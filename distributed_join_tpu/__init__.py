@@ -1,0 +1,64 @@
+"""distributed_join_tpu — a TPU-native distributed equi-join framework.
+
+A ground-up re-design of the capabilities of the `distributed-join`
+reference (GPU radix hash-partition + NCCL/UCX all-to-all + local cuDF
+hash join) for TPU hardware:
+
+- tables are sharded JAX arrays over a ``jax.sharding.Mesh``;
+- the radix hash-partition lowers to pure ``jax.lax`` ops
+  (murmur-style hash -> stable sort by bucket -> searchsorted offsets);
+- the NCCL/UCX all-to-all shuffle becomes a two-phase
+  (counts, then capacity-padded data) ``jax.lax.all_to_all`` over ICI;
+- the local hash join becomes an XLA sort-merge join per partition;
+- the whole partition -> shuffle -> join pipeline compiles as ONE SPMD
+  program under ``jax.jit`` + ``shard_map`` so XLA overlaps collectives
+  with compute (the reference does this by hand with CUDA streams and
+  an over-decomposition pipeline; see SURVEY.md §0 and §2).
+
+The reference's ``Communicator`` plugin boundary (SURVEY.md §2,
+`src/communicator.hpp` in the reference layout) survives as
+:mod:`distributed_join_tpu.parallel.communicator`.
+
+int64 keys require JAX x64 mode; we enable it at import, before any
+tracing happens.
+"""
+
+import os as _os
+
+import jax as _jax
+
+# int64 keys (every BASELINE config) need x64. Respect an explicit user
+# choice via the JAX_ENABLE_X64 env var; otherwise enable it here,
+# before any tracing.
+if "JAX_ENABLE_X64" not in _os.environ:
+    _jax.config.update("jax_enable_x64", True)
+
+from distributed_join_tpu.table import Table  # noqa: E402
+from distributed_join_tpu.ops.hashing import hash_columns  # noqa: E402
+from distributed_join_tpu.ops.partition import radix_hash_partition  # noqa: E402
+from distributed_join_tpu.ops.join import sort_merge_inner_join  # noqa: E402
+from distributed_join_tpu.parallel.communicator import (  # noqa: E402
+    Communicator,
+    LocalCommunicator,
+    TpuCommunicator,
+    make_communicator,
+)
+from distributed_join_tpu.parallel.distributed_join import (  # noqa: E402
+    distributed_inner_join,
+    make_distributed_join,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Table",
+    "hash_columns",
+    "radix_hash_partition",
+    "sort_merge_inner_join",
+    "Communicator",
+    "LocalCommunicator",
+    "TpuCommunicator",
+    "make_communicator",
+    "distributed_inner_join",
+    "make_distributed_join",
+]

@@ -1,0 +1,8 @@
+"""Relational compute kernels — pure jax.lax, single device.
+
+These replace the reference's delegations to cuDF GPU kernels
+(``cudf::hash_partition``, ``cudf::inner_join``; SURVEY.md §2) with
+TPU-idiomatic sort-based equivalents: hashing and radix partition in
+:mod:`hashing` / :mod:`partition`, the per-partition sort-merge join in
+:mod:`join`.
+"""

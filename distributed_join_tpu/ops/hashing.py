@@ -1,0 +1,91 @@
+"""Key hashing for radix partitioning.
+
+The reference delegates to ``cudf::hash_partition`` which uses
+MurmurHash3 (SURVEY.md §2 "Hash partition step"). We use the Murmur3
+finalizers (fmix64 / fmix32) — full avalanche on fixed-width ints, a
+handful of XLA elementwise ops, no lanes of byte-wise state — plus a
+boost-style hash combine for composite (multi-column) keys.
+
+All functions are shape-preserving elementwise maps: they fuse into
+whatever consumes them and never touch HBM on their own.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def fmix64(x: jax.Array) -> jax.Array:
+    """Murmur3 64-bit finalizer. Input any int dtype; output uint64."""
+    k = x.astype(jnp.uint64)
+    k ^= k >> 33
+    k *= jnp.uint64(0xFF51AFD7ED558CCD)
+    k ^= k >> 33
+    k *= jnp.uint64(0xC4CEB9FE1A85EC53)
+    k ^= k >> 33
+    return k
+
+
+def fmix32(x: jax.Array) -> jax.Array:
+    """Murmur3 32-bit finalizer. Input any int dtype; output uint32."""
+    h = x.astype(jnp.uint32)
+    h ^= h >> 16
+    h *= jnp.uint32(0x85EBCA6B)
+    h ^= h >> 13
+    h *= jnp.uint32(0xC2B2AE35)
+    h ^= h >> 16
+    return h
+
+
+def _hash_one(col: jax.Array) -> jax.Array:
+    dt = col.dtype
+    if dt in (jnp.int64, jnp.uint64):
+        return fmix64(col)
+    if dt in (jnp.int32, jnp.uint32, jnp.int16, jnp.uint16, jnp.int8, jnp.uint8):
+        return fmix32(col).astype(jnp.uint64)
+    if dt == jnp.float64:
+        # TPU's X64-rewrite pass can't lower ANY f64 bitcast (verified on
+        # v5e: f64->u64, f64->2xu32, and frexp — which bitcasts
+        # internally — all fail; f64 sort/compare are fine). Decompose
+        # arithmetically instead. Hashing only needs equal values ->
+        # equal hashes, and every op here is a deterministic elementwise
+        # function, so that holds; the 52-bit mantissa capture keeps
+        # collision quality. -0.0 folds onto 0.0 (IEEE equality wants
+        # that); NaN/inf degrade to a constant bucket, harmless.
+        a = jnp.abs(col)
+        e = jnp.where(a > 0, jnp.floor(jnp.log2(a)), 0.0)
+        m = jnp.where(a > 0, a / jnp.exp2(e), 0.0)
+        mi = (m * (2.0**52)).astype(jnp.int64).astype(jnp.uint64)
+        ebits = e.astype(jnp.int32) ^ (col < 0).astype(jnp.int32) << 30
+        return hash_combine(fmix64(mi), fmix32(ebits).astype(jnp.uint64))
+    if dt == jnp.float32:
+        return fmix32(jax.lax.bitcast_convert_type(col, jnp.uint32)).astype(jnp.uint64)
+    raise TypeError(f"unhashable column dtype {dt}")
+
+
+def hash_combine(seed: jax.Array, h: jax.Array) -> jax.Array:
+    """boost::hash_combine on uint64 lanes."""
+    magic = jnp.uint64(0x9E3779B97F4A7C15)
+    return seed ^ (h + magic + (seed << 6) + (seed >> 2))
+
+
+def hash_columns(cols: Sequence[jax.Array]) -> jax.Array:
+    """Row-wise uint64 hash over one or more key columns."""
+    if not cols:
+        raise ValueError("need at least one key column")
+    acc = _hash_one(cols[0])
+    for c in cols[1:]:
+        acc = hash_combine(acc, _hash_one(c))
+    return acc
+
+
+def bucket_ids(cols: Sequence[jax.Array], n_buckets: int) -> jax.Array:
+    """Row-wise bucket id in [0, n_buckets) as int32, via hash modulo
+    n_buckets — fmix avalanches fully so the bottom bits are as good as
+    any, and modulo matches the reference's ``hash % nranks`` routing.
+    """
+    h = hash_columns(cols)
+    return (h % jnp.uint64(n_buckets)).astype(jnp.int32)

@@ -1,0 +1,121 @@
+"""Per-partition sort-merge inner join.
+
+The reference's local join step delegates to ``cudf::hash_join`` —
+build a GPU hash table on the smaller side, probe with the larger
+(SURVEY.md §2 "Local join step"). Hash tables need random scatter/gather
+and data-dependent probing loops, which map badly onto the TPU's vector
+units; the TPU-native formulation (SURVEY.md §7 step 1) is sort-merge:
+
+  1. stably sort the build side by key (padding rows sort last, then get
+     rewritten to the dtype max so the array is globally sorted);
+  2. for every probe row, binary-search the run of equal build keys
+     (``searchsorted`` left/right, clamped to the valid prefix);
+  3. expand the runs into output rows: exclusive-scan the per-probe match
+     counts, invert the scan with one more ``searchsorted`` over a
+     static-capacity output iota, and gather both payloads.
+
+Everything is sorts, scans, searchsorteds and gathers — XLA's bread and
+butter on TPU. Output capacity is static (XLA constraint); the true
+match count and an overflow flag are returned alongside.
+
+Duplicate keys on either side are fully supported (runs × runs expansion
+is exactly what step 3 produces). Null/padding rows never match.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from distributed_join_tpu.table import Table
+
+
+def _dtype_sentinel_max(dt):
+    if jnp.issubdtype(dt, jnp.integer):
+        return jnp.iinfo(dt).max
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.inf
+    raise TypeError(f"unsupported key dtype {dt}")
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class JoinResult:
+    table: Table          # static capacity; .valid marks real result rows
+    total: jax.Array      # true number of matches (may exceed capacity)
+    overflow: jax.Array   # bool: total > capacity, rows were truncated
+
+
+def sort_merge_inner_join(
+    build: Table,
+    probe: Table,
+    key: str,
+    out_capacity: int,
+    build_payload: Optional[Sequence[str]] = None,
+    probe_payload: Optional[Sequence[str]] = None,
+) -> JoinResult:
+    """Inner-join ``build`` and ``probe`` on equality of column ``key``.
+
+    Output columns: ``key`` (probe's copy), then build payloads, then
+    probe payloads. Payload names must not collide.
+    """
+    if build_payload is None:
+        build_payload = [n for n in build.column_names if n != key]
+    if probe_payload is None:
+        probe_payload = [n for n in probe.column_names if n != key]
+    clash = set(build_payload) & set(probe_payload)
+    if clash:
+        raise ValueError(f"payload name collision: {sorted(clash)}")
+
+    bkey = build.columns[key]
+    pkey = probe.columns[key]
+    if bkey.dtype != pkey.dtype:
+        # Hashing and sort order are dtype-dependent; a silent mismatch
+        # would route equal values to different buckets and drop matches.
+        raise TypeError(
+            f"key dtype mismatch: build {bkey.dtype} vs probe {pkey.dtype}"
+        )
+    bc = build.capacity
+
+    # 1. Sort build rows by (is_padding, key); padding sorts last.
+    order = jnp.lexsort((bkey, ~build.valid))
+    skey = bkey[order]
+    n_build = build.num_valid()
+    iota_b = jnp.arange(bc)
+    sentinel = _dtype_sentinel_max(bkey.dtype)
+    skey = jnp.where(iota_b < n_build, skey, sentinel)
+
+    # 2. Equal-key run per probe row, clamped to the valid prefix
+    #    (guards against real keys equal to the sentinel).
+    lo = jnp.searchsorted(skey, pkey, side="left", method="sort")
+    hi = jnp.searchsorted(skey, pkey, side="right", method="sort")
+    lo = jnp.minimum(lo, n_build)
+    hi = jnp.minimum(hi, n_build)
+    cnt = jnp.where(probe.valid, hi - lo, 0).astype(jnp.int32)
+
+    # 3. Expand runs into output rows.
+    csum = jnp.cumsum(cnt)
+    total = csum[-1]
+    j = jnp.arange(out_capacity, dtype=csum.dtype)
+    p = jnp.searchsorted(csum, j, side="right", method="sort")
+    p = jnp.minimum(p, probe.capacity - 1)
+    run_start = csum[p] - cnt[p]
+    bpos = lo[p] + (j - run_start)
+    bidx = order[jnp.clip(bpos, 0, bc - 1)]
+    out_valid = j < total
+
+    out_cols = {key: probe.columns[key][p]}
+    for n in build_payload:
+        out_cols[n] = build.columns[n][bidx]
+    for n in probe_payload:
+        out_cols[n] = probe.columns[n][p]
+
+    out_valid = out_valid & probe.valid[p]  # belt-and-braces; p rows with cnt>0 are valid
+    return JoinResult(
+        Table(out_cols, out_valid),
+        total=total,
+        overflow=total > out_capacity,
+    )

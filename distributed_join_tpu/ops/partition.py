@@ -1,0 +1,105 @@
+"""Radix hash partition — the TPU equivalent of ``cudf::hash_partition``.
+
+The reference's partition step (SURVEY.md §2 "Hash partition step") is a
+Murmur3 radix scatter on GPU. Scatters are a poor fit for the TPU memory
+system, so the TPU-native formulation is sort-based (SURVEY.md §7 step 1):
+
+    hash -> bucket id -> stable sort rows by bucket -> searchsorted offsets
+
+One ``lax.sort`` over the shard dominates; everything else fuses. The
+result is exactly what the reference's all-to-all needs: rows grouped by
+destination bucket plus a per-bucket offset/count vector (the reference
+exchanges the same counts in its metadata all-to-all, SURVEY.md §2
+"Size-exchange helper").
+
+``PartitionedTable.to_padded`` lays the buckets out as a dense
+``(n_buckets, capacity)`` block for the fixed-shape collective; overflow
+(a bucket larger than the static capacity) is reported per call so the
+caller can re-run with a bigger pad or trigger the skew path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from distributed_join_tpu.ops.hashing import bucket_ids
+from distributed_join_tpu.table import Table
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PartitionedTable:
+    """Rows stably sorted by bucket; valid rows form a prefix.
+
+    Attributes:
+      table:   sorted rows (invalid rows sort after every bucket).
+      offsets: (n_buckets + 1,) int32; bucket b occupies
+               rows [offsets[b], offsets[b+1]).
+      counts:  (n_buckets,) int32 == diff(offsets).
+    """
+
+    table: Table
+    offsets: jax.Array
+    counts: jax.Array
+
+    @property
+    def n_buckets(self) -> int:
+        return self.counts.shape[0]
+
+    def to_padded(self, capacity: int, bucket_start: int = 0,
+                  n_buckets: int | None = None):
+        """Dense (n_buckets, capacity) layout for fixed-shape all-to-all.
+
+        ``bucket_start``/``n_buckets`` select a contiguous bucket range —
+        the over-decomposition path shuffles one batch (= one range of
+        n_ranks buckets) at a time, exactly like the reference's batched
+        pipeline (SURVEY.md §2 "Over-decomposition").
+
+        Returns (padded_columns: dict name -> (n_buckets, capacity) array,
+        counts clipped to capacity, overflow: bool scalar — True iff some
+        selected bucket exceeded the capacity and rows were dropped,
+        row_valid: (n_buckets, capacity) bool mask).
+        """
+        nb = self.n_buckets if n_buckets is None else n_buckets
+        offs = self.offsets[bucket_start : bucket_start + nb]
+        counts = self.counts[bucket_start : bucket_start + nb]
+        lane = jnp.arange(capacity, dtype=jnp.int32)
+        idx = offs[:, None] + lane[None, :]
+        row_valid = lane[None, :] < counts[:, None]
+        cap_total = self.table.capacity
+        safe = jnp.clip(idx, 0, cap_total - 1)
+        padded = {n: c[safe] for n, c in self.table.columns.items()}
+        overflow = jnp.any(counts > capacity)
+        return padded, jnp.minimum(counts, capacity), overflow, row_valid
+
+
+def radix_hash_partition(
+    table: Table, key_cols: Sequence[str], n_buckets: int
+) -> PartitionedTable:
+    """Partition ``table`` into ``n_buckets`` by hash of ``key_cols``."""
+    b = bucket_ids([table.columns[c] for c in key_cols], n_buckets)
+    # Padding rows get bucket n_buckets so they sort after every real bucket.
+    b = jnp.where(table.valid, b, jnp.int32(n_buckets))
+    order = jnp.argsort(b, stable=True)
+    sorted_b = b[order]
+    cols = {n: c[order] for n, c in table.columns.items()}
+    valid = table.valid[order]
+    offsets = jnp.searchsorted(
+        sorted_b, jnp.arange(n_buckets + 1, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)
+    counts = jnp.diff(offsets)
+    return PartitionedTable(Table(cols, valid), offsets, counts)
+
+
+def unpad(padded_columns, counts, capacity: int) -> Table:
+    """Inverse-ish of ``to_padded`` after a shuffle: flatten a
+    (n_src, capacity) block received from n_src peers into a flat Table
+    whose validity mask marks the first counts[s] rows of each stripe."""
+    lane = jnp.arange(capacity, dtype=jnp.int32)
+    valid = (lane[None, :] < counts[:, None]).reshape(-1)
+    cols = {n: c.reshape(-1) for n, c in padded_columns.items()}
+    return Table(cols, valid)

@@ -1,0 +1,8 @@
+"""Distributed layer: device mesh, communicator seam, shuffle, orchestrator.
+
+This is the TPU re-design of the reference's layer 2 + 3 (SURVEY.md §1):
+the ``Communicator`` plugin boundary and the ``distributed_inner_join``
+orchestrator. Control plane = JAX distributed runtime / process bootstrap
+(the reference uses MPI); data plane = XLA collectives over ICI (the
+reference uses NCCL/UCX).
+"""

@@ -1,0 +1,164 @@
+"""The Communicator plugin boundary, TPU-native.
+
+The reference's central abstraction (SURVEY.md §2,
+``src/communicator.hpp``: virtual ``initialize / send / recv / waitall /
+finalize`` with NCCL and UCX implementations, MPI bootstrap) is the seam
+`BASELINE.json`'s north star requires us to keep. On TPU the seam moves
+up one level of abstraction:
+
+- the reference's per-peer ``send/recv`` pairs + ``waitall`` always
+  implement one logical op — an all-to-all exchange — so the TPU
+  ``Communicator`` exposes ``all_to_all`` directly and lets XLA lower it
+  to ICI DMAs (there is no profitable TPU analog of hand-posted sends);
+- ``initialize`` becomes mesh construction + (multi-host) the JAX
+  distributed runtime handshake — coordinator over TCP/DCN replaces the
+  reference's ``MPI_Bcast`` of the NCCL unique id (SURVEY.md §3.3);
+- ``waitall`` disappears: XLA schedules the collective asynchronously
+  inside the compiled program and overlaps it with compute, which is the
+  reference's over-decomposition pipeline done by the compiler.
+
+Implementations:
+
+- :class:`TpuCommunicator` — ``jax.lax.all_to_all`` under ``shard_map``
+  over a 1-D mesh; works identically on a real ICI slice and on the
+  CPU fake backend (``--xla_force_host_platform_device_count=N``).
+- :class:`LocalCommunicator` — 1 rank; the collective degenerates to
+  identity. BASELINE config 1's CPU reference path.
+
+``spmd`` is the entry for running a per-rank function SPMD over the
+mesh, with row-sharded inputs/outputs; the orchestrator
+(:mod:`distributed_join_tpu.parallel.distributed_join`) is built on it.
+"""
+
+from __future__ import annotations
+
+import abc
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_join_tpu.parallel.mesh import RANK_AXIS, make_mesh
+
+
+class Communicator(abc.ABC):
+    """Abstract communication backend (the reference's plugin boundary)."""
+
+    name: str = "abstract"
+
+    @property
+    @abc.abstractmethod
+    def n_ranks(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def all_to_all(self, x: jax.Array) -> jax.Array:
+        """Exchange row-blocks: x has shape (n_ranks * m, ...) per rank;
+        block i (rows [i*m, (i+1)*m)) is sent to rank i; the result
+        concatenates the blocks received from every rank in rank order.
+        Must be called inside :meth:`spmd`. Shape-preserving."""
+
+    @abc.abstractmethod
+    def spmd(self, fn: Callable, *, sharded_out=None) -> Callable:
+        """Compile ``fn`` to run SPMD, one instance per rank.
+
+        Array args/outputs are row-sharded over ranks (global view);
+        outputs flagged replicated in ``sharded_out`` (a pytree prefix of
+        bools, default all-sharded) must be identical on every rank
+        (e.g. after a psum).
+        """
+
+    # -- small conveniences shared by backends ------------------------
+
+    def psum(self, x):
+        return x
+
+    def finalize(self) -> None:
+        """Reference parity (``Communicator::finalize``); no-op — XLA
+        owns transport/buffer lifetime on TPU."""
+
+
+class TpuCommunicator(Communicator):
+    """XLA-collective backend over a 1-D device mesh (ICI data plane)."""
+
+    name = "tpu"
+
+    def __init__(self, mesh: Mesh | None = None, n_ranks: int | None = None):
+        self.mesh = mesh if mesh is not None else make_mesh(n_ranks)
+        if len(self.mesh.axis_names) != 1:
+            raise ValueError("TpuCommunicator needs a 1-D mesh")
+        self.axis_name = self.mesh.axis_names[0]
+
+    @property
+    def n_ranks(self) -> int:
+        return self.mesh.shape[self.axis_name]
+
+    def all_to_all(self, x: jax.Array) -> jax.Array:
+        return lax.all_to_all(
+            x, self.axis_name, split_axis=0, concat_axis=0, tiled=True
+        )
+
+    def psum(self, x):
+        return lax.psum(x, self.axis_name)
+
+    def spmd(self, fn: Callable, *, sharded_out=None) -> Callable:
+        shard_spec = P(self.axis_name)
+        if sharded_out is None:
+            out_specs = shard_spec
+        else:
+            out_specs = jax.tree.map(
+                lambda rep: P() if rep else shard_spec,
+                sharded_out,
+            )
+        mapped = jax.shard_map(
+            fn, mesh=self.mesh, in_specs=shard_spec, out_specs=out_specs
+        )
+        return jax.jit(mapped)
+
+    def device_put_sharded(self, tree):
+        """Place a pytree of host arrays row-sharded over the mesh."""
+        sharding = NamedSharding(self.mesh, P(self.axis_name))
+        return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
+
+
+class LocalCommunicator(Communicator):
+    """Single-rank backend: collectives are identities. This is the
+    reference's 1-rank path (BASELINE config 1)."""
+
+    name = "local"
+
+    @property
+    def n_ranks(self) -> int:
+        return 1
+
+    def all_to_all(self, x: jax.Array) -> jax.Array:
+        return x
+
+    def spmd(self, fn: Callable, *, sharded_out=None) -> Callable:
+        return jax.jit(fn)
+
+    def device_put_sharded(self, tree):
+        return jax.tree.map(jax.device_put, tree)
+
+
+def make_communicator(name: str, n_ranks: int | None = None) -> Communicator:
+    """Factory keyed by the reference driver's ``--communicator`` flag.
+
+    The reference accepts {NCCL, UCX}; this framework adds ``tpu`` (the
+    north-star flag) and ``local``. NCCL/UCX are recognized but rejected
+    with an explanatory error — there is no NCCL/UCX on TPU hardware.
+    """
+    lname = name.lower()
+    if lname == "tpu":
+        return TpuCommunicator(n_ranks=n_ranks)
+    if lname == "local":
+        return LocalCommunicator()
+    if lname in ("nccl", "ucx"):
+        raise ValueError(
+            f"communicator {name!r} is the reference's GPU backend; "
+            "this framework targets TPU — use --communicator=tpu"
+        )
+    raise ValueError(f"unknown communicator {name!r}")

@@ -1,0 +1,32 @@
+"""Device-mesh construction helpers.
+
+The reference binds one MPI rank to one GPU (``cudaSetDevice(local_rank)``,
+SURVEY.md §3.1). On TPU the analogous object is a 1-D
+``jax.sharding.Mesh`` over the chips: the mesh axis *is* the rank space,
+and rows sharded along it are "owned" by a rank exactly as the
+reference's per-rank table shards are.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+RANK_AXIS = "ranks"
+
+
+def make_mesh(
+    n_ranks: Optional[int] = None,
+    axis_name: str = RANK_AXIS,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """1-D mesh over the first ``n_ranks`` devices (default: all)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_ranks is not None:
+        if n_ranks > len(devs):
+            raise ValueError(f"asked for {n_ranks} ranks, have {len(devs)} devices")
+        devs = devs[:n_ranks]
+    return Mesh(np.array(devs), (axis_name,))

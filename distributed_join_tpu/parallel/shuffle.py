@@ -1,0 +1,60 @@
+"""Two-phase all-to-all table shuffle.
+
+The reference's shuffle (SURVEY.md §2 "All-to-all shuffle of a cuDF
+table", §3.1) is: exchange per-bucket row counts (metadata all-to-all),
+allocate exact-size receive buffers, then per column per peer post
+send/recv of the bucket slice. XLA has no dynamic receive sizes, so the
+TPU formulation pads each bucket to a static per-destination capacity:
+
+  phase 1: ``all_to_all`` of the (n_ranks,) int32 count vector;
+  phase 2: ``all_to_all`` of each column laid out (n_ranks, capacity).
+
+The received block flattens into a validity-masked Table (padding rows
+carry the mask, not a sentinel). Overflow — a bucket bigger than the
+static capacity — is detected on device and reported so the caller can
+retry with a larger pad or engage the skew path (BASELINE config 3).
+
+Bandwidth note: padding inflates bytes on the wire by ~1/load-factor.
+For uniform keys capacity_factor ~1.2-1.5 keeps that small; the skew
+path exists precisely because one hot bucket would otherwise set the pad
+for everyone (SURVEY.md §7 hard part #2).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_join_tpu.ops.partition import PartitionedTable, unpad
+from distributed_join_tpu.parallel.communicator import Communicator
+from distributed_join_tpu.table import Table
+
+
+def shuffle_padded(
+    comm: Communicator, padded_columns, counts: jax.Array, capacity: int
+) -> Tuple[Table, jax.Array]:
+    """Shuffle a pre-padded (n_ranks, capacity) block; returns the
+    received rows as a masked Table plus the received counts."""
+    recv_counts = comm.all_to_all(counts)
+    recv_cols = {n: comm.all_to_all(c) for n, c in padded_columns.items()}
+    return unpad(recv_cols, recv_counts, capacity), recv_counts
+
+
+def shuffle_partitioned(
+    comm: Communicator, pt: PartitionedTable, capacity: int
+) -> Tuple[Table, jax.Array]:
+    """Shuffle a table already partitioned into exactly n_ranks buckets.
+
+    Returns (received table, overflow flag). The received table holds
+    every row of the global table whose key hashes to this rank, padding
+    masked off.
+    """
+    if pt.n_buckets != comm.n_ranks:
+        raise ValueError(
+            f"partitioned into {pt.n_buckets} buckets but {comm.n_ranks} ranks"
+        )
+    padded, counts, overflow, _ = pt.to_padded(capacity)
+    table, _ = shuffle_padded(comm, padded, counts, capacity)
+    return table, overflow

@@ -1,0 +1,108 @@
+"""Static-shape table representation.
+
+XLA compiles static shapes only, but join/partition outputs are
+data-dependent (SURVEY.md §7 "hard part #1"). The framework-wide answer
+is the :class:`Table`: a pytree of equal-length columns with a fixed
+*capacity* (the static array length) plus a dynamic *validity* —
+either a scalar ``num_valid`` when the valid rows form a prefix, or a
+full boolean mask when they are interleaved (e.g. straight out of a
+padded all-to-all shuffle).
+
+The reference keeps dynamic row counts in cuDF column metadata on the
+host; here validity travels on-device inside the compiled program so the
+whole pipeline stays in one XLA computation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Table:
+    """A fixed-capacity columnar table.
+
+    Attributes:
+      columns: name -> 1-D array; all the same length (the capacity).
+      valid:   boolean mask of shape (capacity,). ``valid[i]`` marks row
+               ``i`` as a real row (vs padding).
+    """
+
+    columns: Mapping[str, jax.Array]
+    valid: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return next(iter(self.columns.values())).shape[0]
+
+    @property
+    def column_names(self):
+        return list(self.columns)
+
+    def num_valid(self) -> jax.Array:
+        """Dynamic count of real rows (traced scalar)."""
+        return jnp.sum(self.valid.astype(jnp.int32))
+
+    def __post_init__(self):
+        if not self.columns:
+            raise ValueError("Table needs at least one column")
+        # JAX transforms rebuild pytrees with non-array sentinels; only
+        # validate when we actually hold arrays.
+        if not all(hasattr(c, "shape") for c in self.columns.values()):
+            return
+        lengths = {name: c.shape for name, c in self.columns.items()}
+        for name, shape in lengths.items():
+            if len(shape) != 1:
+                raise ValueError(f"column {name!r} must be 1-D, got {shape}")
+        if len({s[0] for s in lengths.values()}) != 1:
+            raise ValueError(f"columns must share a length, got {lengths}")
+
+    # -- constructors -------------------------------------------------
+
+    @staticmethod
+    def from_dense(columns: Mapping[str, jax.Array]) -> "Table":
+        """All rows valid."""
+        cap = next(iter(columns.values())).shape[0]
+        return Table(dict(columns), jnp.ones((cap,), dtype=bool))
+
+    @staticmethod
+    def from_prefix(columns: Mapping[str, jax.Array], num_valid) -> "Table":
+        """Rows [0, num_valid) valid; the rest padding."""
+        cap = next(iter(columns.values())).shape[0]
+        valid = jnp.arange(cap) < num_valid
+        return Table(dict(columns), valid)
+
+    # -- transforms ---------------------------------------------------
+
+    def select(self, names) -> "Table":
+        return Table({n: self.columns[n] for n in names}, self.valid)
+
+    def gather(self, idx: jax.Array, idx_valid: jax.Array) -> "Table":
+        """Rows at ``idx`` where ``idx_valid``; out-of-range idx clamped."""
+        cap = self.capacity
+        safe = jnp.clip(idx, 0, cap - 1)
+        cols = {n: c[safe] for n, c in self.columns.items()}
+        return Table(cols, idx_valid & self.valid[safe])
+
+    def compact(self) -> "Table":
+        """Stable-move valid rows to a prefix (one extra sort)."""
+        order = jnp.argsort(~self.valid, stable=True)
+        cols = {n: c[order] for n, c in self.columns.items()}
+        return Table(cols, self.valid[order])
+
+    # -- host-side helpers (NOT jittable) -----------------------------
+
+    def to_pandas(self):
+        """Materialize valid rows on host. Test/debug only."""
+        import numpy as np
+        import pandas as pd
+
+        mask = np.asarray(self.valid)
+        return pd.DataFrame(
+            {n: np.asarray(c)[mask] for n, c in self.columns.items()}
+        )

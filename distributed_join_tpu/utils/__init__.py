@@ -1,0 +1,1 @@
+"""Support utilities: synthetic table generators, TPC-H tables, timing."""

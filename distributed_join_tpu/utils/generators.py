@@ -1,0 +1,114 @@
+"""Synthetic build/probe table generators.
+
+Mirrors the reference's device-side generator
+(``src/generate_table.cuh::generate_build_probe_tables``, SURVEY.md §2):
+build keys uniform in [0, rand_max), probe keys drawn from the build
+keys with probability ``selectivity`` and otherwise from a disjoint
+range so they are guaranteed absent. Generation is `jax.random` on
+device — one-time cost outside the measured region, exactly like the
+reference's Thrust kernels.
+
+Adds a bounded-Zipf generator for BASELINE config 3 (skew path), which
+the uniform-only reference lacks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_join_tpu.table import Table
+
+
+def generate_build_table(
+    key: jax.Array,
+    nrows: int,
+    rand_max: int,
+    key_dtype=jnp.int64,
+    payload_dtype=jnp.int64,
+    unique_keys: bool = False,
+) -> Table:
+    """Build side: keys in [0, rand_max), payload = row id.
+
+    ``unique_keys=True`` uses a permutation-free construction: key i is
+    simply i (requires nrows <= rand_max), matching the reference's
+    unique-build-keys mode where every build key appears once.
+    """
+    if unique_keys:
+        if nrows > rand_max:
+            raise ValueError("unique keys need nrows <= rand_max")
+        keys = jnp.arange(nrows, dtype=key_dtype)
+    else:
+        keys = jax.random.randint(key, (nrows,), 0, rand_max, dtype=key_dtype)
+    payload = jnp.arange(nrows, dtype=payload_dtype)
+    return Table.from_dense({"key": keys, "build_payload": payload})
+
+
+def generate_probe_table(
+    key: jax.Array,
+    nrows: int,
+    rand_max: int,
+    selectivity: float,
+    build_keys: jax.Array,
+    key_dtype=jnp.int64,
+    payload_dtype=jnp.int64,
+) -> Table:
+    """Probe side: with prob ``selectivity`` a random build key (match
+    guaranteed), else a key in [rand_max, 2*rand_max) (miss guaranteed)."""
+    k_sel, k_pick, k_miss = jax.random.split(key, 3)
+    pick = jax.random.randint(k_pick, (nrows,), 0, build_keys.shape[0])
+    hit_keys = build_keys[pick]
+    miss_keys = jax.random.randint(
+        k_miss, (nrows,), rand_max, 2 * rand_max, dtype=key_dtype
+    )
+    is_hit = jax.random.uniform(k_sel, (nrows,)) < selectivity
+    keys = jnp.where(is_hit, hit_keys, miss_keys).astype(key_dtype)
+    payload = jnp.arange(nrows, dtype=payload_dtype)
+    return Table.from_dense({"key": keys, "probe_payload": payload})
+
+
+def generate_build_probe_tables(
+    seed: int,
+    build_nrows: int,
+    probe_nrows: int,
+    rand_max: int | None = None,
+    selectivity: float = 0.3,
+    key_dtype=jnp.int64,
+    payload_dtype=jnp.int64,
+    unique_build_keys: bool = False,
+):
+    """The reference's combined entry point (flag-for-flag; SURVEY.md §2)."""
+    if rand_max is None:
+        rand_max = build_nrows
+    kb, kp = jax.random.split(jax.random.PRNGKey(seed))
+    build = generate_build_table(
+        kb, build_nrows, rand_max, key_dtype, payload_dtype, unique_build_keys
+    )
+    probe = generate_probe_table(
+        kp, probe_nrows, rand_max, selectivity, build.columns["key"],
+        key_dtype, payload_dtype,
+    )
+    return build, probe
+
+
+def zipf_keys(
+    key: jax.Array, nrows: int, alpha: float, rand_max: int, dtype=jnp.int64
+) -> jax.Array:
+    """Bounded Zipf(alpha) keys in [0, rand_max) via inverse-CDF of the
+    Pareto tail approximation: P(X > x) ~ x^(1-alpha). Heavy hitters land
+    on small key values — the load-imbalance path of BASELINE config 3."""
+    if alpha <= 1.0:
+        raise ValueError("alpha must be > 1")
+    u = jax.random.uniform(key, (nrows,), minval=1e-12, maxval=1.0)
+    x = jnp.power(u, -1.0 / (alpha - 1.0))
+    k = jnp.clip(x.astype(dtype) - 1, 0, rand_max - 1)
+    return k
+
+
+def generate_zipf_probe_table(
+    key: jax.Array, nrows: int, alpha: float, rand_max: int,
+    key_dtype=jnp.int64, payload_dtype=jnp.int64,
+) -> Table:
+    keys = zipf_keys(key, nrows, alpha, rand_max, key_dtype)
+    payload = jnp.arange(nrows, dtype=payload_dtype)
+    return Table.from_dense({"key": keys, "probe_payload": payload})

@@ -1,0 +1,26 @@
+"""Test harness: 8 virtual CPU devices, no TPU required.
+
+The reference can only test multi-rank behavior with real GPUs under
+mpirun (SURVEY.md §4). JAX lets us do better: forcing the host platform
+to present 8 virtual devices runs the *identical* shard_map/collective
+program with real all-to-all semantics on CPU.
+
+This environment pre-imports jax from sitecustomize (the axon TPU
+plugin), so env vars alone are too late: we must flip the platform via
+``jax.config`` before any backend initializes. XLA_FLAGS is still read
+at backend-creation time, so mutating it here (before the first
+``jax.devices()``) works.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

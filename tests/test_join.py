@@ -1,0 +1,122 @@
+"""Single-partition sort-merge join vs pandas oracle.
+
+Mirrors the reference's oracle strategy (SURVEY.md §3.4): reference join
+on the full tables, sort-normalize both results, exact compare.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_join_tpu.ops.join import sort_merge_inner_join
+from distributed_join_tpu.table import Table
+from distributed_join_tpu.utils.generators import generate_build_probe_tables
+
+
+def _oracle(build_df, probe_df):
+    return build_df.merge(probe_df, on="key", how="inner")
+
+
+def _normalize(df):
+    cols = sorted(df.columns)
+    return (
+        df[cols].sort_values(cols).reset_index(drop=True).astype("int64")
+    )
+
+
+def _check(build: Table, probe: Table, out_cap: int):
+    res = sort_merge_inner_join(build, probe, "key", out_cap)
+    got = _normalize(res.table.to_pandas())
+    want = _normalize(_oracle(build.to_pandas(), probe.to_pandas()))
+    assert int(res.total) == len(want)
+    assert not bool(res.overflow)
+    pd.testing.assert_frame_equal(got, want)
+
+
+def _mk(keys, payload_name):
+    keys = jnp.asarray(keys, dtype=jnp.int64)
+    return Table.from_dense(
+        {"key": keys, payload_name: jnp.arange(keys.shape[0], dtype=jnp.int64)}
+    )
+
+
+def test_basic_join():
+    build = _mk([1, 2, 3, 4], "b")
+    probe = _mk([2, 4, 4, 9], "p")
+    _check(build, probe, out_cap=16)
+
+
+def test_duplicate_keys_both_sides():
+    build = _mk([1, 1, 2, 3, 3, 3], "b")
+    probe = _mk([1, 3, 3, 5], "p")
+    # matches: 1x2 + 3x3 + 3x3 = 2 + 9... (2 probes of 3 x 3 builds) = 2+6=8
+    _check(build, probe, out_cap=32)
+
+
+def test_no_matches():
+    build = _mk([1, 2, 3], "b")
+    probe = _mk([7, 8, 9], "p")
+    res = sort_merge_inner_join(build, probe, "key", 8)
+    assert int(res.total) == 0
+    assert not bool(np.asarray(res.table.valid).any())
+
+
+def test_padding_rows_never_match():
+    build = Table(
+        {"key": jnp.array([1, 2, 3], dtype=jnp.int64),
+         "b": jnp.arange(3, dtype=jnp.int64)},
+        jnp.array([True, False, True]),
+    )
+    probe = Table(
+        {"key": jnp.array([2, 3, 2], dtype=jnp.int64),
+         "p": jnp.arange(3, dtype=jnp.int64)},
+        jnp.array([True, True, False]),
+    )
+    res = sort_merge_inner_join(build, probe, "key", 8)
+    got = _normalize(res.table.to_pandas())
+    want = _normalize(
+        _oracle(build.to_pandas(), probe.to_pandas())
+    )
+    pd.testing.assert_frame_equal(got, want)
+    assert int(res.total) == 1  # only key 3
+
+
+def test_sentinel_key_value_is_joinable():
+    big = np.iinfo(np.int64).max
+    build = _mk([big, 5], "b")
+    probe = _mk([big, big], "p")
+    res = sort_merge_inner_join(build, probe, "key", 8)
+    assert int(res.total) == 2
+
+
+def test_overflow_flag_and_truncation():
+    build = _mk([1, 1, 1, 1], "b")
+    probe = _mk([1, 1], "p")  # 8 matches
+    res = sort_merge_inner_join(build, probe, "key", 4)
+    assert bool(res.overflow)
+    assert int(res.total) == 8
+    assert int(np.asarray(res.table.valid).sum()) == 4
+
+
+def test_generated_tables_selectivity():
+    build, probe = generate_build_probe_tables(
+        seed=7, build_nrows=2000, probe_nrows=3000, rand_max=500,
+        selectivity=0.4,
+    )
+    _check(build, probe, out_cap=64_000)
+
+
+def test_unique_build_keys():
+    build, probe = generate_build_probe_tables(
+        seed=8, build_nrows=1000, probe_nrows=4000, selectivity=0.5,
+        unique_build_keys=True,
+    )
+    _check(build, probe, out_cap=8_000)
+
+
+def test_payload_name_collision_rejected():
+    build = _mk([1], "x")
+    probe = _mk([1], "x")
+    with pytest.raises(ValueError, match="collision"):
+        sort_merge_inner_join(build, probe, "key", 4)

@@ -1,0 +1,89 @@
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_join_tpu.ops.hashing import bucket_ids
+from distributed_join_tpu.ops.partition import radix_hash_partition, unpad
+from distributed_join_tpu.table import Table
+
+
+def _mk(keys, valid=None):
+    keys = jnp.asarray(keys, dtype=jnp.int64)
+    cols = {"key": keys, "payload": jnp.arange(keys.shape[0], dtype=jnp.int64)}
+    if valid is None:
+        return Table.from_dense(cols)
+    return Table(cols, jnp.asarray(valid))
+
+
+def test_partition_groups_rows_by_bucket():
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1000, size=500)
+    t = _mk(keys)
+    nb = 8
+    pt = radix_hash_partition(t, ["key"], nb)
+    want_b = np.asarray(bucket_ids([t.columns["key"]], nb))
+    got_keys = np.asarray(pt.table.columns["key"])
+    got_b = np.asarray(bucket_ids([pt.table.columns["key"]], nb))
+    offsets = np.asarray(pt.offsets)
+    counts = np.asarray(pt.counts)
+    assert counts.sum() == 500
+    assert (np.diff(offsets) == counts).all()
+    # each bucket slice contains exactly the rows hashing to it
+    for b in range(nb):
+        sl = got_b[offsets[b] : offsets[b + 1]]
+        assert (sl == b).all()
+    # multiset of keys preserved
+    assert sorted(got_keys.tolist()) == sorted(keys.tolist())
+
+
+def test_partition_is_stable_and_respects_validity():
+    keys = [5, 5, 5, 5, 5, 5]
+    t = _mk(keys, valid=[True, False, True, True, False, True])
+    pt = radix_hash_partition(t, ["key"], 4)
+    assert int(np.asarray(pt.counts).sum()) == 4
+    # valid rows keep original relative order (stable sort), padding last
+    pay = np.asarray(pt.table.columns["payload"])
+    v = np.asarray(pt.table.valid)
+    assert list(pay[v]) == [0, 2, 3, 5]
+    assert not v[4:].any()
+
+
+def test_to_padded_unpad_roundtrip():
+    rng = np.random.default_rng(1)
+    keys = rng.integers(0, 50, size=200)
+    t = _mk(keys)
+    nb = 4
+    pt = radix_hash_partition(t, ["key"], nb)
+    cap = int(np.asarray(pt.counts).max()) + 3
+    padded, counts, overflow, _ = pt.to_padded(cap)
+    assert not bool(overflow)
+    flat = unpad(padded, counts, cap)
+    got = flat.to_pandas()
+    assert len(got) == 200
+    assert sorted(got["key"].tolist()) == sorted(keys.tolist())
+
+
+def test_to_padded_overflow_flag():
+    t = _mk([7] * 100)  # all rows in one bucket
+    pt = radix_hash_partition(t, ["key"], 4)
+    _, counts, overflow, _ = pt.to_padded(16)
+    assert bool(overflow)
+    assert np.asarray(counts).max() == 16
+
+
+def test_to_padded_bucket_range():
+    rng = np.random.default_rng(2)
+    keys = rng.integers(0, 1000, size=300)
+    t = _mk(keys)
+    pt = radix_hash_partition(t, ["key"], 8)  # k=2 batches of 4 ranks
+    cap = 128
+    rows = []
+    for batch in range(2):
+        padded, counts, ovf, _ = pt.to_padded(cap, bucket_start=batch * 4, n_buckets=4)
+        assert not bool(ovf)
+        flat = unpad(padded, counts, cap)
+        rows.append(flat.to_pandas())
+    import pandas as pd
+
+    both = pd.concat(rows)
+    assert len(both) == 300
+    assert sorted(both["key"].tolist()) == sorted(keys.tolist())

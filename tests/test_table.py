@@ -1,0 +1,45 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_join_tpu.table import Table
+
+
+def test_from_dense_and_prefix():
+    t = Table.from_dense({"a": jnp.arange(5)})
+    assert t.capacity == 5
+    assert int(t.num_valid()) == 5
+    t2 = Table.from_prefix({"a": jnp.arange(5)}, 3)
+    assert int(t2.num_valid()) == 3
+    assert list(np.asarray(t2.valid)) == [True, True, True, False, False]
+
+
+def test_mismatched_columns_rejected():
+    with pytest.raises(ValueError):
+        Table.from_dense({"a": jnp.arange(5), "b": jnp.arange(4)})
+
+
+def test_gather_clamps_and_masks():
+    t = Table.from_dense({"a": jnp.array([10, 20, 30])})
+    idx = jnp.array([2, 99, 0])
+    out = t.gather(idx, jnp.array([True, False, True]))
+    a = np.asarray(out.columns["a"])
+    v = np.asarray(out.valid)
+    assert a[0] == 30 and a[2] == 10
+    assert list(v) == [True, False, True]
+
+
+def test_compact_moves_valid_to_prefix_stably():
+    t = Table(
+        {"a": jnp.array([1, 2, 3, 4])},
+        jnp.array([False, True, False, True]),
+    )
+    c = t.compact()
+    assert list(np.asarray(c.columns["a"])[:2]) == [2, 4]
+    assert list(np.asarray(c.valid)) == [True, True, False, False]
+
+
+def test_to_pandas_filters_padding():
+    t = Table({"a": jnp.array([1, 2, 3])}, jnp.array([True, False, True]))
+    df = t.to_pandas()
+    assert df["a"].tolist() == [1, 3]
