@@ -25,6 +25,7 @@ is exactly what step 3 produces). Null/padding rows never match.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Optional, Sequence
 
 import jax
@@ -97,8 +98,26 @@ def sort_merge_inner_join(
     cnt = jnp.where(probe.valid, hi - lo, 0).astype(jnp.int32)
 
     # 3. Expand runs into output rows.
+    #    `total` must be int64: duplicate-heavy joins (hot keys on both
+    #    sides) can exceed 2^31 matches per shard, and an int32 wrap
+    #    would turn it negative and defeat the overflow contract. The
+    #    cumsum itself stays int32 — a 64-bit cumsum lowers to an
+    #    emulated-u32-pair reduce-window that blows TPU scoped VMEM at
+    #    10M+ rows (verified on v5e). If csum wraps, total >= 2^31 >>
+    #    out_capacity, so overflow fires and the (garbage) payload rows
+    #    are already flagged untrustworthy.
+    #    With x64 disabled the astype(int64) silently stays int32 and
+    #    that guarantee is gone — warn loudly rather than let the
+    #    overflow contract degrade silently (the package enables x64 at
+    #    import; a user opting out gets a 2^31 matches/shard limit).
+    if not jax.config.x64_enabled:
+        warnings.warn(
+            "JAX x64 is disabled: join match totals are int32 and the "
+            "overflow flag is unreliable past 2**31 matches per shard",
+            stacklevel=2,
+        )
     csum = jnp.cumsum(cnt)
-    total = csum[-1]
+    total = jnp.sum(cnt.astype(jnp.int64))
     j = jnp.arange(out_capacity, dtype=csum.dtype)
     p = jnp.searchsorted(csum, j, side="right", method="sort")
     p = jnp.minimum(p, probe.capacity - 1)

@@ -25,11 +25,9 @@ batches join independently.
 
 from __future__ import annotations
 
-import dataclasses
 import math
 from typing import Optional, Sequence
 
-import jax
 import jax.numpy as jnp
 
 from distributed_join_tpu.ops.join import JoinResult, sort_merge_inner_join
@@ -51,7 +49,7 @@ def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int):
     return table, overflow
 
 
-def make_distributed_join(
+def make_join_step(
     comm: Communicator,
     key: str = "key",
     over_decomposition: int = 1,
@@ -61,12 +59,14 @@ def make_distributed_join(
     build_payload: Optional[Sequence[str]] = None,
     probe_payload: Optional[Sequence[str]] = None,
 ):
-    """Compile a distributed inner join over ``comm``'s ranks.
+    """The raw per-rank join step (partition -> shuffle -> local join).
 
-    Returns a jitted ``fn(build: Table, probe: Table) -> JoinResult``
-    taking row-sharded global Tables (capacity divisible by n_ranks) and
-    returning a row-sharded result Table plus a replicated global match
-    count and overflow flag.
+    Returns ``step(build_local, probe_local) -> JoinResult`` meant to run
+    inside ``comm.spmd`` (collectives are unresolved outside it). Exposed
+    separately from :func:`make_distributed_join` so harnesses can wrap
+    extra structure around the step before compiling — e.g. ``bench.py``
+    chains K dependent steps in one ``lax.fori_loop`` for honest timing
+    over this environment's RPC relay.
 
     Static capacities (the XLA dynamic-shape answer, SURVEY.md §7):
     - shuffle pad per (batch, destination) bucket =
@@ -127,6 +127,18 @@ def make_distributed_join(
         overflow = comm.psum(overflow.astype(jnp.int32)) > 0
         return JoinResult(out, total=total, overflow=overflow)
 
+    return step
+
+
+def make_distributed_join(comm: Communicator, **opts):
+    """Compile a distributed inner join over ``comm``'s ranks.
+
+    Returns a jitted ``fn(build: Table, probe: Table) -> JoinResult``
+    taking row-sharded global Tables (capacity divisible by n_ranks) and
+    returning a row-sharded result Table plus a replicated global match
+    count and overflow flag. See :func:`make_join_step` for options.
+    """
+    step = make_join_step(comm, **opts)
     sharded_out = JoinResult(table=False, total=True, overflow=True)
     return comm.spmd(step, sharded_out=sharded_out)
 

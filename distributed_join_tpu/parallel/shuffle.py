@@ -25,7 +25,6 @@ from __future__ import annotations
 from typing import Tuple
 
 import jax
-import jax.numpy as jnp
 
 from distributed_join_tpu.ops.partition import PartitionedTable, unpad
 from distributed_join_tpu.parallel.communicator import Communicator
