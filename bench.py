@@ -6,17 +6,11 @@ sort-merge inner join) end-to-end on the available device(s) and prints
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 Protocol mirrors the reference's ``benchmark/distributed_join`` driver
-(SURVEY.md §3.1): generate outside the measured region, one warmup
-(compile) run, then a timed region reporting
-``(build_nrows + probe_nrows) / elapsed-per-join`` rows/sec.
-
-Timing discipline: this environment reaches the TPU through an RPC
-relay under which per-call ``block_until_ready`` timing lies (see
-.claude/skills/verify/SKILL.md). So the timed region is ONE compiled
-program that chains ITERS dependent join steps in a ``lax.fori_loop``
-(each iteration's payload is perturbed by the loop counter so nothing
-hoists), fetches a single scalar, and divides by ITERS — RPC overhead
-amortizes to noise.
+(SURVEY.md §3.1): generate outside the measured region, warmup, then a
+timed region reporting ``(build_nrows + probe_nrows) / elapsed-per-join``
+rows/sec. The timing discipline (chained dependent iterations in one
+compiled loop; see distributed_join_tpu/utils/benchmarking.py) is shared
+with benchmark/distributed_join.py.
 
 ``vs_baseline`` is value / 125 M rows/s/chip — the BASELINE.json north
 star (>= 1 B rows/s aggregate on 8 v5e chips) divided per chip; there
@@ -27,11 +21,8 @@ from __future__ import annotations
 
 import json
 import sys
-import time
 
 import jax
-import jax.numpy as jnp
-from jax import lax
 
 BUILD_NROWS = 10_000_000
 PROBE_NROWS = 10_000_000
@@ -46,7 +37,7 @@ def main() -> None:
         TpuCommunicator,
     )
     from distributed_join_tpu.parallel.distributed_join import make_join_step
-    from distributed_join_tpu.table import Table
+    from distributed_join_tpu.utils.benchmarking import timed_join_throughput
     from distributed_join_tpu.utils.generators import generate_build_probe_tables
 
     n_dev = len(jax.devices())
@@ -58,8 +49,7 @@ def main() -> None:
         probe_nrows=PROBE_NROWS,
         selectivity=SELECTIVITY,
     )
-    if hasattr(comm, "device_put_sharded"):
-        build, probe = comm.device_put_sharded((build, probe))
+    build, probe = comm.device_put_sharded((build, probe))
     jax.block_until_ready((build, probe))
 
     step = make_join_step(
@@ -69,58 +59,10 @@ def main() -> None:
         out_rows_per_rank=int(PROBE_NROWS / n_dev * 1.2),
     )
 
-    def looped(build: Table, probe: Table):
-        def body(i, acc):
-            # Shift BOTH sides' keys by the loop counter: every stage
-            # (hash, partition sort, shuffle, join sorts) becomes
-            # loop-variant so XLA cannot hoist work out of the loop,
-            # while the match structure is preserved exactly — equal
-            # keys stay equal, and the generator's miss keys live in a
-            # disjoint range that a common shift keeps disjoint.
-            bcols = dict(build.columns)
-            bcols["key"] = bcols["key"] + i
-            pcols = dict(probe.columns)
-            pcols["key"] = pcols["key"] + i
-            res = step(Table(bcols, build.valid), Table(pcols, probe.valid))
-            # Reduce an output payload column (not just the validity
-            # mask) so XLA cannot dead-code-eliminate the result
-            # materialization gathers out of the timed region.
-            out = res.table
-            consumed = jnp.sum(
-                jnp.where(out.valid, out.columns["probe_payload"], 0)
-            ).astype(jnp.int64)
-            return (
-                acc[0] + res.total.astype(jnp.int64),
-                acc[1] | res.overflow,
-                acc[2] + consumed,
-            )
-
-        # The consumed-carry is per-rank (varying over the mesh axis in
-        # shard_map's vma tracking), so its init must be varying too —
-        # derive it from sharded data instead of a literal zero.
-        vzero = (probe.columns["probe_payload"][0] * 0).astype(jnp.int64)
-        total, overflow, consumed = lax.fori_loop(
-            0, ITERS, body,
-            (jnp.int64(0), jnp.bool_(False), vzero),
-        )
-        # One psum outside the timed loop (the per-rank carry already
-        # prevents DCE); psumming per iteration would bill ITERS extra
-        # collectives to the throughput number.
-        return total, overflow, comm.psum(consumed)
-
-    sharded_out = (True, True, True)  # every accumulator is replicated
-    fn = comm.spmd(looped, sharded_out=sharded_out)
-
-    # Warmup: compiles AND runs the full loop once.
-    total, overflow, _ = fn(build, probe)
-    total = int(total)
-    assert total > 0 and not bool(overflow), (total, bool(overflow))
-
-    t0 = time.perf_counter()
-    total, overflow, _ = fn(build, probe)
-    total = int(total)  # scalar fetch forces completion
-    elapsed = time.perf_counter() - t0
-    per_join = elapsed / ITERS
+    per_join, total, overflow = timed_join_throughput(
+        comm, step, build, probe, ITERS
+    )
+    assert total > 0 and not overflow, (total, overflow)
 
     rows_per_sec = (BUILD_NROWS + PROBE_NROWS) / per_join
     m_rows_per_chip = rows_per_sec / 1e6 / n_dev

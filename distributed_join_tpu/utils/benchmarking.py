@@ -1,0 +1,105 @@
+"""Shared benchmark timing discipline.
+
+This environment reaches the TPU through an RPC relay under which
+per-call ``jax.block_until_ready`` timing lies (it returns before the
+work finishes) and every scalar fetch costs a fixed ~0.1-1s round trip
+(.claude/skills/verify/SKILL.md). The honest protocol — also the right
+one on a directly-attached TPU — is:
+
+  1. chain K *dependent* iterations of the measured computation inside
+     ONE compiled program (``lax.fori_loop``), perturbing the inputs
+     with the loop counter so XLA can neither hoist loop-invariant work
+     nor dead-code-eliminate outputs;
+  2. run it once for warmup/compile;
+  3. time one more call, fetching a single scalar to force completion,
+     and divide by K.
+
+The reference times with ``MPI_Barrier`` + chrono around the measured
+region (SURVEY.md §5 "Tracing"); the fetch-one-scalar protocol is the
+same barrier discipline expressed in XLA terms.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def measure(fn: Callable, fetch: Callable, iters: int) -> float:
+    """Warm up ``fn`` (compiles + runs), then time it; returns seconds
+    per iteration. ``fetch(result)`` must force completion by pulling at
+    least one scalar to the host."""
+    fetch(fn())
+    t0 = time.perf_counter()
+    fetch(fn())
+    return (time.perf_counter() - t0) / iters
+
+
+def timed_join_throughput(
+    comm,
+    step: Callable,
+    build,
+    probe,
+    iters: int,
+    key: str = "key",
+    dce_payload: str = "probe_payload",
+):
+    """Time ``iters`` chained join steps; returns
+    ``(sec_per_join, total_matches_per_join, overflow)``.
+
+    The loop-variance tricks live here, in one place:
+    - both sides' key columns are shifted by the loop counter (the shift
+      preserves hit/miss structure — the generator's miss keys occupy a
+      disjoint range that shifts rigidly with the hits — but makes every
+      hash/sort/shuffle stage loop-variant so nothing hoists);
+    - an output payload column is reduced into the carry so the result
+      materialization cannot be dead-code-eliminated;
+    - the per-rank carry is initialized from sharded data (a literal
+      zero is unvarying in shard_map's vma tracking and is rejected as
+      a carry init for a varying accumulator);
+    - the DCE-guard psum happens once AFTER the loop so no collective
+      is billed to the throughput number beyond the join's own.
+    """
+    from distributed_join_tpu.table import Table
+
+    key_dtype = probe.columns[key].dtype
+
+    def looped(build, probe):
+        def body(i, acc):
+            shift = (
+                i if jnp.issubdtype(key_dtype, jnp.integer)
+                else lax.convert_element_type(i, key_dtype)
+            )
+            bcols = dict(build.columns)
+            bcols[key] = bcols[key] + shift
+            pcols = dict(probe.columns)
+            pcols[key] = pcols[key] + shift
+            res = step(Table(bcols, build.valid), Table(pcols, probe.valid))
+            out = res.table
+            consumed = jnp.sum(
+                jnp.where(out.valid, out.columns[dce_payload], 0)
+            ).astype(jnp.int64)
+            return (
+                acc[0] + res.total.astype(jnp.int64),
+                acc[1] | res.overflow,
+                acc[2] + consumed,
+            )
+
+        vzero = (probe.columns[dce_payload][0] * 0).astype(jnp.int64)
+        total, overflow, consumed = lax.fori_loop(
+            0, iters, body, (jnp.int64(0), jnp.bool_(False), vzero)
+        )
+        return total, overflow, comm.psum(consumed)
+
+    fn = comm.spmd(looped, sharded_out=(True, True, True))
+
+    state = {}
+
+    def fetch(res):
+        state["total"], state["overflow"] = int(res[0]), bool(res[1])
+
+    sec = measure(lambda: fn(build, probe), fetch, iters)
+    return sec, state["total"] // iters, state["overflow"]

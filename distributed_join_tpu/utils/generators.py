@@ -20,6 +20,24 @@ import jax.numpy as jnp
 from distributed_join_tpu.table import Table
 
 
+def _check_float_key_range(key_dtype, max_needed: int) -> None:
+    """Float keys must represent every integer in the generator's range
+    exactly, or the guaranteed-hit/guaranteed-miss contract (and
+    unique-keys mode) silently breaks past the mantissa — e.g. float32
+    folds 2**25-1 and 2**25 onto the same value, turning a guaranteed
+    miss into a spurious match."""
+    if jnp.issubdtype(key_dtype, jnp.floating):
+        exact = 1 << jnp.finfo(key_dtype).nmant
+        if max_needed > exact:
+            raise ValueError(
+                f"key range needs integers up to {max_needed}, beyond "
+                f"{jnp.dtype(key_dtype).name}'s exact-integer range "
+                f"(2**{jnp.finfo(key_dtype).nmant}); generated keys would "
+                "collide and break hit/miss guarantees — use a smaller "
+                "rand_max or an integer/wider key dtype"
+            )
+
+
 def generate_build_table(
     key: jax.Array,
     nrows: int,
@@ -34,12 +52,18 @@ def generate_build_table(
     simply i (requires nrows <= rand_max), matching the reference's
     unique-build-keys mode where every build key appears once.
     """
+    _check_float_key_range(key_dtype, rand_max)
     if unique_keys:
         if nrows > rand_max:
             raise ValueError("unique keys need nrows <= rand_max")
-        keys = jnp.arange(nrows, dtype=key_dtype)
+        keys = jnp.arange(nrows, dtype=jnp.int64).astype(key_dtype)
     else:
-        keys = jax.random.randint(key, (nrows,), 0, rand_max, dtype=key_dtype)
+        # Draw as int64 then cast: supports float key dtypes (exact for
+        # rand_max within the mantissa), matching the reference's
+        # templated key types (SURVEY.md §2 "Table generator").
+        keys = jax.random.randint(
+            key, (nrows,), 0, rand_max, dtype=jnp.int64
+        ).astype(key_dtype)
     payload = jnp.arange(nrows, dtype=payload_dtype)
     return Table.from_dense({"key": keys, "build_payload": payload})
 
@@ -55,12 +79,13 @@ def generate_probe_table(
 ) -> Table:
     """Probe side: with prob ``selectivity`` a random build key (match
     guaranteed), else a key in [rand_max, 2*rand_max) (miss guaranteed)."""
+    _check_float_key_range(key_dtype, 2 * rand_max)
     k_sel, k_pick, k_miss = jax.random.split(key, 3)
     pick = jax.random.randint(k_pick, (nrows,), 0, build_keys.shape[0])
     hit_keys = build_keys[pick]
     miss_keys = jax.random.randint(
-        k_miss, (nrows,), rand_max, 2 * rand_max, dtype=key_dtype
-    )
+        k_miss, (nrows,), rand_max, 2 * rand_max, dtype=jnp.int64
+    ).astype(key_dtype)
     is_hit = jax.random.uniform(k_sel, (nrows,)) < selectivity
     keys = jnp.where(is_hit, hit_keys, miss_keys).astype(key_dtype)
     payload = jnp.arange(nrows, dtype=payload_dtype)

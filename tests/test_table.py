@@ -43,3 +43,14 @@ def test_to_pandas_filters_padding():
     t = Table({"a": jnp.array([1, 2, 3])}, jnp.array([True, False, True]))
     df = t.to_pandas()
     assert df["a"].tolist() == [1, 3]
+
+
+def test_float_key_range_guard():
+    import pytest
+    from distributed_join_tpu.utils.generators import generate_build_probe_tables
+
+    with pytest.raises(ValueError, match="exact-integer range"):
+        generate_build_probe_tables(
+            seed=0, build_nrows=64, probe_nrows=64,
+            rand_max=1 << 25, key_dtype="float32",
+        )
