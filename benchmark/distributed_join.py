@@ -36,7 +36,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from distributed_join_tpu.parallel.communicator import make_communicator
 from distributed_join_tpu.parallel.distributed_join import make_join_step
 from distributed_join_tpu.utils.benchmarking import timed_join_throughput
-from distributed_join_tpu.utils.generators import generate_build_probe_tables
+from distributed_join_tpu.utils.generators import (
+    generate_build_probe_tables,
+    generate_build_table,
+    generate_zipf_probe_table,
+)
 
 DTYPES = {
     "int32": jnp.int32,
@@ -75,6 +79,15 @@ def parse_args(argv=None):
                    help="timed join steps chained in one compiled loop")
     p.add_argument("--shuffle-capacity-factor", type=float, default=1.6)
     p.add_argument("--out-capacity-factor", type=float, default=1.2)
+    p.add_argument("--zipf-alpha", type=float, default=None,
+                   help="draw probe keys Zipf(alpha) instead of the "
+                        "generator's hit/miss mix (BASELINE config 3)")
+    p.add_argument("--skew-threshold", type=float, default=None,
+                   help="enable heavy-hitter handling: a key is heavy "
+                        "when its global probe count exceeds this "
+                        "fraction of one rank's probe rows")
+    p.add_argument("--hh-slots", type=int, default=64,
+                   help="static heavy-hitter key slots")
     p.add_argument("--json-output", default=None,
                    help="also write the result record to this file")
     return p.parse_args(argv)
@@ -96,16 +109,30 @@ def run(args) -> dict:
     if b_rows % n or p_rows % n:
         raise SystemExit(f"table nrows must be divisible by n_ranks={n}")
 
-    build, probe = generate_build_probe_tables(
-        seed=42,
-        build_nrows=b_rows,
-        probe_nrows=p_rows,
-        rand_max=args.rand_max,
-        selectivity=args.selectivity,
-        key_dtype=key_dtype,
-        payload_dtype=payload_dtype,
-        unique_build_keys=not args.duplicate_build_keys,
-    )
+    if args.zipf_alpha is not None:
+        # Build the sides separately — generating the uniform probe
+        # table only to discard it would waste GBs at 100M rows.
+        build = generate_build_table(
+            jax.random.PRNGKey(42), b_rows, args.rand_max or b_rows,
+            key_dtype=key_dtype, payload_dtype=payload_dtype,
+            unique_keys=not args.duplicate_build_keys,
+        )
+        probe = generate_zipf_probe_table(
+            jax.random.PRNGKey(43), p_rows, args.zipf_alpha,
+            args.rand_max or b_rows,
+            key_dtype=key_dtype, payload_dtype=payload_dtype,
+        )
+    else:
+        build, probe = generate_build_probe_tables(
+            seed=42,
+            build_nrows=b_rows,
+            probe_nrows=p_rows,
+            rand_max=args.rand_max,
+            selectivity=args.selectivity,
+            key_dtype=key_dtype,
+            payload_dtype=payload_dtype,
+            unique_build_keys=not args.duplicate_build_keys,
+        )
     build, probe = comm.device_put_sharded((build, probe))
     jax.block_until_ready((build, probe))
 
@@ -115,6 +142,8 @@ def run(args) -> dict:
         over_decomposition=args.over_decomposition_factor,
         shuffle_capacity_factor=args.shuffle_capacity_factor,
         out_capacity_factor=args.out_capacity_factor,
+        skew_threshold=args.skew_threshold,
+        hh_slots=args.hh_slots,
     )
     iters = args.iterations
 
@@ -134,6 +163,8 @@ def run(args) -> dict:
         "probe_table_nrows": p_rows,
         "selectivity": args.selectivity,
         "over_decomposition_factor": args.over_decomposition_factor,
+        "zipf_alpha": args.zipf_alpha,
+        "skew_threshold": args.skew_threshold,
         "matches_per_join": matches,
         "overflow": overflow,
         "elapsed_per_join_s": sec_per_join,

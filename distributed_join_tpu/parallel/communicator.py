@@ -62,6 +62,13 @@ class Communicator(abc.ABC):
         Must be called inside :meth:`spmd`. Shape-preserving."""
 
     @abc.abstractmethod
+    def all_gather(self, x: jax.Array) -> jax.Array:
+        """Concatenate every rank's ``x`` along axis 0 (rank order),
+        replicated to all ranks. Must be called inside :meth:`spmd`.
+        The skew path broadcasts heavy-hitter build rows with this —
+        the TPU analog of the reference replicating hot partitions."""
+
+    @abc.abstractmethod
     def spmd(self, fn: Callable, *, sharded_out=None) -> Callable:
         """Compile ``fn`` to run SPMD, one instance per rank.
 
@@ -101,6 +108,9 @@ class TpuCommunicator(Communicator):
             x, self.axis_name, split_axis=0, concat_axis=0, tiled=True
         )
 
+    def all_gather(self, x: jax.Array) -> jax.Array:
+        return lax.all_gather(x, self.axis_name, axis=0, tiled=True)
+
     def psum(self, x):
         return lax.psum(x, self.axis_name)
 
@@ -135,6 +145,9 @@ class LocalCommunicator(Communicator):
         return 1
 
     def all_to_all(self, x: jax.Array) -> jax.Array:
+        return x
+
+    def all_gather(self, x: jax.Array) -> jax.Array:
         return x
 
     def spmd(self, fn: Callable, *, sharded_out=None) -> Callable:

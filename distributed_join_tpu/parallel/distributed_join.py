@@ -37,6 +37,10 @@ from distributed_join_tpu.parallel.shuffle import shuffle_padded
 from distributed_join_tpu.table import Table
 
 
+DEFAULT_SHUFFLE_CAPACITY_FACTOR = 1.6
+DEFAULT_OUT_CAPACITY_FACTOR = 1.2
+
+
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
@@ -53,11 +57,15 @@ def make_join_step(
     comm: Communicator,
     key: str = "key",
     over_decomposition: int = 1,
-    shuffle_capacity_factor: float = 1.6,
-    out_capacity_factor: float = 1.2,
+    shuffle_capacity_factor: float = DEFAULT_SHUFFLE_CAPACITY_FACTOR,
+    out_capacity_factor: float = DEFAULT_OUT_CAPACITY_FACTOR,
     out_rows_per_rank: Optional[int] = None,
     build_payload: Optional[Sequence[str]] = None,
     probe_payload: Optional[Sequence[str]] = None,
+    skew_threshold: Optional[float] = None,
+    hh_slots: int = 64,
+    hh_build_capacity: Optional[int] = None,
+    hh_out_capacity: Optional[int] = None,
 ):
     """The raw per-rank join step (partition -> shuffle -> local join).
 
@@ -75,6 +83,14 @@ def make_join_step(
       out_capacity_factor (or out_rows_per_rank / k if given).
     Overflow of either capacity is reported, never silently dropped
     rows presented as success.
+
+    Skew handling (BASELINE config 3; :mod:`..parallel.skew`): pass
+    ``skew_threshold`` — a key becomes a heavy hitter when its global
+    probe count exceeds ``skew_threshold * local_probe_rows``. HH probe
+    rows skip the shuffle and stay local; HH build rows are broadcast
+    (``hh_build_capacity`` slots per rank, default ``hh_slots * 32``)
+    and joined locally into an extra output block of
+    ``hh_out_capacity`` rows (default local probe rows).
     """
     n = comm.n_ranks
     k = over_decomposition
@@ -100,12 +116,44 @@ def make_join_step(
                 int(math.ceil(p_rows / k * out_capacity_factor)), 8
             )
 
-        ptb = radix_hash_partition(build_local, [key], nb)
-        ptp = radix_hash_partition(probe_local, [key], nb)
-
         parts = []
         total = jnp.int64(0)
         overflow = jnp.bool_(False)
+
+        if skew_threshold is not None:
+            from distributed_join_tpu.parallel import skew
+
+            hh = skew.global_heavy_hitters(
+                comm,
+                probe_local.columns[key],
+                probe_local.valid,
+                hh_slots,
+                threshold=jnp.int32(int(skew_threshold * p_rows)),
+            )
+            is_hh_b = skew.mark_heavy(build_local.columns[key], hh)
+            is_hh_p = skew.mark_heavy(probe_local.columns[key], hh)
+            hh_build, ovf_hb = skew.broadcast_heavy_build(
+                comm, build_local, is_hh_b,
+                hh_build_capacity or hh_slots * 32,
+            )
+            # HH probe rows stay local: same arrays, narrowed validity.
+            hh_probe = Table(probe_local.columns, probe_local.valid & is_hh_p)
+            hh_res = sort_merge_inner_join(
+                hh_build, hh_probe, key,
+                hh_out_capacity or p_rows,
+                build_payload=build_payload, probe_payload=probe_payload,
+            )
+            parts.append(hh_res.table)
+            total = total + hh_res.total.astype(jnp.int64)
+            overflow = overflow | ovf_hb | hh_res.overflow
+            # The normal path sees neither side's HH rows.
+            build_local = Table(build_local.columns,
+                                build_local.valid & ~is_hh_b)
+            probe_local = Table(probe_local.columns,
+                                probe_local.valid & ~is_hh_p)
+
+        ptb = radix_hash_partition(build_local, [key], nb)
+        ptp = radix_hash_partition(probe_local, [key], nb)
         for b in range(k):
             recv_build, ovf_b = _batch_shuffle(comm, ptb, b, n, b_cap)
             recv_probe, ovf_p = _batch_shuffle(comm, ptp, b, n, p_cap)
@@ -148,11 +196,19 @@ def distributed_inner_join(
     probe: Table,
     comm: Communicator,
     key: str = "key",
+    auto_retry: int = 0,
     **opts,
 ) -> JoinResult:
     """One-shot convenience: pad to rank-divisible capacity, shard the
     inputs over the mesh, compile and run. For benchmarking, build the
-    function once with :func:`make_distributed_join` instead."""
+    function once with :func:`make_distributed_join` instead.
+
+    ``auto_retry``: on overflow (a static capacity too small for the
+    data), recompile with doubled shuffle/output capacity factors up to
+    this many times. The reference sizes receive buffers exactly and
+    can't overflow (SURVEY.md §2); static shapes can, so they get an
+    escape hatch instead of a wrong answer.
+    """
     n = comm.n_ranks
 
     def pad_div(t: Table) -> Table:
@@ -171,5 +227,33 @@ def distributed_inner_join(
     build, probe = pad_div(build), pad_div(probe)
     if hasattr(comm, "device_put_sharded"):
         build, probe = comm.device_put_sharded((build, probe))
-    fn = make_distributed_join(comm, key=key, **opts)
-    return fn(build, probe)
+
+    shuffle_f = opts.pop("shuffle_capacity_factor",
+                         DEFAULT_SHUFFLE_CAPACITY_FACTOR)
+    out_f = opts.pop("out_capacity_factor", DEFAULT_OUT_CAPACITY_FACTOR)
+    # Resolve the HH capacities here so retries can double them too —
+    # overflow can originate in the skew path as well as the shuffle.
+    skew_on = opts.get("skew_threshold") is not None
+    hh_build_cap = opts.pop("hh_build_capacity", None)
+    hh_out_cap = opts.pop("hh_out_capacity", None)
+    if skew_on:
+        hh_build_cap = hh_build_cap or opts.get("hh_slots", 64) * 32
+        hh_out_cap = hh_out_cap or probe.capacity // n
+    for attempt in range(auto_retry + 1):
+        fn = make_distributed_join(
+            comm, key=key,
+            shuffle_capacity_factor=shuffle_f,
+            out_capacity_factor=out_f,
+            hh_build_capacity=hh_build_cap,
+            hh_out_capacity=hh_out_cap,
+            **opts,
+        )
+        res = fn(build, probe)
+        if attempt == auto_retry or not bool(res.overflow):
+            return res
+        shuffle_f *= 2.0
+        out_f *= 2.0
+        if skew_on:
+            hh_build_cap *= 2
+            hh_out_cap *= 2
+    raise AssertionError("unreachable")

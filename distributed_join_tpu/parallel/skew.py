@@ -1,0 +1,157 @@
+"""Heavy-hitter (skew) handling — BASELINE config 3.
+
+Hash partitioning routes every row of one key to one rank, so a
+Zipf-skewed key column (alpha=1.5 concentrates a large fraction of all
+rows on a handful of keys) both overloads one rank and — under this
+framework's static-shape padded shuffle — forces every (rank, bucket)
+pad up to the hottest bucket's size (SURVEY.md §7 hard part #2). The
+reference has no skew machinery (its exact-size buffers merely survive
+skew without balancing it); this framework does better with the classic
+PRPD scheme (partial redistribution / partial duplication), designed
+here for static shapes:
+
+  1. detect heavy-hitter keys on device: each rank counts its probe-side
+     key runs (one sort + searchsorted), takes its local top-K, and the
+     K-slot candidate lists are all-gathered and aggregated; keys whose
+     (approximate) global count exceeds ``threshold`` become the
+     replicated HH set — a fixed K-slot array, identically computed on
+     every rank;
+  2. probe rows with HH keys are EXCLUDED from the shuffle and stay on
+     their generating rank — they are balanced by construction (the
+     generator hashes nothing), and the hot bucket disappears from the
+     padded all-to-all;
+  3. build rows with HH keys are broadcast (all-gather of a fixed-slot
+     block) to every rank — the build side of a hot key is small (unique
+     or low-multiplicity build keys), so duplication is cheap;
+  4. each rank joins its local HH probe rows against the replicated HH
+     build block; results concatenate with the normal path's.
+
+Every row of a key takes exactly one path (the HH set is replicated and
+consistent), so no match is lost or duplicated. Detection is
+approximate — a key spread thin below every rank's local top-K can be
+missed — but classification consistency, not accuracy, is what
+correctness needs; a missed moderately-hot key just pays normal-path
+padding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_join_tpu.ops.join import _dtype_sentinel_max
+from distributed_join_tpu.parallel.communicator import Communicator
+from distributed_join_tpu.table import Table
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class HeavyHitters:
+    """A fixed-K replicated set of heavy keys. Invalid slots hold the
+    key dtype's max sentinel and are masked by ``slot_valid``."""
+
+    keys: jax.Array        # (K,) key dtype
+    counts: jax.Array      # (K,) int32 approximate global counts
+    slot_valid: jax.Array  # (K,) bool
+
+
+def local_top_keys(keys: jax.Array, valid: jax.Array, k: int):
+    """Per-shard top-``k`` keys by frequency: (keys, counts), padded
+    slots carrying count 0. One sort + two searchsorted. Always returns
+    ``k`` slots even when the shard has fewer rows (extra slots pad)."""
+    n = keys.shape[0]
+    k_eff = min(k, n)  # lax.top_k rejects k > array length
+    order = jnp.lexsort((keys, ~valid))
+    sk = keys[order]
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    sentinel = _dtype_sentinel_max(keys.dtype)
+    iota = jnp.arange(n)
+    sk = jnp.where(iota < n_valid, sk, sentinel)
+    lo = jnp.searchsorted(sk, sk, side="left", method="sort")
+    hi = jnp.searchsorted(sk, sk, side="right", method="sort")
+    hi = jnp.minimum(hi, n_valid)
+    run = (hi - lo).astype(jnp.int32)
+    # Score only the first position of each run so a key appears once.
+    is_first = iota == lo
+    score = jnp.where(is_first & (iota < n_valid), run, 0)
+    top_counts, top_idx = lax.top_k(score, k_eff)
+    top_keys = jnp.where(top_counts > 0, sk[top_idx], sentinel)
+    if k_eff < k:
+        pad = k - k_eff
+        top_keys = jnp.concatenate(
+            [top_keys, jnp.full((pad,), sentinel, dtype=top_keys.dtype)]
+        )
+        top_counts = jnp.concatenate(
+            [top_counts, jnp.zeros((pad,), dtype=top_counts.dtype)]
+        )
+    return top_keys, top_counts
+
+
+def global_heavy_hitters(
+    comm: Communicator,
+    keys: jax.Array,
+    valid: jax.Array,
+    k: int,
+    threshold,
+) -> HeavyHitters:
+    """Replicated global top-``k`` keys with aggregated count >
+    ``threshold`` (a traced or static int). Aggregation is exact over
+    the union of per-rank candidate lists (a key missing from some
+    rank's list undercounts — see module docstring)."""
+    lk, lc = local_top_keys(keys, valid, k)
+    gk = comm.all_gather(lk)                      # (n*k,)
+    gc = comm.all_gather(lc)                      # (n*k,) int32
+    nk = gk.shape[0]
+    sentinel = _dtype_sentinel_max(keys.dtype)
+    eq = gk[:, None] == gk[None, :]
+    tot = jnp.sum(jnp.where(eq, gc[None, :], 0), axis=1).astype(jnp.int32)
+    iota = jnp.arange(nk)
+    dup = jnp.any(eq & (iota[None, :] < iota[:, None]), axis=1)
+    real = gk != sentinel
+    score = jnp.where(real & ~dup, tot, 0)
+    top_counts, top_idx = lax.top_k(score, k)
+    slot_valid = top_counts > threshold
+    hh_keys = jnp.where(slot_valid, gk[top_idx], sentinel)
+    return HeavyHitters(hh_keys, top_counts, slot_valid)
+
+
+def mark_heavy(keys: jax.Array, hh: HeavyHitters) -> jax.Array:
+    """Row-wise bool: key is in the HH set. K elementwise passes — no
+    (rows, K) materialization (which would be GBs at 10M rows)."""
+
+    def body(j, acc):
+        hk = lax.dynamic_index_in_dim(hh.keys, j, keepdims=False)
+        hv = lax.dynamic_index_in_dim(hh.slot_valid, j, keepdims=False)
+        return acc | ((keys == hk) & hv)
+
+    # Init derived from `keys` (all-False, same shape) so the carry is
+    # rank-varying under shard_map's vma tracking, like the body output.
+    return lax.fori_loop(0, hh.keys.shape[0], body, keys != keys)
+
+
+def extract_prefix(table: Table, sel: jax.Array, capacity: int):
+    """Stable-compact rows where ``sel`` into a static-capacity Table;
+    returns (extracted, count, overflow). One small sort. ``capacity``
+    may exceed the table's row count (extra slots are padding)."""
+    order = jnp.argsort(~sel, stable=True)
+    count = jnp.sum(sel.astype(jnp.int32))
+    n = order.shape[0]
+    lane = jnp.arange(capacity, dtype=jnp.int32)
+    idx = order[jnp.minimum(lane, n - 1)]
+    cols = {name: c[idx] for name, c in table.columns.items()}
+    valid = (lane < jnp.minimum(count, capacity)) & (lane < n)
+    return Table(cols, valid), count, count > capacity
+
+
+def broadcast_heavy_build(
+    comm: Communicator, build: Table, is_hh: jax.Array, capacity: int
+):
+    """All-gather each rank's HH build rows (fixed ``capacity`` slots)
+    into one replicated Table of n_ranks*capacity rows."""
+    local, count, overflow = extract_prefix(build, is_hh & build.valid, capacity)
+    cols = {n: comm.all_gather(c) for n, c in local.columns.items()}
+    valid = comm.all_gather(local.valid)
+    return Table(cols, valid), comm.psum(overflow.astype(jnp.int32)) > 0

@@ -61,6 +61,13 @@ class Table:
                 raise ValueError(f"column {name!r} must be 1-D, got {shape}")
         if len({s[0] for s in lengths.values()}) != 1:
             raise ValueError(f"columns must share a length, got {lengths}")
+        if hasattr(self.valid, "shape") and (
+            self.valid.shape != next(iter(lengths.values()))
+        ):
+            raise ValueError(
+                f"valid mask shape {self.valid.shape} != column length "
+                f"{next(iter(lengths.values()))}"
+            )
 
     # -- constructors -------------------------------------------------
 
