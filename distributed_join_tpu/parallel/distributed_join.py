@@ -39,6 +39,8 @@ from distributed_join_tpu.table import Table
 
 DEFAULT_SHUFFLE_CAPACITY_FACTOR = 1.6
 DEFAULT_OUT_CAPACITY_FACTOR = 1.2
+DEFAULT_HH_SLOTS = 64
+HH_BUILD_SLOTS_PER_HH = 32  # default hh_build_capacity = slots * this
 
 
 def _round_up(x: int, m: int) -> int:
@@ -63,7 +65,7 @@ def make_join_step(
     build_payload: Optional[Sequence[str]] = None,
     probe_payload: Optional[Sequence[str]] = None,
     skew_threshold: Optional[float] = None,
-    hh_slots: int = 64,
+    hh_slots: int = DEFAULT_HH_SLOTS,
     hh_build_capacity: Optional[int] = None,
     hh_out_capacity: Optional[int] = None,
 ):
@@ -134,7 +136,7 @@ def make_join_step(
             is_hh_p = skew.mark_heavy(probe_local.columns[key], hh)
             hh_build, ovf_hb = skew.broadcast_heavy_build(
                 comm, build_local, is_hh_b,
-                hh_build_capacity or hh_slots * 32,
+                hh_build_capacity or hh_slots * HH_BUILD_SLOTS_PER_HH,
             )
             # HH probe rows stay local: same arrays, narrowed validity.
             hh_probe = Table(probe_local.columns, probe_local.valid & is_hh_p)
@@ -237,13 +239,17 @@ def distributed_inner_join(
     hh_build_cap = opts.pop("hh_build_capacity", None)
     hh_out_cap = opts.pop("hh_out_capacity", None)
     if skew_on:
-        hh_build_cap = hh_build_cap or opts.get("hh_slots", 64) * 32
+        hh_build_cap = hh_build_cap or (
+            opts.get("hh_slots", DEFAULT_HH_SLOTS) * HH_BUILD_SLOTS_PER_HH
+        )
         hh_out_cap = hh_out_cap or probe.capacity // n
+    out_rows = opts.pop("out_rows_per_rank", None)
     for attempt in range(auto_retry + 1):
         fn = make_distributed_join(
             comm, key=key,
             shuffle_capacity_factor=shuffle_f,
             out_capacity_factor=out_f,
+            out_rows_per_rank=out_rows,
             hh_build_capacity=hh_build_cap,
             hh_out_capacity=hh_out_cap,
             **opts,
@@ -251,8 +257,12 @@ def distributed_inner_join(
         res = fn(build, probe)
         if attempt == auto_retry or not bool(res.overflow):
             return res
+        # Double every capacity a retry can relieve — out_rows_per_rank
+        # supersedes out_capacity_factor when set, so it must scale too.
         shuffle_f *= 2.0
         out_f *= 2.0
+        if out_rows is not None:
+            out_rows *= 2
         if skew_on:
             hh_build_cap *= 2
             hh_out_cap *= 2

@@ -1,0 +1,113 @@
+"""Out-of-core key-range batching — tables bigger than HBM.
+
+The reference's over-decomposition has a second job beyond pipelining:
+only ``1/k`` of the *shuffled* data is resident at once (SURVEY.md §5
+"Long-context"). But its inputs still live wholly in device memory, and
+so do ours inside one compiled step. For tables that exceed HBM
+entirely (TPC-H SF-100 lineitem is ~600M rows), this module batches the
+*key space* on the host: rows are split into ``n_batches`` by key hash
+(the same Murmur3 finalizer the device kernels use — numpy mirror
+below), and each co-partitioned batch pair runs through the compiled
+distributed join independently. Matching keys share a hash, hence a
+batch, so batch joins are independent and their totals sum.
+
+This is the framework's answer to the reference's "tables larger than
+per-chip HBM" axis; the host loop costs one H2D transfer per batch,
+which a real deployment would overlap with compute via double-buffered
+``jax.device_put`` (left for the profiling round).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from distributed_join_tpu.parallel.communicator import Communicator
+from distributed_join_tpu.table import Table
+
+
+def fmix64_np(x: np.ndarray) -> np.ndarray:
+    """numpy mirror of ops.hashing.fmix64 (same constants) so host-side
+    batching agrees with device-side bucket routing."""
+    k = x.astype(np.uint64)
+    k ^= k >> np.uint64(33)
+    k *= np.uint64(0xFF51AFD7ED558CCD)
+    k ^= k >> np.uint64(33)
+    k *= np.uint64(0xC4CEB9FE1A85EC53)
+    k ^= k >> np.uint64(33)
+    return k
+
+
+def key_batch_ids(keys: np.ndarray, n_batches: int) -> np.ndarray:
+    """Batch id per row. Uses the UPPER hash bits so batching composes
+    with the device kernels' ``hash % n_buckets`` routing (lower bits):
+    the two partitions stay independent, and every key pair that joins
+    lands in the same batch on both sides."""
+    h = fmix64_np(keys)
+    return ((h >> np.uint64(40)) % np.uint64(n_batches)).astype(np.int64)
+
+
+def _host_columns(table: Table) -> dict:
+    mask = np.asarray(table.valid)
+    return {n: np.asarray(c)[mask] for n, c in table.columns.items()}
+
+
+def keyrange_batched_join(
+    build: Table,
+    probe: Table,
+    comm: Communicator,
+    key: str = "key",
+    n_batches: int = 4,
+    on_batch_result: Optional[Callable] = None,
+    **join_opts,
+) -> Tuple[int, bool]:
+    """Join arbitrarily large host-resident tables in ``n_batches``
+    device-sized pieces; returns (total_matches, any_overflow).
+
+    ``on_batch_result(batch_index, JoinResult)`` can materialize or
+    reduce each batch's output before the next batch replaces it.
+    """
+    from distributed_join_tpu.parallel.distributed_join import (
+        make_distributed_join,
+    )
+
+    hb, hp = _host_columns(build), _host_columns(probe)
+    bb = key_batch_ids(hb[key], n_batches)
+    pb = key_batch_ids(hp[key], n_batches)
+
+    # One static capacity across batches (max batch size, rank-padded)
+    # so the join compiles ONCE; per-batch recompiles at 30-100s each
+    # would dwarf the work on a remote-compile TPU.
+    n = comm.n_ranks
+
+    def _cap(ids):
+        c = int(np.bincount(ids, minlength=n_batches).max())
+        return -(-c // n) * n  # round up to a rank multiple
+
+    bcap, pcap = _cap(bb), _cap(pb)
+
+    def _pad(cols: dict, sel: np.ndarray, cap: int) -> Table:
+        m = int(sel.sum())
+        out = {}
+        for name, c in cols.items():
+            buf = np.zeros((cap,), dtype=c.dtype)
+            buf[:m] = c[sel]
+            out[name] = jnp.asarray(buf)
+        return Table.from_prefix(out, m)
+
+    fn = make_distributed_join(comm, key=key, **join_opts)
+    total = 0
+    overflow = False
+    for b in range(n_batches):
+        bt = _pad(hb, bb == b, bcap)
+        pt = _pad(hp, pb == b, pcap)
+        bt, pt = comm.device_put_sharded((bt, pt))
+        res = fn(bt, pt)
+        total += int(res.total)
+        overflow |= bool(res.overflow)
+        if on_batch_result is not None:
+            on_batch_result(b, res)
+    return total, overflow

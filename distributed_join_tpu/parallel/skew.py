@@ -107,7 +107,12 @@ def global_heavy_hitters(
     nk = gk.shape[0]
     sentinel = _dtype_sentinel_max(keys.dtype)
     eq = gk[:, None] == gk[None, :]
-    tot = jnp.sum(jnp.where(eq, gc[None, :], 0), axis=1).astype(jnp.int32)
+    # int64 accumulation: per-rank counts fit int32, but a globally hot
+    # key summed over many ranks can pass 2^31 — an int32 wrap here
+    # would silently demote the hottest key to the normal path.
+    tot = jnp.sum(
+        jnp.where(eq, gc[None, :].astype(jnp.int64), 0), axis=1
+    )
     iota = jnp.arange(nk)
     dup = jnp.any(eq & (iota[None, :] < iota[:, None]), axis=1)
     real = gk != sentinel

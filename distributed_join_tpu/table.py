@@ -89,6 +89,13 @@ class Table:
     def select(self, names) -> "Table":
         return Table({n: self.columns[n] for n in names}, self.valid)
 
+    def rename(self, mapping: Mapping[str, str]) -> "Table":
+        """Rename columns (unlisted names pass through)."""
+        return Table(
+            {mapping.get(n, n): c for n, c in self.columns.items()},
+            self.valid,
+        )
+
     def gather(self, idx: jax.Array, idx_valid: jax.Array) -> "Table":
         """Rows at ``idx`` where ``idx_valid``; out-of-range idx clamped."""
         cap = self.capacity

@@ -88,7 +88,10 @@ def timed_join_throughput(
                 acc[2] + consumed,
             )
 
-        vzero = (probe.columns[dce_payload][0] * 0).astype(jnp.int64)
+        # Any probe column works for the varying all-zero init;
+        # dce_payload itself may be a build-side column.
+        first_col = next(iter(probe.columns.values()))
+        vzero = (first_col[0] * 0).astype(jnp.int64)
         total, overflow, consumed = lax.fori_loop(
             0, iters, body, (jnp.int64(0), jnp.bool_(False), vzero)
         )

@@ -1,0 +1,130 @@
+"""TPC-H-flavored table generators — BASELINE config 4.
+
+The reference benchmarks TPC-H SF-100 ``lineitem ⋈ orders`` (Q3 join
+pattern) but ships only a synthetic uniform generator (SURVEY.md §2
+"Table generator"); the TPC-H tables come from dbgen externally. This
+module generates the two join-relevant tables on device with dbgen's
+join-structure semantics so the benchmark is self-contained:
+
+- ``orders``: SF * 1.5M rows. Order keys are *sparse* exactly like
+  dbgen's (8 keys used out of every 32-key block), so key-space tricks
+  that assume dense keys are kept honest. ``o_orderdate`` is uniform
+  over the 1992-01-01..1998-08-02 window (days since epoch start,
+  int32), ``o_totalprice`` a scaled int.
+- ``lineitem``: 1..7 lines per order, uniform (dbgen's distribution;
+  expectation 4 -> SF * ~6M rows). ``l_shipdate`` = order date + 1..121
+  days; ``l_quantity`` 1..50; ``l_extendedprice`` scaled int;
+  ``l_discount`` percent 0..10 (int).
+
+Row counts are data-dependent (sum of per-order line counts), which XLA
+cannot express statically — the *generator* (one-time, outside the
+measured region) resolves the total on the host and materializes with a
+static ``total_repeat_length``, mirroring how the reference's generator
+runs device-side but sizes its outputs before the timed join.
+
+Simplifications vs real dbgen, documented for honesty: text/enum
+columns (comments, priorities, clerk ids) are omitted — they don't
+affect join structure; prices are independent uniform ints rather than
+part-price-derived; no customer table yet (Q3's customer leg is the
+segment filter, stubbed as a row mask).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from distributed_join_tpu.table import Table
+
+ORDERS_PER_SF = 1_500_000
+DATE_RANGE_DAYS = 2406       # 1992-01-01 .. 1998-08-02
+MAX_SHIP_LAG_DAYS = 121
+MAX_LINES_PER_ORDER = 7
+
+
+def sparse_order_keys(n_orders: int) -> jax.Array:
+    """dbgen's sparse key encoding: the i-th order (0-based) gets key
+    ``(i // 8) * 32 + (i % 8) + 1`` — 8 keys per 32-block, so only a
+    quarter of the key space is populated."""
+    i = jnp.arange(n_orders, dtype=jnp.int64)
+    return (i // 8) * 32 + (i % 8) + 1
+
+
+def generate_orders(key: jax.Array, scale_factor: float) -> Table:
+    n = int(ORDERS_PER_SF * scale_factor)
+    k_date, k_price = jax.random.split(key)
+    orderkey = sparse_order_keys(n)
+    orderdate = jax.random.randint(
+        k_date, (n,), 0, DATE_RANGE_DAYS, dtype=jnp.int32
+    )
+    totalprice = jax.random.randint(
+        k_price, (n,), 90_000, 55_550_000, dtype=jnp.int64
+    )  # cents
+    return Table.from_dense({
+        "o_orderkey": orderkey,
+        "o_orderdate": orderdate,
+        "o_totalprice": totalprice,
+    })
+
+
+def generate_lineitem(
+    key: jax.Array, scale_factor: float, orders: Table
+) -> Table:
+    """Lines per order ~ Uniform{1..7}; ship date trails the order date
+    by 1..121 days. The total row count is resolved on host (generator
+    only — the join itself never does this)."""
+    n_orders = orders.capacity
+    k_cnt, k_ship, k_qty, k_price, k_disc = jax.random.split(key, 5)
+    counts = jax.random.randint(
+        k_cnt, (n_orders,), 1, MAX_LINES_PER_ORDER + 1, dtype=jnp.int32
+    )
+    total = int(jnp.sum(counts))  # host sync: generator-time only
+
+    orderkey = jnp.repeat(
+        orders.columns["o_orderkey"], counts, total_repeat_length=total
+    )
+    orderdate = jnp.repeat(
+        orders.columns["o_orderdate"], counts, total_repeat_length=total
+    )
+    shipdate = orderdate + jax.random.randint(
+        k_ship, (total,), 1, MAX_SHIP_LAG_DAYS + 1, dtype=jnp.int32
+    )
+    quantity = jax.random.randint(k_qty, (total,), 1, 51, dtype=jnp.int32)
+    extendedprice = jax.random.randint(
+        k_price, (total,), 90_000, 10_500_000, dtype=jnp.int64
+    )  # cents
+    discount = jax.random.randint(k_disc, (total,), 0, 11, dtype=jnp.int32)
+    return Table.from_dense({
+        "l_orderkey": orderkey,
+        "l_shipdate": shipdate,
+        "l_quantity": quantity,
+        "l_extendedprice": extendedprice,
+        "l_discount": discount,
+    })
+
+
+def generate_tpch_join_tables(
+    seed: int, scale_factor: float
+) -> Tuple[Table, Table]:
+    """(orders, lineitem) for the config-4 join. orders is the build
+    side (smaller), lineitem the probe side, matching the reference's
+    build-on-smaller convention (SURVEY.md §2 'Local join step')."""
+    ko, kl = jax.random.split(jax.random.PRNGKey(seed))
+    orders = generate_orders(ko, scale_factor)
+    lineitem = generate_lineitem(kl, scale_factor, orders)
+    return orders, lineitem
+
+
+def q3_filter(
+    orders: Table, lineitem: Table, cutoff_day: int = DATE_RANGE_DAYS // 2
+) -> Tuple[Table, Table]:
+    """Q3's date predicates as validity masks (static shapes):
+    ``o_orderdate < cutoff`` and ``l_shipdate > cutoff``. The customer
+    market-segment leg is out of scope until a customer table exists."""
+    o = Table(orders.columns,
+              orders.valid & (orders.columns["o_orderdate"] < cutoff_day))
+    l = Table(lineitem.columns,
+              lineitem.valid & (lineitem.columns["l_shipdate"] > cutoff_day))
+    return o, l

@@ -1,0 +1,119 @@
+"""TPC-H generator semantics, config-4 join vs pandas oracle, and the
+out-of-core key-range batched path."""
+
+import jax
+import numpy as np
+import pytest
+
+import distributed_join_tpu as dj
+from distributed_join_tpu.parallel.out_of_core import (
+    fmix64_np,
+    key_batch_ids,
+    keyrange_batched_join,
+)
+from distributed_join_tpu.ops.hashing import fmix64
+from distributed_join_tpu.utils.tpch import (
+    generate_tpch_join_tables,
+    q3_filter,
+    sparse_order_keys,
+)
+
+SF = 0.001  # 1500 orders, ~6000 lineitem rows
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return generate_tpch_join_tables(seed=7, scale_factor=SF)
+
+
+def test_sparse_order_keys_match_dbgen_pattern():
+    keys = np.asarray(sparse_order_keys(20))
+    # 8 keys per 32-block, 1-based: 1..8 then 33..40 then 65..68...
+    assert keys[:8].tolist() == [1, 2, 3, 4, 5, 6, 7, 8]
+    assert keys[8:16].tolist() == [33, 34, 35, 36, 37, 38, 39, 40]
+    assert keys[16:20].tolist() == [65, 66, 67, 68]
+
+
+def test_generator_shapes_and_distributions(tables):
+    orders, lineitem = tables
+    n_orders = orders.capacity
+    assert n_orders == 1500
+    lk = np.asarray(lineitem.columns["l_orderkey"])
+    ok = np.asarray(orders.columns["o_orderkey"])
+    # every lineitem joins an existing order
+    assert np.isin(lk, ok).all()
+    # lines per order within 1..7, mean near 4
+    counts = np.bincount(lk)[ok]
+    assert counts.min() >= 1 and counts.max() <= 7
+    assert 3.5 < counts.mean() < 4.5
+    ship = np.asarray(lineitem.columns["l_shipdate"])
+    odate_per_line = np.asarray(lineitem.columns["l_orderkey"])
+    # shipdate strictly after the order date
+    od = dict(zip(ok.tolist(), np.asarray(orders.columns["o_orderdate"]).tolist()))
+    lag = ship - np.array([od[k] for k in lk.tolist()])
+    assert lag.min() >= 1 and lag.max() <= 121
+
+
+def _oracle(build, probe, key="key"):
+    return len(build.to_pandas().merge(probe.to_pandas(), on=key))
+
+
+def test_tpch_join_vs_oracle(tables):
+    orders, lineitem = tables
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    build = orders.rename({"o_orderkey": "key"})
+    probe = lineitem.rename({"l_orderkey": "key"})
+    res = dj.distributed_inner_join(
+        build, probe, comm, out_capacity_factor=2.0,
+    )
+    want = _oracle(build, probe)
+    assert int(res.total) == want == lineitem.capacity  # every line matches
+    assert not bool(res.overflow)
+
+
+def test_tpch_q3_filters_vs_oracle(tables):
+    orders, lineitem = tables
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    o, l = q3_filter(orders, lineitem)
+    build = o.rename({"o_orderkey": "key"})
+    probe = l.rename({"l_orderkey": "key"})
+    res = dj.distributed_inner_join(
+        build, probe, comm, out_capacity_factor=2.0,
+    )
+    want = _oracle(build, probe)
+    assert 0 < want < lineitem.capacity
+    assert int(res.total) == want
+    assert not bool(res.overflow)
+
+
+def test_fmix64_np_matches_device_hash():
+    x = np.array([0, 1, 2, 77, 2**31, 2**62, -5], dtype=np.int64)
+    import jax.numpy as jnp
+
+    dev = np.asarray(fmix64(jnp.asarray(x)))
+    np.testing.assert_array_equal(fmix64_np(x), dev)
+
+
+def test_keyrange_batched_join_matches_single_shot(tables):
+    orders, lineitem = tables
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    build = orders.rename({"o_orderkey": "key"})
+    probe = lineitem.rename({"l_orderkey": "key"})
+
+    single = dj.distributed_inner_join(
+        build, probe, comm, out_capacity_factor=2.0
+    )
+    seen = []
+    total, overflow = keyrange_batched_join(
+        build, probe, comm, n_batches=4, out_capacity_factor=3.0,
+        shuffle_capacity_factor=3.0,
+        on_batch_result=lambda b, res: seen.append(b),
+    )
+    assert seen == [0, 1, 2, 3]
+    assert not overflow
+    assert total == int(single.total)
+
+
+def test_key_batch_ids_cover_all_batches():
+    ids = key_batch_ids(np.arange(10000, dtype=np.int64), 8)
+    assert set(ids.tolist()) == set(range(8))
