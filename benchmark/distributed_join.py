@@ -39,6 +39,7 @@ from distributed_join_tpu.utils.benchmarking import timed_join_throughput
 from distributed_join_tpu.utils.generators import (
     generate_build_probe_tables,
     generate_build_table,
+    generate_composite_build_probe_tables,
     generate_zipf_probe_table,
 )
 
@@ -88,6 +89,12 @@ def parse_args(argv=None):
                         "fraction of one rank's probe rows")
     p.add_argument("--hh-slots", type=int, default=64,
                    help="static heavy-hitter key slots")
+    p.add_argument("--key-columns", type=int, default=1,
+                   help=">1 joins on a composite multi-column key "
+                        "(BASELINE config 5)")
+    p.add_argument("--string-payload-bytes", type=int, default=0,
+                   help="attach a fixed-width string payload of this "
+                        "many bytes to the build side (config 5)")
     p.add_argument("--json-output", default=None,
                    help="also write the result record to this file")
     return p.parse_args(argv)
@@ -109,7 +116,25 @@ def run(args) -> dict:
     if b_rows % n or p_rows % n:
         raise SystemExit(f"table nrows must be divisible by n_ranks={n}")
 
-    if args.zipf_alpha is not None:
+    join_key = "key"
+    if args.key_columns > 1 or args.string_payload_bytes > 0:
+        if args.zipf_alpha is not None:
+            raise SystemExit("--key-columns/--string-payload-bytes do not "
+                             "combine with --zipf-alpha yet")
+        if args.key_type != "int64":
+            raise SystemExit("composite keys currently use int64 columns")
+        build, probe, key_names = generate_composite_build_probe_tables(
+            seed=42,
+            build_nrows=b_rows,
+            probe_nrows=p_rows,
+            key_columns=args.key_columns,
+            rand_max=args.rand_max,
+            selectivity=args.selectivity,
+            string_payload_len=args.string_payload_bytes,
+            unique_build_keys=not args.duplicate_build_keys,
+        )
+        join_key = key_names if args.key_columns > 1 else key_names[0]
+    elif args.zipf_alpha is not None:
         # Build the sides separately — generating the uniform probe
         # table only to discard it would waste GBs at 100M rows.
         build = generate_build_table(
@@ -138,7 +163,7 @@ def run(args) -> dict:
 
     step = make_join_step(
         comm,
-        key="key",
+        key=join_key,
         over_decomposition=args.over_decomposition_factor,
         shuffle_capacity_factor=args.shuffle_capacity_factor,
         out_capacity_factor=args.out_capacity_factor,
@@ -148,7 +173,7 @@ def run(args) -> dict:
     iters = args.iterations
 
     sec_per_join, matches, overflow = timed_join_throughput(
-        comm, step, build, probe, iters
+        comm, step, build, probe, iters, key=join_key
     )
 
     rows = b_rows + p_rows
@@ -165,6 +190,8 @@ def run(args) -> dict:
         "over_decomposition_factor": args.over_decomposition_factor,
         "zipf_alpha": args.zipf_alpha,
         "skew_threshold": args.skew_threshold,
+        "key_columns": args.key_columns,
+        "string_payload_bytes": args.string_payload_bytes,
         "matches_per_join": matches,
         "overflow": overflow,
         "elapsed_per_join_s": sec_per_join,

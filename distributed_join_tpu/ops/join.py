@@ -35,10 +35,12 @@ from distributed_join_tpu.table import Table
 
 
 def _dtype_sentinel_max(dt):
+    # Typed scalar, not a weak Python number: uint64's max overflows
+    # the default int64 weak-type path inside where()/full().
     if jnp.issubdtype(dt, jnp.integer):
-        return jnp.iinfo(dt).max
+        return jnp.asarray(jnp.iinfo(dt).max, dtype=dt)
     if jnp.issubdtype(dt, jnp.floating):
-        return jnp.inf
+        return jnp.asarray(jnp.inf, dtype=dt)
     raise TypeError(f"unsupported key dtype {dt}")
 
 
@@ -50,41 +52,60 @@ class JoinResult:
     overflow: jax.Array   # bool: total > capacity, rows were truncated
 
 
-def sort_merge_inner_join(
-    build: Table,
-    probe: Table,
-    key: str,
-    out_capacity: int,
-    build_payload: Optional[Sequence[str]] = None,
-    probe_payload: Optional[Sequence[str]] = None,
-) -> JoinResult:
-    """Inner-join ``build`` and ``probe`` on equality of column ``key``.
+def composite_key_ids(
+    build_cols: Sequence[jax.Array], probe_cols: Sequence[jax.Array]
+):
+    """Map composite (multi-column) keys on both sides to dense int32
+    group ids such that two rows share an id iff all their key columns
+    are equal — reducing a composite-key join to the single-key
+    machinery. One lexsort over the concatenated sides + boundary-flag
+    cumsum; fully static shapes.
 
-    Output columns: ``key`` (probe's copy), then build payloads, then
-    probe payloads. Payload names must not collide.
+    The reference's composite keys ride cuDF's multi-column
+    hash/compare kernels (SURVEY.md §2 config 5); dense re-ranking is
+    the sort-based TPU equivalent.
     """
-    if build_payload is None:
-        build_payload = [n for n in build.column_names if n != key]
-    if probe_payload is None:
-        probe_payload = [n for n in probe.column_names if n != key]
-    clash = set(build_payload) & set(probe_payload)
-    if clash:
-        raise ValueError(f"payload name collision: {sorted(clash)}")
+    if len(build_cols) != len(probe_cols):
+        raise ValueError("key column count mismatch")
+    for b, p in zip(build_cols, probe_cols):
+        if b.dtype != p.dtype:
+            raise TypeError(
+                f"key dtype mismatch: build {b.dtype} vs probe {p.dtype}"
+            )
+    nb = build_cols[0].shape[0]
+    cat = [jnp.concatenate([b, p]) for b, p in zip(build_cols, probe_cols)]
+    # lexsort: LAST element is the primary key; order doesn't matter
+    # for grouping, only that equal tuples are adjacent.
+    order = jnp.lexsort(tuple(cat))
+    n = cat[0].shape[0]
+    iota = jnp.arange(n)
+    changed = jnp.zeros((n,), dtype=bool)
+    for c in cat:
+        sc = c[order]
+        changed = changed | (sc != jnp.where(iota == 0, sc[0], jnp.roll(sc, 1)))
+    changed = changed.at[0].set(False)
+    gid_sorted = jnp.cumsum(changed.astype(jnp.int32))
+    inv = jnp.argsort(order)
+    gids = gid_sorted[inv]
+    return gids[:nb], gids[nb:]
 
-    bkey = build.columns[key]
-    pkey = probe.columns[key]
-    if bkey.dtype != pkey.dtype:
-        # Hashing and sort order are dtype-dependent; a silent mismatch
-        # would route equal values to different buckets and drop matches.
-        raise TypeError(
-            f"key dtype mismatch: build {bkey.dtype} vs probe {pkey.dtype}"
-        )
-    bc = build.capacity
+
+def _match_expand(
+    bkey: jax.Array,
+    bvalid: jax.Array,
+    pkey: jax.Array,
+    pvalid: jax.Array,
+    out_capacity: int,
+):
+    """The sort-merge core on a single key array pair: returns
+    ``(p, bidx, out_valid, total, overflow)`` — for each output slot j,
+    probe row ``p[j]`` matches build row ``bidx[j]``."""
+    bc = bkey.shape[0]
 
     # 1. Sort build rows by (is_padding, key); padding sorts last.
-    order = jnp.lexsort((bkey, ~build.valid))
+    order = jnp.lexsort((bkey, ~bvalid))
     skey = bkey[order]
-    n_build = build.num_valid()
+    n_build = jnp.sum(bvalid.astype(jnp.int32))
     iota_b = jnp.arange(bc)
     sentinel = _dtype_sentinel_max(bkey.dtype)
     skey = jnp.where(iota_b < n_build, skey, sentinel)
@@ -95,7 +116,7 @@ def sort_merge_inner_join(
     hi = jnp.searchsorted(skey, pkey, side="right", method="sort")
     lo = jnp.minimum(lo, n_build)
     hi = jnp.minimum(hi, n_build)
-    cnt = jnp.where(probe.valid, hi - lo, 0).astype(jnp.int32)
+    cnt = jnp.where(pvalid, hi - lo, 0).astype(jnp.int32)
 
     # 3. Expand runs into output rows.
     #    `total` must be int64: duplicate-heavy joins (hot keys on both
@@ -120,21 +141,63 @@ def sort_merge_inner_join(
     total = jnp.sum(cnt.astype(jnp.int64))
     j = jnp.arange(out_capacity, dtype=csum.dtype)
     p = jnp.searchsorted(csum, j, side="right", method="sort")
-    p = jnp.minimum(p, probe.capacity - 1)
+    p = jnp.minimum(p, pkey.shape[0] - 1)
     run_start = csum[p] - cnt[p]
     bpos = lo[p] + (j - run_start)
     bidx = order[jnp.clip(bpos, 0, bc - 1)]
-    out_valid = j < total
+    out_valid = (j < total) & pvalid[p]
+    return p, bidx, out_valid, total, total > out_capacity
 
-    out_cols = {key: probe.columns[key][p]}
+
+def sort_merge_inner_join(
+    build: Table,
+    probe: Table,
+    key,
+    out_capacity: int,
+    build_payload: Optional[Sequence[str]] = None,
+    probe_payload: Optional[Sequence[str]] = None,
+) -> JoinResult:
+    """Inner-join ``build`` and ``probe`` on equality of ``key`` — a
+    column name or a sequence of names (composite key; reduced to dense
+    group ids via :func:`composite_key_ids`, one extra lexsort).
+
+    Output columns: the key column(s) (probe's copy), then build
+    payloads, then probe payloads. Payload names must not collide.
+    """
+    keys = [key] if isinstance(key, str) else list(key)
+    if build_payload is None:
+        build_payload = [n for n in build.column_names if n not in keys]
+    if probe_payload is None:
+        probe_payload = [n for n in probe.column_names if n not in keys]
+    clash = set(build_payload) & set(probe_payload)
+    if clash:
+        raise ValueError(f"payload name collision: {sorted(clash)}")
+
+    if len(keys) == 1:
+        bkey = build.columns[keys[0]]
+        pkey = probe.columns[keys[0]]
+        if bkey.dtype != pkey.dtype:
+            # Hashing and sort order are dtype-dependent; a silent
+            # mismatch would route equal keys apart and drop matches.
+            raise TypeError(
+                f"key dtype mismatch: build {bkey.dtype} vs probe {pkey.dtype}"
+            )
+    else:
+        bkey, pkey = composite_key_ids(
+            [build.columns[k] for k in keys],
+            [probe.columns[k] for k in keys],
+        )
+
+    p, bidx, out_valid, total, overflow = _match_expand(
+        bkey, build.valid, pkey, probe.valid, out_capacity
+    )
+
+    out_cols = {k: probe.columns[k][p] for k in keys}
     for n in build_payload:
         out_cols[n] = build.columns[n][bidx]
     for n in probe_payload:
         out_cols[n] = probe.columns[n][p]
 
-    out_valid = out_valid & probe.valid[p]  # belt-and-braces; p rows with cnt>0 are valid
     return JoinResult(
-        Table(out_cols, out_valid),
-        total=total,
-        overflow=total > out_capacity,
+        Table(out_cols, out_valid), total=total, overflow=overflow
     )

@@ -101,5 +101,9 @@ def unpad(padded_columns, counts, capacity: int) -> Table:
     whose validity mask marks the first counts[s] rows of each stripe."""
     lane = jnp.arange(capacity, dtype=jnp.int32)
     valid = (lane[None, :] < counts[:, None]).reshape(-1)
-    cols = {n: c.reshape(-1) for n, c in padded_columns.items()}
+    # Flatten only the (src, lane) dims; trailing dims (e.g. the byte
+    # axis of fixed-width string columns) ride along.
+    cols = {
+        n: c.reshape((-1,) + c.shape[2:]) for n, c in padded_columns.items()
+    }
     return Table(cols, valid)

@@ -100,13 +100,18 @@ def make_join_step(
         raise ValueError("over_decomposition must be >= 1")
     nb = k * n
 
+    keys = [key] if isinstance(key, str) else list(key)
+
     def step(build_local: Table, probe_local: Table) -> JoinResult:
-        bdt = build_local.columns[key].dtype
-        pdt = probe_local.columns[key].dtype
-        if bdt != pdt:
-            # Hash routing is dtype-dependent: a mismatch would shuffle
-            # equal keys to different ranks and silently lose matches.
-            raise TypeError(f"key dtype mismatch: build {bdt} vs probe {pdt}")
+        for kname in keys:
+            bdt = build_local.columns[kname].dtype
+            pdt = probe_local.columns[kname].dtype
+            if bdt != pdt:
+                # Hash routing is dtype-dependent: a mismatch would
+                # shuffle equal keys apart and silently lose matches.
+                raise TypeError(
+                    f"key {kname!r} dtype mismatch: build {bdt} vs probe {pdt}"
+                )
         b_rows, p_rows = build_local.capacity, probe_local.capacity
         b_cap = _round_up(int(math.ceil(b_rows / nb * shuffle_capacity_factor)), 8)
         p_cap = _round_up(int(math.ceil(p_rows / nb * shuffle_capacity_factor)), 8)
@@ -123,17 +128,25 @@ def make_join_step(
         overflow = jnp.bool_(False)
 
         if skew_threshold is not None:
+            from distributed_join_tpu.ops.hashing import hash_columns
             from distributed_join_tpu.parallel import skew
 
+            # Detect/mark heavy hitters on the uint64 key-tuple hash:
+            # classification only needs to be CONSISTENT across sides
+            # and ranks (hash collisions merely over-classify a key as
+            # heavy, which stays correct — the HH join matches on the
+            # real composite key).
+            bh = hash_columns([build_local.columns[k] for k in keys])
+            ph = hash_columns([probe_local.columns[k] for k in keys])
             hh = skew.global_heavy_hitters(
                 comm,
-                probe_local.columns[key],
+                ph,
                 probe_local.valid,
                 hh_slots,
                 threshold=jnp.int32(int(skew_threshold * p_rows)),
             )
-            is_hh_b = skew.mark_heavy(build_local.columns[key], hh)
-            is_hh_p = skew.mark_heavy(probe_local.columns[key], hh)
+            is_hh_b = skew.mark_heavy(bh, hh)
+            is_hh_p = skew.mark_heavy(ph, hh)
             hh_build, ovf_hb = skew.broadcast_heavy_build(
                 comm, build_local, is_hh_b,
                 hh_build_capacity or hh_slots * HH_BUILD_SLOTS_PER_HH,
@@ -141,7 +154,7 @@ def make_join_step(
             # HH probe rows stay local: same arrays, narrowed validity.
             hh_probe = Table(probe_local.columns, probe_local.valid & is_hh_p)
             hh_res = sort_merge_inner_join(
-                hh_build, hh_probe, key,
+                hh_build, hh_probe, keys,
                 hh_out_capacity or p_rows,
                 build_payload=build_payload, probe_payload=probe_payload,
             )
@@ -154,13 +167,13 @@ def make_join_step(
             probe_local = Table(probe_local.columns,
                                 probe_local.valid & ~is_hh_p)
 
-        ptb = radix_hash_partition(build_local, [key], nb)
-        ptp = radix_hash_partition(probe_local, [key], nb)
+        ptb = radix_hash_partition(build_local, keys, nb)
+        ptp = radix_hash_partition(probe_local, keys, nb)
         for b in range(k):
             recv_build, ovf_b = _batch_shuffle(comm, ptb, b, n, b_cap)
             recv_probe, ovf_p = _batch_shuffle(comm, ptp, b, n, p_cap)
             res = sort_merge_inner_join(
-                recv_build, recv_probe, key, out_cap,
+                recv_build, recv_probe, keys, out_cap,
                 build_payload=build_payload, probe_payload=probe_payload,
             )
             parts.append(res.table)
@@ -213,20 +226,8 @@ def distributed_inner_join(
     """
     n = comm.n_ranks
 
-    def pad_div(t: Table) -> Table:
-        cap = t.capacity
-        new_cap = _round_up(cap, n)
-        if new_cap == cap:
-            return t
-        extra = new_cap - cap
-        cols = {
-            name: jnp.concatenate([c, jnp.zeros((extra,), dtype=c.dtype)])
-            for name, c in t.columns.items()
-        }
-        valid = jnp.concatenate([t.valid, jnp.zeros((extra,), dtype=bool)])
-        return Table(cols, valid)
-
-    build, probe = pad_div(build), pad_div(probe)
+    build = build.pad_to(_round_up(build.capacity, n))
+    probe = probe.pad_to(_round_up(probe.capacity, n))
     if hasattr(comm, "device_put_sharded"):
         build, probe = comm.device_put_sharded((build, probe))
 

@@ -19,7 +19,8 @@ which a real deployment would overlap with compute via double-buffered
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence, Tuple
+import time
+from typing import Callable, Optional, Tuple
 
 import numpy as np
 
@@ -41,12 +42,31 @@ def fmix64_np(x: np.ndarray) -> np.ndarray:
     return k
 
 
-def key_batch_ids(keys: np.ndarray, n_batches: int) -> np.ndarray:
-    """Batch id per row. Uses the UPPER hash bits so batching composes
-    with the device kernels' ``hash % n_buckets`` routing (lower bits):
-    the two partitions stay independent, and every key pair that joins
-    lands in the same batch on both sides."""
-    h = fmix64_np(keys)
+def hash_combine_np(seed: np.ndarray, h: np.ndarray) -> np.ndarray:
+    """numpy mirror of ops.hashing.hash_combine."""
+    magic = np.uint64(0x9E3779B97F4A7C15)
+    return seed ^ (
+        h + magic + (seed << np.uint64(6)) + (seed >> np.uint64(2))
+    )
+
+
+def hash_columns_np(cols) -> np.ndarray:
+    """numpy mirror of ops.hashing.hash_columns for integer key
+    columns (composite keys batch by the combined hash)."""
+    acc = fmix64_np(cols[0])
+    for c in cols[1:]:
+        acc = hash_combine_np(acc, fmix64_np(c))
+    return acc
+
+
+def key_batch_ids(keys, n_batches: int) -> np.ndarray:
+    """Batch id per row; ``keys`` is one array or a list of composite
+    key columns. Uses the UPPER hash bits so batching composes with the
+    device kernels' ``hash % n_buckets`` routing (lower bits): the two
+    partitions stay independent, and every key pair that joins lands in
+    the same batch on both sides."""
+    cols = keys if isinstance(keys, (list, tuple)) else [keys]
+    h = hash_columns_np([np.asarray(c) for c in cols])
     return ((h >> np.uint64(40)) % np.uint64(n_batches)).astype(np.int64)
 
 
@@ -62,6 +82,8 @@ def keyrange_batched_join(
     key: str = "key",
     n_batches: int = 4,
     on_batch_result: Optional[Callable] = None,
+    warmup: bool = True,
+    stats: Optional[dict] = None,
     **join_opts,
 ) -> Tuple[int, bool]:
     """Join arbitrarily large host-resident tables in ``n_batches``
@@ -69,14 +91,20 @@ def keyrange_batched_join(
 
     ``on_batch_result(batch_index, JoinResult)`` can materialize or
     reduce each batch's output before the next batch replaces it.
+    ``warmup`` runs (and discards) batch 0 once first so the 30-100s
+    remote XLA compile stays out of the measured loop; ``stats`` (if a
+    dict) receives ``elapsed_s`` — the post-warmup batch-loop wall time
+    including H2D staging, the honest out-of-core figure a caller
+    should report instead of timing around this whole call.
     """
     from distributed_join_tpu.parallel.distributed_join import (
         make_distributed_join,
     )
 
+    keys = [key] if isinstance(key, str) else list(key)
     hb, hp = _host_columns(build), _host_columns(probe)
-    bb = key_batch_ids(hb[key], n_batches)
-    pb = key_batch_ids(hp[key], n_batches)
+    bb = key_batch_ids([hb[k] for k in keys], n_batches)
+    pb = key_batch_ids([hp[k] for k in keys], n_batches)
 
     # One static capacity across batches (max batch size, rank-padded)
     # so the join compiles ONCE; per-batch recompiles at 30-100s each
@@ -93,14 +121,20 @@ def keyrange_batched_join(
         m = int(sel.sum())
         out = {}
         for name, c in cols.items():
-            buf = np.zeros((cap,), dtype=c.dtype)
+            buf = np.zeros((cap,) + c.shape[1:], dtype=c.dtype)
             buf[:m] = c[sel]
             out[name] = jnp.asarray(buf)
         return Table.from_prefix(out, m)
 
     fn = make_distributed_join(comm, key=key, **join_opts)
+    if warmup:
+        bt = _pad(hb, bb == 0, bcap)
+        pt = _pad(hp, pb == 0, pcap)
+        bt, pt = comm.device_put_sharded((bt, pt))
+        int(fn(bt, pt).total)  # compile + run, result discarded
     total = 0
     overflow = False
+    t0 = time.perf_counter()
     for b in range(n_batches):
         bt = _pad(hb, bb == b, bcap)
         pt = _pad(hp, pb == b, pcap)
@@ -110,4 +144,6 @@ def keyrange_batched_join(
         overflow |= bool(res.overflow)
         if on_batch_result is not None:
             on_batch_result(b, res)
+    if stats is not None:
+        stats["elapsed_s"] = time.perf_counter() - t0
     return total, overflow

@@ -28,7 +28,12 @@ class Table:
     """A fixed-capacity columnar table.
 
     Attributes:
-      columns: name -> 1-D array; all the same length (the capacity).
+      columns: name -> array whose leading dimension is the row
+               dimension; all columns share it (the capacity). Scalar
+               columns are 1-D; fixed-width string columns are 2-D
+               ``uint8[capacity, max_len]`` (see utils/strings.py) —
+               the TPU answer to cuDF's offsets+chars string columns
+               (SURVEY.md §2 "All-to-all shuffle", string children).
       valid:   boolean mask of shape (capacity,). ``valid[i]`` marks row
                ``i`` as a real row (vs padding).
     """
@@ -57,16 +62,14 @@ class Table:
             return
         lengths = {name: c.shape for name, c in self.columns.items()}
         for name, shape in lengths.items():
-            if len(shape) != 1:
-                raise ValueError(f"column {name!r} must be 1-D, got {shape}")
+            if len(shape) < 1:
+                raise ValueError(f"column {name!r} must have a row dim")
         if len({s[0] for s in lengths.values()}) != 1:
-            raise ValueError(f"columns must share a length, got {lengths}")
-        if hasattr(self.valid, "shape") and (
-            self.valid.shape != next(iter(lengths.values()))
-        ):
+            raise ValueError(f"columns must share a row count, got {lengths}")
+        cap = next(iter(lengths.values()))[0]
+        if hasattr(self.valid, "shape") and self.valid.shape != (cap,):
             raise ValueError(
-                f"valid mask shape {self.valid.shape} != column length "
-                f"{next(iter(lengths.values()))}"
+                f"valid mask shape {self.valid.shape} != (capacity,) = ({cap},)"
             )
 
     # -- constructors -------------------------------------------------
@@ -96,6 +99,27 @@ class Table:
             self.valid,
         )
 
+    def pad_to(self, capacity: int) -> "Table":
+        """Grow to ``capacity`` rows with invalid zero padding (no-op if
+        already there). The one padding implementation — handles
+        trailing dims (string columns)."""
+        cap = self.capacity
+        if capacity == cap:
+            return self
+        if capacity < cap:
+            raise ValueError(f"pad_to({capacity}) below capacity {cap}")
+        extra = capacity - cap
+        cols = {
+            n: jnp.concatenate(
+                [c, jnp.zeros((extra,) + c.shape[1:], dtype=c.dtype)]
+            )
+            for n, c in self.columns.items()
+        }
+        valid = jnp.concatenate(
+            [self.valid, jnp.zeros((extra,), dtype=bool)]
+        )
+        return Table(cols, valid)
+
     def gather(self, idx: jax.Array, idx_valid: jax.Array) -> "Table":
         """Rows at ``idx`` where ``idx_valid``; out-of-range idx clamped."""
         cap = self.capacity
@@ -112,11 +136,19 @@ class Table:
     # -- host-side helpers (NOT jittable) -----------------------------
 
     def to_pandas(self):
-        """Materialize valid rows on host. Test/debug only."""
+        """Materialize valid rows on host; 2-D uint8 columns decode to
+        Python strings (see utils/strings.py). Test/debug only."""
         import numpy as np
         import pandas as pd
 
+        from distributed_join_tpu.utils.strings import decode_strings
+
         mask = np.asarray(self.valid)
-        return pd.DataFrame(
-            {n: np.asarray(c)[mask] for n, c in self.columns.items()}
-        )
+        out = {}
+        for n, c in self.columns.items():
+            a = np.asarray(c)[mask]
+            if a.ndim == 2 and a.dtype == np.uint8:
+                out[n] = decode_strings(a)
+            else:
+                out[n] = a
+        return pd.DataFrame(out)

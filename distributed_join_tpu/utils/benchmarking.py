@@ -65,7 +65,11 @@ def timed_join_throughput(
     """
     from distributed_join_tpu.table import Table
 
-    key_dtype = probe.columns[key].dtype
+    # For a composite key, shifting ONLY the first column preserves
+    # tuple-equality structure (tuples equal iff shifted tuples equal)
+    # while still making every downstream stage loop-variant.
+    shift_key = key if isinstance(key, str) else key[0]
+    key_dtype = probe.columns[shift_key].dtype
 
     def looped(build, probe):
         def body(i, acc):
@@ -74,9 +78,9 @@ def timed_join_throughput(
                 else lax.convert_element_type(i, key_dtype)
             )
             bcols = dict(build.columns)
-            bcols[key] = bcols[key] + shift
+            bcols[shift_key] = bcols[shift_key] + shift
             pcols = dict(probe.columns)
-            pcols[key] = pcols[key] + shift
+            pcols[shift_key] = pcols[shift_key] + shift
             res = step(Table(bcols, build.valid), Table(pcols, probe.valid))
             out = res.table
             consumed = jnp.sum(

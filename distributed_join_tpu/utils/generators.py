@@ -116,6 +116,73 @@ def generate_build_probe_tables(
     return build, probe
 
 
+def expand_composite_key(base: jax.Array, n_cols: int, rand_max: int):
+    """Derive ``n_cols`` key columns from a scalar base key so that two
+    rows' composite tuples are equal iff their bases are equal — the
+    hit/miss guarantees of the scalar generator carry over verbatim to
+    the composite-key configs (BASELINE config 5)."""
+    from distributed_join_tpu.ops.hashing import fmix64
+
+    cols = {"key0": base}
+    for i in range(1, n_cols):
+        cols[f"key{i}"] = (
+            fmix64(base + jnp.int64(i)) % jnp.uint64(rand_max)
+        ).astype(base.dtype)
+    return cols
+
+
+def generate_composite_build_probe_tables(
+    seed: int,
+    build_nrows: int,
+    probe_nrows: int,
+    key_columns: int = 2,
+    rand_max: int | None = None,
+    selectivity: float = 0.3,
+    string_payload_len: int = 0,
+    unique_build_keys: bool = False,
+):
+    """Config-5 generator: multi-column keys (+ optional fixed-width
+    string payload on the build side). Returns (build, probe,
+    key_names)."""
+    from distributed_join_tpu.utils.strings import LEN_SUFFIX, encode_int_strings
+
+    if rand_max is None:
+        rand_max = build_nrows
+    build, probe = generate_build_probe_tables(
+        seed, build_nrows, probe_nrows, rand_max=rand_max,
+        selectivity=selectivity, unique_build_keys=unique_build_keys,
+    )
+    key_names = [f"key{i}" for i in range(key_columns)]
+
+    def expand(t: Table, payload_names) -> Table:
+        cols = expand_composite_key(t.columns["key"], key_columns, rand_max)
+        for p in payload_names:
+            cols[p] = t.columns[p]
+        return Table(cols, t.valid)
+
+    build = expand(build, ["build_payload"])
+    probe = expand(probe, ["probe_payload"])
+    if string_payload_len > 0:
+        import numpy as np
+
+        prefix = "itm-"
+        if string_payload_len <= len(prefix):
+            raise ValueError(
+                f"string_payload_len must exceed {len(prefix)} (the "
+                f"{prefix!r} prefix) so the payload has id digits"
+            )
+        sbytes, slens = encode_int_strings(
+            np.asarray(build.columns["build_payload"]),
+            prefix=prefix,
+            digits=string_payload_len - len(prefix),
+        )
+        cols = dict(build.columns)
+        cols["build_tag"] = sbytes
+        cols["build_tag" + LEN_SUFFIX] = slens
+        build = Table(cols, build.valid)
+    return build, probe, key_names
+
+
 def zipf_keys(
     key: jax.Array, nrows: int, alpha: float, rand_max: int, dtype=jnp.int64
 ) -> jax.Array:

@@ -1,0 +1,92 @@
+"""Fixed-width string columns.
+
+The reference shuffles cuDF string columns as offsets + chars children
+with exact per-rank byte counts (SURVEY.md §2 "All-to-all shuffle of a
+cuDF table"). Exact ragged bytes need dynamic receive sizes, which XLA
+doesn't have; the TPU-native representation here is fixed-width padded:
+
+    bytes:   uint8[capacity, max_len]   (zero-padded row bytes)
+    lengths: int32[capacity]            (companion column "<name>#len")
+
+A string column is then just a 2-D Table column — every kernel
+(partition gather, padded all-to-all, join output gather) moves it by
+row indexing with zero string-specific code. The cost is pad bytes on
+the wire (~1/utilization), the classic TPU trade of padding for static
+shapes; a ragged two-phase byte shuffle is a possible later
+optimization, mirroring the reference's offsets-then-chars exchange.
+
+Strings are payload-only for now: join keys must be fixed-width
+scalars (hash/sort of 2-D byte rows is not wired into the kernels).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+LEN_SUFFIX = "#len"
+
+
+def encode_strings(values: Sequence[str], max_len: int):
+    """Encode to (bytes uint8[n, max_len], lengths int32[n]). Raises if
+    any UTF-8 encoding exceeds ``max_len`` (silent truncation would
+    corrupt payloads)."""
+    n = len(values)
+    out = np.zeros((n, max_len), dtype=np.uint8)
+    lens = np.zeros((n,), dtype=np.int32)
+    for i, s in enumerate(values):
+        raw = s.encode("utf-8")
+        if len(raw) > max_len:
+            raise ValueError(
+                f"string row {i} is {len(raw)} bytes > max_len={max_len}"
+            )
+        out[i, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        lens[i] = len(raw)
+    return jnp.asarray(out), jnp.asarray(lens)
+
+
+def decode_strings(bytes_2d: np.ndarray, lengths: np.ndarray | None = None
+                   ) -> List[str]:
+    """Decode uint8[n, max_len] back to Python strings. Without
+    ``lengths``, trailing zero bytes are stripped (encode never emits
+    interior NULs for text, so this matches encode_strings round-trip
+    for normal strings)."""
+    a = np.asarray(bytes_2d)
+    out = []
+    for i in range(a.shape[0]):
+        row = a[i]
+        k = int(lengths[i]) if lengths is not None else (
+            int(np.max(np.nonzero(row)[0])) + 1 if row.any() else 0
+        )
+        out.append(bytes(row[:k]).decode("utf-8"))
+    return out
+
+
+def encode_int_strings(ids: np.ndarray, prefix: str = "itm-",
+                       digits: int = 12):
+    """Vectorized '<prefix><zero-padded id>' encoding — generator-scale
+    string payloads without a Python loop over millions of rows."""
+    ids = np.asarray(ids)
+    praw = prefix.encode("utf-8")
+    width = len(praw) + digits
+    out = np.empty((ids.shape[0], width), dtype=np.uint8)
+    out[:, : len(praw)] = np.frombuffer(praw, dtype=np.uint8)
+    for d in range(digits):
+        out[:, len(praw) + d] = (
+            (ids // 10 ** (digits - 1 - d)) % 10 + ord("0")
+        ).astype(np.uint8)
+    lens = np.full((ids.shape[0],), width, dtype=np.int32)
+    return jnp.asarray(out), jnp.asarray(lens)
+
+
+def add_string_column(columns: dict, name: str, values: Sequence[str],
+                      max_len: int) -> dict:
+    """Insert a string column plus its companion length column."""
+    b, l = encode_strings(values, max_len)
+    columns = dict(columns)
+    columns[name] = b
+    columns[name + LEN_SUFFIX] = l
+    return columns

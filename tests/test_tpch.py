@@ -117,3 +117,33 @@ def test_keyrange_batched_join_matches_single_shot(tables):
 def test_key_batch_ids_cover_all_batches():
     ids = key_batch_ids(np.arange(10000, dtype=np.int64), 8)
     assert set(ids.tolist()) == set(range(8))
+
+
+def test_keyrange_batched_join_with_string_payload():
+    """Out-of-core path must move 2-D string columns intact."""
+    from distributed_join_tpu.utils.generators import (
+        generate_composite_build_probe_tables,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    build, probe, keys = generate_composite_build_probe_tables(
+        seed=11, build_nrows=1024, probe_nrows=2048, key_columns=2,
+        selectivity=0.5, string_payload_len=12,
+    )
+    total, overflow = keyrange_batched_join(
+        build, probe, comm, key=keys, n_batches=2,
+        out_capacity_factor=4.0, shuffle_capacity_factor=4.0,
+    )
+    want = len(build.to_pandas().merge(probe.to_pandas(), on=keys))
+    assert total == want and not overflow
+
+
+def test_hash_columns_np_matches_device():
+    import jax.numpy as jnp
+    from distributed_join_tpu.ops.hashing import hash_columns
+    from distributed_join_tpu.parallel.out_of_core import hash_columns_np
+
+    a = np.array([1, 5, 2**40, -3], dtype=np.int64)
+    b = np.array([9, 0, 7, 2**20], dtype=np.int64)
+    dev = np.asarray(hash_columns([jnp.asarray(a), jnp.asarray(b)]))
+    np.testing.assert_array_equal(hash_columns_np([a, b]), dev)
