@@ -1,110 +1,14 @@
-"""All-to-all shuffle microbenchmark — mirrors the reference's
-``benchmark/all_to_all`` executable (SURVEY.md §3.2).
+"""Shim at the reference's ``benchmark/all_to_all`` path; the driver
+lives in :mod:`distributed_join_tpu.benchmarks.all_to_all` (installed
+as the ``tpu-all-to-all`` console script)."""
 
-The reference allocates fixed-size send/recv buffers per peer, loops
-``comm->send/recv`` to all peers + waitall, and reports GB/s — isolating
-the communication layer entirely. Here the isolated layer is the
-``Communicator.all_to_all`` collective (XLA ``AllToAll`` over ICI on a
-real slice; the host-platform emulation on the CPU fake backend), timed
-with the chained-loop protocol so per-call RPC latency doesn't pollute
-the number.
-
-Bandwidth definition: per-rank egress — each rank sends
-``(n_ranks - 1) / n_ranks`` of its buffer off-chip per iteration (the
-diagonal block stays local), and we report aggregate off-chip GB/s =
-``n_ranks * egress_bytes / t``. The reference's count-everything variant
-(as if the local copy were traffic) is also printed for comparability.
-"""
-
-from __future__ import annotations
-
-import argparse
-import json
 import os
 import sys
 
-import jax
-import jax.numpy as jnp
-from jax import lax
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from distributed_join_tpu.parallel.communicator import make_communicator
-from distributed_join_tpu.utils.benchmarking import measure
-
-
-def parse_args(argv=None):
-    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--buffer-size", type=int, default=64 * 1024 * 1024,
-                   help="bytes in each rank's send buffer (split across "
-                        "peers), reference-style fixed-size exchange")
-    p.add_argument("--communicator", default="tpu")
-    p.add_argument("--n-ranks", type=int, default=None)
-    p.add_argument("--iterations", type=int, default=20,
-                   help="chained exchanges in the timed compiled loop")
-    p.add_argument("--json-output", default=None)
-    return p.parse_args(argv)
-
-
-def run(args) -> dict:
-    comm = make_communicator(args.communicator, n_ranks=args.n_ranks)
-    n = comm.n_ranks
-    if n < 2:
-        raise SystemExit(
-            "all_to_all needs >= 2 ranks (on one real chip, force the CPU "
-            "fake backend: XLA_FLAGS=--xla_force_host_platform_device_count=8"
-            " with jax.config jax_platforms=cpu)"
-        )
-    elems = args.buffer_size // 4  # float32 lanes
-    elems -= elems % n
-    per_rank = elems // n
-
-    x = jnp.arange(n * elems, dtype=jnp.float32).reshape(n, elems)
-    x = comm.device_put_sharded(x)
-    jax.block_until_ready(x)
-    iters = args.iterations
-
-    def looped(x):
-        x = x.reshape(n, per_rank)
-
-        def body(i, carry):
-            # The +i makes each exchange depend on the loop counter and
-            # the previous result, so XLA cannot collapse the chain.
-            return comm.all_to_all(carry + jnp.float32(1)) + i
-        y = lax.fori_loop(0, iters, body, x)
-        return comm.psum(jnp.sum(y))
-
-    fn = comm.spmd(looped, sharded_out=True)
-
-    state = {}
-
-    def fetch(res):
-        state["checksum"] = float(res)
-
-    sec = measure(lambda: fn(x), fetch, iters)
-
-    bytes_per_rank = elems * 4
-    egress = bytes_per_rank * (n - 1) / n
-    record = {
-        "benchmark": "all_to_all",
-        "communicator": comm.name,
-        "n_ranks": n,
-        "buffer_bytes_per_rank": bytes_per_rank,
-        "elapsed_per_exchange_s": sec,
-        "aggregate_offchip_gb_per_sec": n * egress / sec / 1e9,
-        "aggregate_gb_per_sec_incl_local": n * bytes_per_rank / sec / 1e9,
-    }
-    print(f"all-to-all: {n} ranks x {bytes_per_rank / 1e6:.1f} MB in "
-          f"{sec * 1e3:.3f} ms -> "
-          f"{record['aggregate_offchip_gb_per_sec']:.2f} GB/s off-chip "
-          f"({record['aggregate_gb_per_sec_incl_local']:.2f} GB/s incl. "
-          f"local block)")
-    print(json.dumps(record))
-    if args.json_output:
-        with open(args.json_output, "w") as f:
-            json.dump(record, f, indent=2)
-    return record
-
+from distributed_join_tpu.benchmarks.all_to_all import *  # noqa: F401,F403
+from distributed_join_tpu.benchmarks.all_to_all import main, parse_args, run  # noqa: F401
 
 if __name__ == "__main__":
-    run(parse_args())
+    main()

@@ -1,215 +1,14 @@
-"""Distributed-join benchmark driver — flag-compatible with the
-reference's ``benchmark/distributed_join`` executable.
+"""Shim at the reference's ``benchmark/distributed_join`` path; the
+driver lives in :mod:`distributed_join_tpu.benchmarks.distributed_join`
+(installed as the ``tpu-distributed-join`` console script)."""
 
-The reference driver (SURVEY.md §2 "Join benchmark driver", §3.1) does:
-MPI init -> device binding -> memory pool -> parse flags -> generate
-build/probe tables -> warmup join -> barrier-timed join -> report
-rows/sec from rank 0. This driver keeps the flag names and the protocol
-(BASELINE.json north star: "the existing benchmark/distributed_join
-driver selects the backend via --communicator=tpu and runs unmodified");
-the TPU backend replaces MPI+NCCL/UCX with a device mesh + XLA
-collectives, so "MPI init" becomes mesh construction and the barrier
-timing becomes the chained-loop protocol of
-:mod:`distributed_join_tpu.utils.benchmarking`.
-
-Reference flags accepted verbatim: --key-type --payload-type
---build-table-nrows --probe-table-nrows --selectivity --rand-max
---duplicate-build-keys --over-decomposition-factor --communicator
---registration-method --compression.
-
-Flags this framework adds: --n-ranks --iterations
---shuffle-capacity-factor --out-capacity-factor --json-output.
-"""
-
-from __future__ import annotations
-
-import argparse
-import json
 import os
 import sys
 
-import jax
-import jax.numpy as jnp
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from distributed_join_tpu.parallel.communicator import make_communicator
-from distributed_join_tpu.parallel.distributed_join import make_join_step
-from distributed_join_tpu.utils.benchmarking import timed_join_throughput
-from distributed_join_tpu.utils.generators import (
-    generate_build_probe_tables,
-    generate_build_table,
-    generate_composite_build_probe_tables,
-    generate_zipf_probe_table,
-)
-
-DTYPES = {
-    "int32": jnp.int32,
-    "int64": jnp.int64,
-    "float32": jnp.float32,
-    "float64": jnp.float64,
-}
-
-
-def parse_args(argv=None):
-    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    # -- reference flags (names verbatim; SURVEY.md §5 "Config") --------
-    p.add_argument("--key-type", choices=DTYPES, default="int64")
-    p.add_argument("--payload-type", choices=DTYPES, default="int64")
-    p.add_argument("--build-table-nrows", type=int, default=1_000_000)
-    p.add_argument("--probe-table-nrows", type=int, default=1_000_000)
-    p.add_argument("--selectivity", type=float, default=0.3)
-    p.add_argument("--rand-max", type=int, default=None,
-                   help="key range [0, rand-max); default build-table-nrows")
-    p.add_argument("--duplicate-build-keys", action="store_true",
-                   help="draw build keys with replacement (default: unique)")
-    p.add_argument("--over-decomposition-factor", type=int, default=1)
-    p.add_argument("--communicator", default="tpu",
-                   help="tpu | local (NCCL/UCX are the reference's GPU "
-                        "backends and are rejected with guidance)")
-    p.add_argument("--registration-method", default=None,
-                   help="accepted for reference CLI parity; ignored — XLA "
-                        "owns TPU memory, there is no RDMA registration")
-    p.add_argument("--compression", action="store_true",
-                   help="accepted for reference CLI parity; on-the-wire "
-                        "compression is a documented v1 gap (SURVEY.md §2)")
-    # -- framework flags ------------------------------------------------
-    p.add_argument("--n-ranks", type=int, default=None,
-                   help="mesh size; default all visible devices")
-    p.add_argument("--iterations", type=int, default=4,
-                   help="timed join steps chained in one compiled loop")
-    p.add_argument("--shuffle-capacity-factor", type=float, default=1.6)
-    p.add_argument("--out-capacity-factor", type=float, default=1.2)
-    p.add_argument("--zipf-alpha", type=float, default=None,
-                   help="draw probe keys Zipf(alpha) instead of the "
-                        "generator's hit/miss mix (BASELINE config 3)")
-    p.add_argument("--skew-threshold", type=float, default=None,
-                   help="enable heavy-hitter handling: a key is heavy "
-                        "when its global probe count exceeds this "
-                        "fraction of one rank's probe rows")
-    p.add_argument("--hh-slots", type=int, default=64,
-                   help="static heavy-hitter key slots")
-    p.add_argument("--key-columns", type=int, default=1,
-                   help=">1 joins on a composite multi-column key "
-                        "(BASELINE config 5)")
-    p.add_argument("--string-payload-bytes", type=int, default=0,
-                   help="attach a fixed-width string payload of this "
-                        "many bytes to the build side (config 5)")
-    p.add_argument("--json-output", default=None,
-                   help="also write the result record to this file")
-    return p.parse_args(argv)
-
-
-def run(args) -> dict:
-    if args.registration_method:
-        print(f"note: --registration-method={args.registration_method} "
-              "ignored (no RDMA registration on TPU)", file=sys.stderr)
-    if args.compression:
-        print("note: --compression ignored (v1 gap; SURVEY.md §2)",
-              file=sys.stderr)
-
-    comm = make_communicator(args.communicator, n_ranks=args.n_ranks)
-    n = comm.n_ranks
-    key_dtype = DTYPES[args.key_type]
-    payload_dtype = DTYPES[args.payload_type]
-    b_rows, p_rows = args.build_table_nrows, args.probe_table_nrows
-    if b_rows % n or p_rows % n:
-        raise SystemExit(f"table nrows must be divisible by n_ranks={n}")
-
-    join_key = "key"
-    if args.key_columns > 1 or args.string_payload_bytes > 0:
-        if args.zipf_alpha is not None:
-            raise SystemExit("--key-columns/--string-payload-bytes do not "
-                             "combine with --zipf-alpha yet")
-        if args.key_type != "int64":
-            raise SystemExit("composite keys currently use int64 columns")
-        build, probe, key_names = generate_composite_build_probe_tables(
-            seed=42,
-            build_nrows=b_rows,
-            probe_nrows=p_rows,
-            key_columns=args.key_columns,
-            rand_max=args.rand_max,
-            selectivity=args.selectivity,
-            string_payload_len=args.string_payload_bytes,
-            unique_build_keys=not args.duplicate_build_keys,
-        )
-        join_key = key_names if args.key_columns > 1 else key_names[0]
-    elif args.zipf_alpha is not None:
-        # Build the sides separately — generating the uniform probe
-        # table only to discard it would waste GBs at 100M rows.
-        build = generate_build_table(
-            jax.random.PRNGKey(42), b_rows, args.rand_max or b_rows,
-            key_dtype=key_dtype, payload_dtype=payload_dtype,
-            unique_keys=not args.duplicate_build_keys,
-        )
-        probe = generate_zipf_probe_table(
-            jax.random.PRNGKey(43), p_rows, args.zipf_alpha,
-            args.rand_max or b_rows,
-            key_dtype=key_dtype, payload_dtype=payload_dtype,
-        )
-    else:
-        build, probe = generate_build_probe_tables(
-            seed=42,
-            build_nrows=b_rows,
-            probe_nrows=p_rows,
-            rand_max=args.rand_max,
-            selectivity=args.selectivity,
-            key_dtype=key_dtype,
-            payload_dtype=payload_dtype,
-            unique_build_keys=not args.duplicate_build_keys,
-        )
-    build, probe = comm.device_put_sharded((build, probe))
-    jax.block_until_ready((build, probe))
-
-    step = make_join_step(
-        comm,
-        key=join_key,
-        over_decomposition=args.over_decomposition_factor,
-        shuffle_capacity_factor=args.shuffle_capacity_factor,
-        out_capacity_factor=args.out_capacity_factor,
-        skew_threshold=args.skew_threshold,
-        hh_slots=args.hh_slots,
-    )
-    iters = args.iterations
-
-    sec_per_join, matches, overflow = timed_join_throughput(
-        comm, step, build, probe, iters, key=join_key
-    )
-
-    rows = b_rows + p_rows
-    rows_per_sec = rows / sec_per_join
-    record = {
-        "benchmark": "distributed_join",
-        "communicator": comm.name,
-        "n_ranks": n,
-        "key_type": args.key_type,
-        "payload_type": args.payload_type,
-        "build_table_nrows": b_rows,
-        "probe_table_nrows": p_rows,
-        "selectivity": args.selectivity,
-        "over_decomposition_factor": args.over_decomposition_factor,
-        "zipf_alpha": args.zipf_alpha,
-        "skew_threshold": args.skew_threshold,
-        "key_columns": args.key_columns,
-        "string_payload_bytes": args.string_payload_bytes,
-        "matches_per_join": matches,
-        "overflow": overflow,
-        "elapsed_per_join_s": sec_per_join,
-        "rows_per_sec": rows_per_sec,
-        "m_rows_per_sec_per_rank": rows_per_sec / 1e6 / n,
-    }
-    # Rank-0-style stdout line, shape-compatible with the reference's
-    # report (SURVEY.md §3.1 final step).
-    print(f"distributed join: {rows} rows in {sec_per_join:.4f} s -> "
-          f"{rows_per_sec / 1e6:.2f} M rows/s over {n} rank(s)"
-          + (" [OVERFLOW — rerun with larger capacity factors]"
-             if overflow else ""))
-    print(json.dumps(record))
-    if args.json_output:
-        with open(args.json_output, "w") as f:
-            json.dump(record, f, indent=2)
-    return record
-
+from distributed_join_tpu.benchmarks.distributed_join import *  # noqa: F401,F403
+from distributed_join_tpu.benchmarks.distributed_join import main, parse_args, run  # noqa: F401
 
 if __name__ == "__main__":
-    run(parse_args())
+    main()

@@ -1,130 +1,14 @@
-"""TPC-H ``lineitem ⋈ orders`` benchmark (Q3 join pattern) —
-BASELINE config 4.
+"""Shim at the reference's ``benchmark/tpch_join`` path; the driver
+lives in :mod:`distributed_join_tpu.benchmarks.tpch_join` (installed as
+the ``tpu-tpch-join`` console script)."""
 
-Generates dbgen-semantics orders/lineitem tables on device
-(:mod:`distributed_join_tpu.utils.tpch`), applies Q3's date predicates
-as validity masks, and times the distributed join of lineitem (probe)
-against orders (build) on orderkey, reporting rows/sec — the BASELINE
-north star's headline configuration (>= 1 B rows/sec aggregate at
-SF-100 on 8 v5e chips).
-
-``--batches k`` engages the out-of-core key-range path
-(:mod:`distributed_join_tpu.parallel.out_of_core`) for scale factors
-whose tables exceed device memory; batching is outside the timed
-region's per-join loop, so its rows/sec includes H2D staging — the
-honest number for an out-of-core join.
-"""
-
-from __future__ import annotations
-
-import argparse
-import json
 import os
 import sys
-import time
-
-import jax
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from distributed_join_tpu.parallel.communicator import make_communicator
-from distributed_join_tpu.parallel.distributed_join import make_join_step
-from distributed_join_tpu.parallel.out_of_core import keyrange_batched_join
-from distributed_join_tpu.utils.benchmarking import timed_join_throughput
-from distributed_join_tpu.utils.tpch import generate_tpch_join_tables, q3_filter
-
-
-def parse_args(argv=None):
-    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    p.add_argument("--scale-factor", type=float, default=0.01,
-                   help="TPC-H SF; SF-1 = 1.5M orders / ~6M lineitem rows")
-    p.add_argument("--communicator", default="tpu")
-    p.add_argument("--n-ranks", type=int, default=None)
-    p.add_argument("--iterations", type=int, default=4)
-    p.add_argument("--q3-filters", action="store_true",
-                   help="apply Q3's date predicates before the join")
-    p.add_argument("--batches", type=int, default=1,
-                   help=">1 engages the out-of-core key-range path")
-    p.add_argument("--over-decomposition-factor", type=int, default=1)
-    p.add_argument("--shuffle-capacity-factor", type=float, default=1.6)
-    p.add_argument("--out-capacity-factor", type=float, default=1.5)
-    p.add_argument("--json-output", default=None)
-    return p.parse_args(argv)
-
-
-def run(args) -> dict:
-    comm = make_communicator(args.communicator, n_ranks=args.n_ranks)
-    n = comm.n_ranks
-
-    orders, lineitem = generate_tpch_join_tables(
-        seed=42, scale_factor=args.scale_factor
-    )
-    if args.q3_filters:
-        orders, lineitem = q3_filter(orders, lineitem)
-    build = orders.rename({"o_orderkey": "key"})
-    probe = lineitem.rename({"l_orderkey": "key"})
-    rows = build.capacity + probe.capacity
-
-    if args.batches > 1:
-        # The batched path drops filter-invalidated rows on the host, so
-        # count the rows it actually moves; the warmup inside
-        # keyrange_batched_join keeps the remote compile out of the
-        # window. --iterations doesn't apply here (each batch runs once;
-        # H2D staging is part of the honest out-of-core number).
-        rows = int(build.num_valid()) + int(probe.num_valid())
-        stats = {}
-        total, overflow = keyrange_batched_join(
-            build, probe, comm,
-            n_batches=args.batches,
-            over_decomposition=args.over_decomposition_factor,
-            shuffle_capacity_factor=args.shuffle_capacity_factor,
-            out_capacity_factor=args.out_capacity_factor,
-            stats=stats,
-        )
-        sec = stats["elapsed_s"]
-        matches = total
-    else:
-        build = build.pad_to(build.capacity + (-build.capacity) % n)
-        probe = probe.pad_to(probe.capacity + (-probe.capacity) % n)
-        build, probe = comm.device_put_sharded((build, probe))
-        jax.block_until_ready((build, probe))
-        step = make_join_step(
-            comm,
-            key="key",
-            over_decomposition=args.over_decomposition_factor,
-            shuffle_capacity_factor=args.shuffle_capacity_factor,
-            out_capacity_factor=args.out_capacity_factor,
-        )
-        sec, matches, overflow = timed_join_throughput(
-            comm, step, build, probe, args.iterations,
-            dce_payload="o_totalprice",
-        )
-
-    rows_per_sec = rows / sec
-    record = {
-        "benchmark": "tpch_join",
-        "communicator": comm.name,
-        "n_ranks": n,
-        "scale_factor": args.scale_factor,
-        "orders_nrows": orders.capacity,
-        "lineitem_nrows": lineitem.capacity,
-        "q3_filters": args.q3_filters,
-        "batches": args.batches,
-        "matches_per_join": matches,
-        "overflow": overflow,
-        "elapsed_per_join_s": sec,
-        "rows_per_sec": rows_per_sec,
-        "m_rows_per_sec_per_rank": rows_per_sec / 1e6 / n,
-    }
-    print(f"tpch lineitem⋈orders SF-{args.scale_factor:g}: {rows} rows in "
-          f"{sec:.4f} s -> {rows_per_sec / 1e6:.2f} M rows/s over {n} rank(s)"
-          + (" [OVERFLOW]" if overflow else ""))
-    print(json.dumps(record))
-    if args.json_output:
-        with open(args.json_output, "w") as f:
-            json.dump(record, f, indent=2)
-    return record
-
+from distributed_join_tpu.benchmarks.tpch_join import *  # noqa: F401,F403
+from distributed_join_tpu.benchmarks.tpch_join import main, parse_args, run  # noqa: F401
 
 if __name__ == "__main__":
-    run(parse_args())
+    main()

@@ -1,0 +1,46 @@
+"""Benchmark drivers — the framework's user-facing entry points.
+
+Mirrors the reference's ``benchmark/`` executables (SURVEY.md §1
+layer 4): ``distributed_join`` (the flag-verbatim join driver),
+``all_to_all`` (shuffle-bandwidth microbenchmark), ``tpch_join``
+(BASELINE config 4). Each module exposes ``parse_args``/``run``/``main``
+and is installed as a console script (pyproject.toml); the repo-root
+``benchmark/`` directory keeps thin shims at the reference's layout.
+"""
+
+from __future__ import annotations
+
+
+def add_platform_arg(parser) -> None:
+    """The shared ``--platform`` flag (one definition for all drivers)."""
+    parser.add_argument(
+        "--platform", default=None,
+        choices=["default", "cpu", "tpu", "axon"],
+        help="cpu forces the virtual-device host backend "
+             "(multi-rank runs on a 1-chip machine)",
+    )
+
+
+def apply_platform(platform: str | None, n_ranks: int | None) -> None:
+    """Honor a driver's ``--platform`` flag BEFORE any device use.
+
+    ``cpu`` forces the host-platform fake backend with enough virtual
+    devices for ``n_ranks`` (>=8 by default) — the only way to run the
+    multi-rank drivers on a machine with one real chip. Env vars alone
+    don't work here: some environments pre-import jax with a pinned
+    platform (see tests/conftest.py), so we flip via jax.config too.
+    """
+    if platform in (None, "", "default"):
+        return
+    import os
+
+    import jax
+
+    if platform == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            count = max(8, n_ranks or 0)
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={count}"
+            ).strip()
+    jax.config.update("jax_platforms", platform)

@@ -4,18 +4,23 @@ The reference's partition step (SURVEY.md §2 "Hash partition step") is a
 Murmur3 radix scatter on GPU. Scatters are a poor fit for the TPU memory
 system, so the TPU-native formulation is sort-based (SURVEY.md §7 step 1):
 
-    hash -> bucket id -> stable sort rows by bucket -> searchsorted offsets
+    hash -> bucket id -> stable sort ROW INDICES by bucket -> offsets
 
-One ``lax.sort`` over the shard dominates; everything else fuses. The
-result is exactly what the reference's all-to-all needs: rows grouped by
-destination bucket plus a per-bucket offset/count vector (the reference
-exchanges the same counts in its metadata all-to-all, SURVEY.md §2
-"Size-exchange helper").
+The sort carries only (bucket id, row index) — two int32 lanes; data
+columns are never moved by the sort. ``to_padded`` then gathers each
+column directly from the ORIGINAL table through the composed index
+``order[bucket_offset + lane]``, so every column is touched by exactly
+one gather on its way into the collective (round 1 materialized a fully
+sorted table first and paid a second full gather in ``to_padded``; on
+this TPU random gathers at 10M rows cost ~100-300ms each — twice the
+sort itself — so the composition halves the partition's real cost).
 
-``PartitionedTable.to_padded`` lays the buckets out as a dense
-``(n_buckets, capacity)`` block for the fixed-shape collective; overflow
-(a bucket larger than the static capacity) is reported per call so the
-caller can re-run with a bigger pad or trigger the skew path.
+The result is exactly what the reference's all-to-all needs: rows
+grouped by destination bucket plus a per-bucket offset/count vector
+(the reference exchanges the same counts in its metadata all-to-all,
+SURVEY.md §2 "Size-exchange helper"). Overflow (a bucket larger than
+the static capacity) is reported per call so the caller can re-run with
+a bigger pad or trigger the skew path.
 """
 
 from __future__ import annotations
@@ -33,22 +38,34 @@ from distributed_join_tpu.table import Table
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class PartitionedTable:
-    """Rows stably sorted by bucket; valid rows form a prefix.
+    """A bucket-sorted VIEW of a table: the rows stay where they are;
+    ``order`` holds the stable bucket-sorted row permutation (invalid
+    rows sort after every real bucket).
 
     Attributes:
-      table:   sorted rows (invalid rows sort after every bucket).
+      source:  the original (unsorted) table.
+      order:   (capacity,) int32 row permutation, bucket-sorted.
       offsets: (n_buckets + 1,) int32; bucket b occupies
-               rows [offsets[b], offsets[b+1]).
+               ``order[offsets[b] : offsets[b+1]]``.
       counts:  (n_buckets,) int32 == diff(offsets).
     """
 
-    table: Table
+    source: Table
+    order: jax.Array
     offsets: jax.Array
     counts: jax.Array
 
     @property
     def n_buckets(self) -> int:
         return self.counts.shape[0]
+
+    @property
+    def table(self) -> Table:
+        """Materialized sorted view (one gather per column). The hot
+        path never calls this — ``to_padded`` gathers through ``order``
+        directly; it exists for tests/debugging."""
+        cols = {n: c[self.order] for n, c in self.source.columns.items()}
+        return Table(cols, self.source.valid[self.order])
 
     def to_padded(self, capacity: int, bucket_start: int = 0,
                   n_buckets: int | None = None):
@@ -68,11 +85,14 @@ class PartitionedTable:
         offs = self.offsets[bucket_start : bucket_start + nb]
         counts = self.counts[bucket_start : bucket_start + nb]
         lane = jnp.arange(capacity, dtype=jnp.int32)
-        idx = offs[:, None] + lane[None, :]
+        pos = offs[:, None] + lane[None, :]
         row_valid = lane[None, :] < counts[:, None]
-        cap_total = self.table.capacity
-        safe = jnp.clip(idx, 0, cap_total - 1)
-        padded = {n: c[safe] for n, c in self.table.columns.items()}
+        cap_total = self.source.capacity
+        # Compose the bucket-slot -> sorted-position -> source-row maps
+        # so each data column is gathered ONCE, straight into its padded
+        # layout.
+        idx = self.order[jnp.clip(pos, 0, cap_total - 1)]
+        padded = {n: c[idx] for n, c in self.source.columns.items()}
         overflow = jnp.any(counts > capacity)
         return padded, jnp.minimum(counts, capacity), overflow, row_valid
 
@@ -84,15 +104,18 @@ def radix_hash_partition(
     b = bucket_ids([table.columns[c] for c in key_cols], n_buckets)
     # Padding rows get bucket n_buckets so they sort after every real bucket.
     b = jnp.where(table.valid, b, jnp.int32(n_buckets))
-    order = jnp.argsort(b, stable=True)
-    sorted_b = b[order]
-    cols = {n: c[order] for n, c in table.columns.items()}
-    valid = table.valid[order]
+    # One stable 32-bit sort (bucket id key + int32 row index) — NOT
+    # jnp.argsort, whose x64-mode int64 iota operand would double every
+    # sort lane on TPU (emulated 64-bit).
+    n = b.shape[0]
+    sorted_b, order = jax.lax.sort(
+        (b, jnp.arange(n, dtype=jnp.int32)), num_keys=1, is_stable=True
+    )
     offsets = jnp.searchsorted(
         sorted_b, jnp.arange(n_buckets + 1, dtype=jnp.int32), side="left"
     ).astype(jnp.int32)
     counts = jnp.diff(offsets)
-    return PartitionedTable(Table(cols, valid), offsets, counts)
+    return PartitionedTable(table, order, offsets, counts)
 
 
 def unpad(padded_columns, counts, capacity: int) -> Table:

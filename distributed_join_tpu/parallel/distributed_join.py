@@ -167,18 +167,32 @@ def make_join_step(
             probe_local = Table(probe_local.columns,
                                 probe_local.valid & ~is_hh_p)
 
-        ptb = radix_hash_partition(build_local, keys, nb)
-        ptp = radix_hash_partition(probe_local, keys, nb)
-        for b in range(k):
-            recv_build, ovf_b = _batch_shuffle(comm, ptb, b, n, b_cap)
-            recv_probe, ovf_p = _batch_shuffle(comm, ptp, b, n, p_cap)
+        if nb == 1:
+            # Single rank, single batch: the partition is one all-rows
+            # bucket and the shuffle is an identity — both pure row
+            # permutations. Skip them entirely (the join handles masked
+            # validity natively); this is the reference's 1-rank path,
+            # which also partitions into nranks=1 buckets and joins.
             res = sort_merge_inner_join(
-                recv_build, recv_probe, keys, out_cap,
+                build_local, probe_local, keys, out_cap,
                 build_payload=build_payload, probe_payload=probe_payload,
             )
             parts.append(res.table)
             total = total + res.total.astype(jnp.int64)
-            overflow = overflow | ovf_b | ovf_p | res.overflow
+            overflow = overflow | res.overflow
+        else:
+            ptb = radix_hash_partition(build_local, keys, nb)
+            ptp = radix_hash_partition(probe_local, keys, nb)
+            for b in range(k):
+                recv_build, ovf_b = _batch_shuffle(comm, ptb, b, n, b_cap)
+                recv_probe, ovf_p = _batch_shuffle(comm, ptp, b, n, p_cap)
+                res = sort_merge_inner_join(
+                    recv_build, recv_probe, keys, out_cap,
+                    build_payload=build_payload, probe_payload=probe_payload,
+                )
+                parts.append(res.table)
+                total = total + res.total.astype(jnp.int64)
+                overflow = overflow | ovf_b | ovf_p | res.overflow
         out = Table(
             {
                 name: jnp.concatenate([t.columns[name] for t in parts])

@@ -50,12 +50,54 @@ def hash_combine_np(seed: np.ndarray, h: np.ndarray) -> np.ndarray:
     )
 
 
+def fmix32_np(x: np.ndarray) -> np.ndarray:
+    """numpy mirror of ops.hashing.fmix32."""
+    h = x.astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    h *= np.uint32(0x85EBCA6B)
+    h ^= h >> np.uint32(13)
+    h *= np.uint32(0xC2B2AE35)
+    h ^= h >> np.uint32(16)
+    return h
+
+
+def _hash_one_np(col: np.ndarray) -> np.ndarray:
+    """numpy mirror of ops.hashing._hash_one's per-dtype dispatch.
+
+    Bit-exact with the device hash for integer and float32 keys. For
+    float64 the mirror follows the same arithmetic decomposition, but
+    np.log2/np.exp2 and XLA's may differ in the last ulp near powers of
+    two, so cross-layer agreement is NOT guaranteed for f64 — batch
+    correctness only needs host-internal consistency (both sides of a
+    key batch by the same host hash), which always holds."""
+    dt = col.dtype
+    if dt in (np.dtype(np.int64), np.dtype(np.uint64)):
+        return fmix64_np(col)
+    if dt in (np.dtype(t) for t in
+              (np.int32, np.uint32, np.int16, np.uint16, np.int8, np.uint8)):
+        return fmix32_np(col).astype(np.uint64)
+    if dt == np.dtype(np.float64):
+        # Mirrors the device's arithmetic f64 decomposition (hashing.py
+        # _hash_one): |x| = m * 2**e with m in [1, 2), mantissa scaled
+        # to 52 bits; sign folded into the exponent hash.
+        a = np.abs(col)
+        with np.errstate(divide="ignore"):
+            e = np.where(a > 0, np.floor(np.log2(a)), 0.0)
+        m = np.where(a > 0, a / np.exp2(e), 0.0)
+        mi = (m * (2.0 ** 52)).astype(np.int64).astype(np.uint64)
+        ebits = e.astype(np.int32) ^ (col < 0).astype(np.int32) << 30
+        return hash_combine_np(fmix64_np(mi), fmix32_np(ebits).astype(np.uint64))
+    if dt == np.dtype(np.float32):
+        return fmix32_np(col.view(np.uint32)).astype(np.uint64)
+    raise TypeError(f"unhashable key dtype {dt}")
+
+
 def hash_columns_np(cols) -> np.ndarray:
-    """numpy mirror of ops.hashing.hash_columns for integer key
-    columns (composite keys batch by the combined hash)."""
-    acc = fmix64_np(cols[0])
+    """numpy mirror of ops.hashing.hash_columns (composite keys batch by
+    the combined hash); per-dtype dispatch matches the device exactly."""
+    acc = _hash_one_np(cols[0])
     for c in cols[1:]:
-        acc = hash_combine_np(acc, fmix64_np(c))
+        acc = hash_combine_np(acc, _hash_one_np(c))
     return acc
 
 
@@ -67,6 +109,10 @@ def key_batch_ids(keys, n_batches: int) -> np.ndarray:
     the same batch on both sides."""
     cols = keys if isinstance(keys, (list, tuple)) else [keys]
     h = hash_columns_np([np.asarray(c) for c in cols])
+    # Re-mix before taking the upper bits: 32-bit key dtypes hash via
+    # fmix32 widened to uint64, whose top 32 bits are all zero — without
+    # this pass every row of such a column would land in batch 0.
+    h = fmix64_np(h)
     return ((h >> np.uint64(40)) % np.uint64(n_batches)).astype(np.int64)
 
 

@@ -128,9 +128,13 @@ class Table:
         return Table(cols, idx_valid & self.valid[safe])
 
     def compact(self) -> "Table":
-        """Stable-move valid rows to a prefix (one extra sort)."""
-        order = jnp.argsort(~self.valid, stable=True)
-        cols = {n: c[order] for n, c in self.columns.items()}
+        """Stable-move valid rows to a prefix (one extra 32-bit sort)."""
+        n = self.capacity
+        _, order = jax.lax.sort(
+            ((~self.valid).astype(jnp.int8), jnp.arange(n, dtype=jnp.int32)),
+            num_keys=1, is_stable=True,
+        )
+        cols = {name: c[order] for name, c in self.columns.items()}
         return Table(cols, self.valid[order])
 
     # -- host-side helpers (NOT jittable) -----------------------------

@@ -70,6 +70,15 @@ def encode_int_strings(ids: np.ndarray, prefix: str = "itm-",
     """Vectorized '<prefix><zero-padded id>' encoding — generator-scale
     string payloads without a Python loop over millions of rows."""
     ids = np.asarray(ids)
+    # Same no-silent-corruption contract as encode_strings: dropping
+    # high digits (or floor-division artifacts on negatives — -1 renders
+    # as all 9s) would collide distinct ids into one payload string.
+    if ids.size and int(ids.max()) >= 10 ** digits:
+        raise ValueError(
+            f"id {int(ids.max())} needs more than digits={digits} digits"
+        )
+    if ids.size and int(ids.min()) < 0:
+        raise ValueError(f"negative id {int(ids.min())} is not encodable")
     praw = prefix.encode("utf-8")
     width = len(praw) + digits
     out = np.empty((ids.shape[0], width), dtype=np.uint8)

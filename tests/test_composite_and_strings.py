@@ -6,10 +6,7 @@ import numpy as np
 import pytest
 
 import distributed_join_tpu as dj
-from distributed_join_tpu.ops.join import (
-    composite_key_ids,
-    sort_merge_inner_join,
-)
+from distributed_join_tpu.ops.join import sort_merge_inner_join
 from distributed_join_tpu.table import Table
 from distributed_join_tpu.utils.generators import (
     generate_composite_build_probe_tables,
@@ -21,18 +18,25 @@ from distributed_join_tpu.utils.strings import (
 )
 
 
-def test_composite_key_ids_group_equal_tuples():
-    b0 = jnp.array([1, 1, 2, 3], dtype=jnp.int64)
-    b1 = jnp.array([9, 8, 9, 9], dtype=jnp.int64)
-    p0 = jnp.array([1, 1, 4], dtype=jnp.int64)
-    p1 = jnp.array([9, 7, 9], dtype=jnp.int64)
-    bg, pg = composite_key_ids([b0, b1], [p0, p1])
-    bg, pg = np.asarray(bg), np.asarray(pg)
-    assert bg[0] == pg[0]            # (1,9) == (1,9)
-    assert bg[1] != pg[0]            # (1,8) != (1,9)
-    assert pg[1] not in bg.tolist()  # (1,7) matches nothing
-    assert pg[2] not in bg.tolist()  # (4,9) matches nothing
-    assert len({bg[0], bg[1], bg[2], bg[3]}) == 4  # all distinct tuples
+def test_composite_join_matches_equal_tuples_only():
+    # Tuples join iff ALL key columns are equal — the multi-operand
+    # merged sort must not mix rows that agree on a prefix of the key.
+    build = Table.from_dense({
+        "k0": jnp.array([1, 1, 2, 3], dtype=jnp.int64),
+        "k1": jnp.array([9, 8, 9, 9], dtype=jnp.int64),
+        "bp": jnp.array([0, 1, 2, 3], dtype=jnp.int64),
+    })
+    probe = Table.from_dense({
+        "k0": jnp.array([1, 1, 4], dtype=jnp.int64),
+        "k1": jnp.array([9, 7, 9], dtype=jnp.int64),
+        "pp": jnp.array([0, 1, 2], dtype=jnp.int64),
+    })
+    res = sort_merge_inner_join(build, probe, ["k0", "k1"], out_capacity=8)
+    # Only (1,9) appears on both sides.
+    assert int(res.total) == 1
+    df = res.table.to_pandas()
+    assert df["k0"].tolist() == [1] and df["k1"].tolist() == [9]
+    assert df["bp"].tolist() == [0] and df["pp"].tolist() == [0]
 
 
 def test_single_device_composite_join_vs_oracle():
