@@ -11,6 +11,24 @@ and is installed as a console script (pyproject.toml); the repo-root
 from __future__ import annotations
 
 
+def report(headline: str, record: dict, json_output: str | None) -> None:
+    """Rank-0-only result reporting, shared by every driver: a
+    reference-shaped stdout line, the JSON record, and the optional
+    ``--json-output`` file (the reference prints from MPI rank 0,
+    SURVEY.md §3.1 final step)."""
+    import json
+
+    from distributed_join_tpu.parallel.bootstrap import is_coordinator
+
+    if not is_coordinator():
+        return
+    print(headline)
+    print(json.dumps(record))
+    if json_output:
+        with open(json_output, "w") as f:
+            json.dump(record, f, indent=2)
+
+
 def add_platform_arg(parser) -> None:
     """The shared ``--platform`` flag (one definition for all drivers)."""
     parser.add_argument(
@@ -29,7 +47,18 @@ def apply_platform(platform: str | None, n_ranks: int | None) -> None:
     multi-rank drivers on a machine with one real chip. Env vars alone
     don't work here: some environments pre-import jax with a pinned
     platform (see tests/conftest.py), so we flip via jax.config too.
+
+    When the process was started by ``tpu-launch`` (DJTPU_* env set),
+    the multi-host bootstrap owns platform + device count and
+    ``--platform`` is ignored: the handshake must happen before any
+    device use, exactly here.
     """
+    from distributed_join_tpu.parallel.bootstrap import (
+        maybe_initialize_from_env,
+    )
+
+    if maybe_initialize_from_env():
+        return
     if platform in (None, "", "default"):
         return
     import os

@@ -19,13 +19,16 @@ diagonal block stays local), and we report aggregate off-chip GB/s =
 from __future__ import annotations
 
 import argparse
-import json
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from distributed_join_tpu.benchmarks import add_platform_arg, apply_platform
+from distributed_join_tpu.benchmarks import (
+    add_platform_arg,
+    apply_platform,
+    report,
+)
 from distributed_join_tpu.parallel.communicator import make_communicator
 from distributed_join_tpu.utils.benchmarking import measure
 
@@ -93,15 +96,14 @@ def run(args) -> dict:
         "aggregate_offchip_gb_per_sec": n * egress / sec / 1e9,
         "aggregate_gb_per_sec_incl_local": n * bytes_per_rank / sec / 1e9,
     }
-    print(f"all-to-all: {n} ranks x {bytes_per_rank / 1e6:.1f} MB in "
-          f"{sec * 1e3:.3f} ms -> "
-          f"{record['aggregate_offchip_gb_per_sec']:.2f} GB/s off-chip "
-          f"({record['aggregate_gb_per_sec_incl_local']:.2f} GB/s incl. "
-          f"local block)")
-    print(json.dumps(record))
-    if args.json_output:
-        with open(args.json_output, "w") as f:
-            json.dump(record, f, indent=2)
+    report(
+        f"all-to-all: {n} ranks x {bytes_per_rank / 1e6:.1f} MB in "
+        f"{sec * 1e3:.3f} ms -> "
+        f"{record['aggregate_offchip_gb_per_sec']:.2f} GB/s off-chip "
+        f"({record['aggregate_gb_per_sec_incl_local']:.2f} GB/s incl. "
+        f"local block)",
+        record, args.json_output,
+    )
     return record
 
 

@@ -24,13 +24,16 @@ Flags this framework adds: --n-ranks --iterations
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 
 import jax
 import jax.numpy as jnp
 
-from distributed_join_tpu.benchmarks import add_platform_arg, apply_platform
+from distributed_join_tpu.benchmarks import (
+    add_platform_arg,
+    apply_platform,
+    report,
+)
 from distributed_join_tpu.parallel.communicator import make_communicator
 from distributed_join_tpu.parallel.distributed_join import make_join_step
 from distributed_join_tpu.utils.benchmarking import timed_join_throughput
@@ -198,16 +201,13 @@ def run(args) -> dict:
         "rows_per_sec": rows_per_sec,
         "m_rows_per_sec_per_rank": rows_per_sec / 1e6 / n,
     }
-    # Rank-0-style stdout line, shape-compatible with the reference's
-    # report (SURVEY.md §3.1 final step).
-    print(f"distributed join: {rows} rows in {sec_per_join:.4f} s -> "
-          f"{rows_per_sec / 1e6:.2f} M rows/s over {n} rank(s)"
-          + (" [OVERFLOW — rerun with larger capacity factors]"
-             if overflow else ""))
-    print(json.dumps(record))
-    if args.json_output:
-        with open(args.json_output, "w") as f:
-            json.dump(record, f, indent=2)
+    report(
+        f"distributed join: {rows} rows in {sec_per_join:.4f} s -> "
+        f"{rows_per_sec / 1e6:.2f} M rows/s over {n} rank(s)"
+        + (" [OVERFLOW — rerun with larger capacity factors]"
+           if overflow else ""),
+        record, args.json_output,
+    )
     return record
 
 

@@ -1,0 +1,90 @@
+"""Multi-process launcher — the framework's ``mpirun`` equivalent.
+
+The reference ships run scripts that ``mpirun -n N`` its benchmark
+executables with UCX/NCCL env tuning (SURVEY.md §2 "Run scripts"). The
+TPU equivalent launches one process per host (or an emulated set on one
+machine) with the ``DJTPU_*`` bootstrap env
+(:mod:`..parallel.bootstrap`) and a coordinator address:
+
+  # 2 emulated hosts x 4 virtual CPU devices, any driver command:
+  tpu-launch --num-processes 2 --cpu-devices-per-process 4 -- \
+      tpu-distributed-join --build-table-nrows 100000 ...
+
+  # real multi-host TPU: run ONE process per host, pointing at the
+  # coordinator (process 0's host):
+  tpu-launch --num-processes 4 --process-id $HOST_ID \
+      --coordinator host0:9876 -- tpu-tpch-join --scale-factor 100
+
+With ``--process-id`` the launcher execs the command for that single
+process (one invocation per host, like one mpirun task); without it,
+all processes spawn locally (the CPU-emulation / single-host case).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+from distributed_join_tpu.parallel.bootstrap import (
+    ENV_COORDINATOR,
+    ENV_CPU_DEVICES,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--num-processes", type=int, required=True)
+    p.add_argument("--process-id", type=int, default=None,
+                   help="run only this process (one launcher per host); "
+                        "default: spawn all processes locally")
+    p.add_argument("--coordinator", default="localhost:9876",
+                   help="host:port of process 0's coordinator service")
+    p.add_argument("--cpu-devices-per-process", type=int, default=None,
+                   help="emulate this many virtual CPU devices per "
+                        "process (no-TPU validation path, gloo transport)")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="driver command to launch (prefix with --)")
+    args = p.parse_args(argv)
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no driver command given (append: -- <driver> [args...])")
+    args.command = cmd
+    return args
+
+
+def _env_for(args, pid: int) -> dict:
+    env = dict(os.environ)
+    env[ENV_COORDINATOR] = args.coordinator
+    env[ENV_NUM_PROCESSES] = str(args.num_processes)
+    env[ENV_PROCESS_ID] = str(pid)
+    if args.cpu_devices_per_process is not None:
+        env[ENV_CPU_DEVICES] = str(args.cpu_devices_per_process)
+    return env
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.process_id is not None:
+        # One process on this host: exec in place, mpirun-task style.
+        os.execvpe(args.command[0], args.command,
+                   _env_for(args, args.process_id))
+
+    procs = [
+        subprocess.Popen(args.command, env=_env_for(args, pid))
+        for pid in range(args.num_processes)
+    ]
+    rc = 0
+    for p in procs:
+        code = p.wait()  # always reap every process, even after a failure
+        rc = rc or code
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

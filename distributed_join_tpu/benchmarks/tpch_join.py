@@ -18,12 +18,15 @@ honest number for an out-of-core join.
 from __future__ import annotations
 
 import argparse
-import json
 import time
 
 import jax
 
-from distributed_join_tpu.benchmarks import add_platform_arg, apply_platform
+from distributed_join_tpu.benchmarks import (
+    add_platform_arg,
+    apply_platform,
+    report,
+)
 from distributed_join_tpu.parallel.communicator import make_communicator
 from distributed_join_tpu.parallel.distributed_join import make_join_step
 from distributed_join_tpu.parallel.out_of_core import keyrange_batched_join
@@ -42,6 +45,11 @@ def parse_args(argv=None):
                    help="apply Q3's date predicates before the join")
     p.add_argument("--batches", type=int, default=1,
                    help=">1 engages the out-of-core key-range path")
+    p.add_argument("--host-generator", action="store_true",
+                   help="generate on host (numpy, chunked) and stream "
+                        "key-range batches to the device — required "
+                        "beyond SF ~1 (device HBM); implies --batches "
+                        "semantics even at --batches 1")
     p.add_argument("--over-decomposition-factor", type=int, default=1)
     p.add_argument("--shuffle-capacity-factor", type=float, default=1.6)
     p.add_argument("--out-capacity-factor", type=float, default=1.5)
@@ -54,6 +62,47 @@ def run(args) -> dict:
     apply_platform(args.platform, args.n_ranks)
     comm = make_communicator(args.communicator, n_ranks=args.n_ranks)
     n = comm.n_ranks
+
+    if args.host_generator:
+        from distributed_join_tpu.parallel.out_of_core import (
+            batched_join_host,
+        )
+        from distributed_join_tpu.utils.tpch_host import (
+            generate_tpch_host_batches,
+            rename_batches,
+        )
+
+        gen_t0 = time.perf_counter()
+        ob, lb = generate_tpch_host_batches(
+            seed=42,
+            scale_factor=args.scale_factor,
+            n_batches=args.batches,
+            q3_filters=args.q3_filters,
+        )
+        gen_s = time.perf_counter() - gen_t0
+        build_b = rename_batches(ob, {"o_orderkey": "key"})
+        probe_b = rename_batches(lb, {"l_orderkey": "key"})
+        orders_rows = sum(b["key"].shape[0] for b in build_b)
+        lineitem_rows = sum(b["key"].shape[0] for b in probe_b)
+        rows = orders_rows + lineitem_rows
+
+        stats = {}
+        total, overflow = batched_join_host(
+            build_b, probe_b, comm,
+            over_decomposition=args.over_decomposition_factor,
+            shuffle_capacity_factor=args.shuffle_capacity_factor,
+            out_capacity_factor=args.out_capacity_factor,
+            stats=stats,
+        )
+        sec = stats["elapsed_s"]
+        record_extra = {
+            "host_generator": True,
+            "generate_s": gen_s,
+            "batch_build_capacity": stats["build_capacity"],
+            "batch_probe_capacity": stats["probe_capacity"],
+        }
+        return _report(args, comm, orders_rows, lineitem_rows, rows,
+                       total, overflow, sec, record_extra)
 
     orders, lineitem = generate_tpch_join_tables(
         seed=42, scale_factor=args.scale_factor
@@ -99,14 +148,23 @@ def run(args) -> dict:
             dce_payload="o_totalprice",
         )
 
+    # Valid-row counts (post-filter), same semantics as the host path.
+    return _report(args, comm, int(orders.num_valid()),
+                   int(lineitem.num_valid()),
+                   rows, matches, overflow, sec, {})
+
+
+def _report(args, comm, orders_rows, lineitem_rows, rows,
+            matches, overflow, sec, extra) -> dict:
+    n = comm.n_ranks
     rows_per_sec = rows / sec
     record = {
         "benchmark": "tpch_join",
         "communicator": comm.name,
         "n_ranks": n,
         "scale_factor": args.scale_factor,
-        "orders_nrows": orders.capacity,
-        "lineitem_nrows": lineitem.capacity,
+        "orders_nrows": orders_rows,
+        "lineitem_nrows": lineitem_rows,
         "q3_filters": args.q3_filters,
         "batches": args.batches,
         "matches_per_join": matches,
@@ -114,14 +172,14 @@ def run(args) -> dict:
         "elapsed_per_join_s": sec,
         "rows_per_sec": rows_per_sec,
         "m_rows_per_sec_per_rank": rows_per_sec / 1e6 / n,
+        **extra,
     }
-    print(f"tpch lineitem⋈orders SF-{args.scale_factor:g}: {rows} rows in "
-          f"{sec:.4f} s -> {rows_per_sec / 1e6:.2f} M rows/s over {n} rank(s)"
-          + (" [OVERFLOW]" if overflow else ""))
-    print(json.dumps(record))
-    if args.json_output:
-        with open(args.json_output, "w") as f:
-            json.dump(record, f, indent=2)
+    report(
+        f"tpch lineitem⋈orders SF-{args.scale_factor:g}: {rows} rows "
+        f"in {sec:.4f} s -> {rows_per_sec / 1e6:.2f} M rows/s over "
+        f"{n} rank(s)" + (" [OVERFLOW]" if overflow else ""),
+        record, args.json_output,
+    )
     return record
 
 

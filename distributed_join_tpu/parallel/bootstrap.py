@@ -1,0 +1,110 @@
+"""Multi-host bootstrap — the reference's MPI control plane, TPU-native.
+
+The reference launches one OS process per GPU under ``mpirun``; MPI is
+pure control plane (rank/size, ``ncclGetUniqueId`` broadcast, barriers)
+while NCCL/UCX own the data plane (SURVEY.md §3.3). The 1:1 TPU mapping:
+
+- control plane: ``jax.distributed.initialize(coordinator_address,
+  num_processes, process_id)`` — a TCP/DCN handshake with a coordinator
+  replaces ``MPI_Bcast`` of the NCCL id;
+- data plane: unchanged — after initialization ``jax.devices()`` spans
+  every process's chips, the 1-D rank mesh covers the whole slice, and
+  the SAME compiled ``shard_map`` program runs on it, XLA routing
+  collectives over ICI within a host and DCN across hosts.
+
+Nothing else in the framework changes for multi-host: the
+``Communicator`` is already built on the global ``jax.devices()`` view.
+
+Configuration comes from flags or the ``DJTPU_*`` environment variables
+(set by ``scripts/launch_multiprocess.py``, the framework's ``mpirun``
+equivalent):
+
+  DJTPU_COORDINATOR    host:port of process 0 (the coordinator)
+  DJTPU_NUM_PROCESSES  total process count
+  DJTPU_PROCESS_ID     this process's id in [0, num_processes)
+
+For a no-TPU validation path (the reference cannot do this at all —
+its multi-rank tests need real GPUs under mpirun, SURVEY.md §4), set
+``DJTPU_CPU_DEVICES_PER_PROCESS=k``: each process presents ``k``
+virtual CPU devices and cross-process collectives run over the gloo CPU
+backend — verified working in this environment (2 procs x 4 devices).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_COORDINATOR = "DJTPU_COORDINATOR"
+ENV_NUM_PROCESSES = "DJTPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "DJTPU_PROCESS_ID"
+ENV_CPU_DEVICES = "DJTPU_CPU_DEVICES_PER_PROCESS"
+
+
+def initialize(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    cpu_devices_per_process: Optional[int] = None,
+) -> None:
+    """Join the distributed runtime. Call BEFORE any other jax use —
+    like ``MPI_Init``, this must precede every collective/device call.
+
+    ``cpu_devices_per_process`` switches to the virtual-CPU data plane
+    (gloo): multi-host semantics without TPU hardware.
+    """
+    import jax
+
+    # Record the identity for process_id()/is_coordinator() even when
+    # this is called directly (one invocation per host) rather than via
+    # the tpu-launch env.
+    os.environ[ENV_NUM_PROCESSES] = str(num_processes)
+    os.environ[ENV_PROCESS_ID] = str(process_id)
+
+    if cpu_devices_per_process is not None:
+        # OVERRIDE any inherited device-count flag (e.g. a test harness
+        # parent sets 8): each launched process must present exactly
+        # cpu_devices_per_process devices or the global mesh is wrong.
+        flags = [
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(
+            "--xla_force_host_platform_device_count="
+            f"{cpu_devices_per_process}"
+        )
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+        # Cross-process CPU collectives need an explicit transport.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def maybe_initialize_from_env() -> bool:
+    """Initialize iff the ``DJTPU_*`` launch env is present; returns
+    whether it did. Drivers call this first thing, so a single-process
+    run (no env) is untouched and a launched run joins its slice."""
+    coord = os.environ.get(ENV_COORDINATOR)
+    if not coord:
+        return False
+    nproc = int(os.environ[ENV_NUM_PROCESSES])
+    pid = int(os.environ[ENV_PROCESS_ID])
+    cpu = os.environ.get(ENV_CPU_DEVICES)
+    initialize(coord, nproc, pid,
+               cpu_devices_per_process=int(cpu) if cpu else None)
+    return True
+
+
+def process_id() -> int:
+    """This process's id (0 when not launched distributed) — the
+    reference's ``rank`` for rank-0-only printing."""
+    return int(os.environ.get(ENV_PROCESS_ID, "0"))
+
+
+def is_coordinator() -> bool:
+    return process_id() == 0

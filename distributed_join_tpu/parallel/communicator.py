@@ -129,8 +129,19 @@ class TpuCommunicator(Communicator):
         return jax.jit(mapped)
 
     def device_put_sharded(self, tree):
-        """Place a pytree of host arrays row-sharded over the mesh."""
+        """Place a pytree of host arrays row-sharded over the mesh.
+
+        Multi-host (``jax.process_count() > 1``): every process passes
+        the same GLOBAL value (deterministic generators make this free)
+        and keeps only its addressable shards — the multi-controller
+        contract. Device-backed leaves are pulled to host first;
+        ``device_put`` requires addressable-only sources there.
+        """
         sharding = NamedSharding(self.mesh, P(self.axis_name))
+        if jax.process_count() > 1:
+            import numpy as np
+
+            tree = jax.tree.map(np.asarray, tree)
         return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
 
 

@@ -1,0 +1,95 @@
+"""Does index locality change TPU gather/scatter cost? And what does a
+payload-carrying sort cost? Decides the ops/join.py round-2 rewrite.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_gather.py
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import distributed_join_tpu  # noqa: F401
+
+N = 10_000_000
+OUT = 7_500_000
+ITERS = 8
+
+
+def timeit(name, make_body, *args):
+    def looped(*args):
+        def body(i, acc):
+            return acc + make_body(i + acc % 2, *args).astype(jnp.int64)
+
+        return lax.fori_loop(0, ITERS, body, jnp.int64(0))
+
+    fn = jax.jit(looped)
+    int(fn(*args))
+    t0 = time.perf_counter()
+    int(fn(*args))
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:52s} {dt * 1e3:9.1f} ms", flush=True)
+    return dt
+
+
+def main():
+    k = jax.random.PRNGKey(0)
+    n = 2 * N
+    src64 = jax.random.randint(k, (n,), 0, 1 << 62, dtype=jnp.int64)
+    rand_idx = jax.random.randint(k, (OUT,), 0, n, dtype=jnp.int32)
+    sort_idx = jnp.sort(rand_idx)
+    # "expansion-like": mostly-monotone with small runs of repeats
+    exp_idx = jnp.minimum((jnp.arange(OUT, dtype=jnp.int32) * 8) // 3, n - 1)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    tag = (iota % 2).astype(jnp.int8)
+    vals = iota
+    jax.block_until_ready((src64, rand_idx, sort_idx, exp_idx))
+
+    timeit("gather 7.5M/20M i64 RANDOM idx",
+           lambda i, c, s: c[(s + i) % n][0], src64, rand_idx)
+    timeit("gather 7.5M/20M i64 SORTED idx",
+           lambda i, c, s: c[jnp.minimum(s + i, n - 1)][0], src64, sort_idx)
+    timeit("gather 7.5M/20M i64 MONOTONE-RUN idx",
+           lambda i, c, s: c[jnp.minimum(s + i, n - 1)][0], src64, exp_idx)
+    timeit("gather 7.5M/20M i32 SORTED idx",
+           lambda i, c, s: c[jnp.minimum(s + i, n - 1)][0], iota, sort_idx)
+    timeit("take_along monotone via dynamic_slice-free iota add",
+           lambda i, c: c[jnp.minimum(iota[:OUT] + i, n - 1)][0], src64)
+
+    timeit("scatter-max 20M->7.5M RANDOM slots",
+           lambda i, s, v: jnp.zeros((OUT,), jnp.int32)
+           .at[(s + i) % OUT].max(v, mode="drop")[0],
+           rand_idx, vals[:OUT])
+    mono_slots = (jnp.arange(n, dtype=jnp.int32) * 3) // 8
+    timeit("scatter-max 20M->7.5M MONOTONE slots",
+           lambda i, s, v: jnp.zeros((OUT,), jnp.int32)
+           .at[jnp.minimum(s + i, OUT - 1)].max(v, mode="drop")[0],
+           mono_slots, vals)
+    timeit("scatter-set 20M->10M MONOTONE unique-ish",
+           lambda i, s, v: jnp.zeros((N,), jnp.int32)
+           .at[jnp.minimum(s + i, N - 1)].set(v, mode="drop")[0],
+           (iota * 2) % N, vals)
+
+    # sort with payload operands riding along
+    timeit("sort 20M (i64,i8,i32) [base]",
+           lambda i, a, t, x: lax.sort((a + i, t, x), num_keys=2)[2][0],
+           src64, tag, vals)
+    timeit("sort 20M (i64,i8,i32,+1x i64 payload)",
+           lambda i, a, t, x: lax.sort(
+               (a + i, t, x, a), num_keys=2)[3][0],
+           src64, tag, vals)
+    timeit("sort 20M (i64,i8,i32,+2x i64 payload)",
+           lambda i, a, t, x: lax.sort(
+               (a + i, t, x, a, a), num_keys=2)[4][0],
+           src64, tag, vals)
+    timeit("sort 20M (i64,i8,i32,+4x i64 payload)",
+           lambda i, a, t, x: lax.sort(
+               (a + i, t, x, a, a, a, a), num_keys=2)[6][0],
+           src64, tag, vals)
+
+
+if __name__ == "__main__":
+    main()

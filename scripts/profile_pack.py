@@ -1,0 +1,96 @@
+"""Do 2-D row gathers amortize over columns? Can one packed i64
+scatter replace two i32 scatters? Final inputs to the join rewrite.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_pack.py
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import distributed_join_tpu  # noqa: F401
+
+N = 10_000_000
+OUT = 7_500_000
+ITERS = 8
+
+
+def timeit(name, make_body, *args):
+    def looped(*args):
+        def body(i, acc):
+            return acc + make_body(i + acc % 2, *args).astype(jnp.int64)
+
+        return lax.fori_loop(0, ITERS, body, jnp.int64(0))
+
+    fn = jax.jit(looped)
+    int(fn(*args))
+    t0 = time.perf_counter()
+    int(fn(*args))
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{name:52s} {dt * 1e3:9.1f} ms", flush=True)
+    return dt
+
+
+def main():
+    k = jax.random.PRNGKey(0)
+    n = 2 * N
+    idx = jax.random.randint(k, (OUT,), 0, N, dtype=jnp.int32)
+    col = jax.random.randint(k, (N,), 0, 1 << 62, dtype=jnp.int64)
+    iota_n = jnp.arange(n, dtype=jnp.int32)
+    jax.block_until_ready((idx, col))
+
+    for kk in (1, 2, 3, 4):
+        pack = jnp.stack([col + j for j in range(kk)], axis=1)
+        jax.block_until_ready(pack)
+        timeit(f"row-gather 7.5M x ({kk},) i64 cols",
+               lambda i, c, s: c[(s + i) % N][0, 0],
+               pack, idx)
+    timeit("3 separate 7.5M i64 gathers (fused program)",
+           lambda i, c, s: (col[(s + i) % N][0] + (col + 1)[(s + i) % N][0]
+                            + (col + 2)[(s + i) % N][0]),
+           col, idx)
+    timeit("pack construction: stack 3 i64 cols of 10M",
+           lambda i, c: jnp.stack([c + i, c + 1, c + 2], axis=1)[0, 0], col)
+
+    # one packed i64 scatter vs two i32 scatters (20M operands -> 7.5M)
+    slots = jax.random.randint(k, (n,), 0, OUT + n, dtype=jnp.int32)
+    v2 = jax.random.randint(k, (n,), 0, 1 << 30, dtype=jnp.int32)
+    jax.block_until_ready((slots, v2))
+    timeit("two i32 scatter-max 20M-operand -> 7.5M",
+           lambda i, s, a, b: (
+               jnp.zeros((OUT,), jnp.int32)
+               .at[jnp.minimum(s + i, OUT)].max(a, mode="drop")[0]
+               + jnp.zeros((OUT,), jnp.int32)
+               .at[jnp.minimum(s + i, OUT)].max(b, mode="drop")[0]
+           ),
+           slots, iota_n, v2)
+    timeit("one packed i64 scatter-max 20M-operand -> 7.5M",
+           lambda i, s, a, b: jnp.zeros((OUT,), jnp.int64)
+           .at[jnp.minimum(s + i, OUT)]
+           .max((a.astype(jnp.int64) << 32) | b.astype(jnp.int64),
+                mode="drop")[0],
+           slots, iota_n, v2)
+    timeit("cummax i64 7.5M",
+           lambda i, c: lax.cummax(c[:OUT] + i)[-1], col[:OUT])
+    # associative_scan "last-marked-value" broadcast, the gather-free
+    # alternative for segment value broadcast
+    flag = (iota_n[:OUT] % 3) == 0
+    vals = col[:OUT]
+
+    def seg_last(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf, bv, av)
+
+    timeit("associative_scan last-set (bool,i64) 7.5M",
+           lambda i, f, v: lax.associative_scan(
+               seg_last, (f, v + i))[1][-1],
+           flag, vals)
+
+
+if __name__ == "__main__":
+    main()
