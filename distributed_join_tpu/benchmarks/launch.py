@@ -82,7 +82,14 @@ def main(argv=None) -> int:
     rc = 0
     for p in procs:
         code = p.wait()  # always reap every process, even after a failure
-        rc = rc or code
+        if code and not rc:
+            rc = code
+            # mpirun semantics: first rank death kills the job — the
+            # survivors are blocked in a collective waiting for the
+            # dead peer and would hang this wait loop forever.
+            for q in procs:
+                if q.poll() is None:
+                    q.terminate()
     return rc
 
 

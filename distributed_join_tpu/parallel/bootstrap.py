@@ -102,7 +102,20 @@ def maybe_initialize_from_env() -> bool:
 
 def process_id() -> int:
     """This process's id (0 when not launched distributed) — the
-    reference's ``rank`` for rank-0-only printing."""
+    reference's ``rank`` for rank-0-only printing.
+
+    Once a backend exists, ``jax.process_index()`` is authoritative —
+    a user may have called ``jax.distributed.initialize`` themselves
+    (or relied on TPU-pod auto-detection) without any ``DJTPU_*`` env,
+    and every host believing it is rank 0 would duplicate reports and
+    race on ``--json-output``. The env is only a pre-initialization
+    fallback; probing it must not itself initialize a backend."""
+    from jax._src import xla_bridge
+
+    if getattr(xla_bridge, "_backends", None):
+        import jax
+
+        return jax.process_index()
     return int(os.environ.get(ENV_PROCESS_ID, "0"))
 
 

@@ -262,10 +262,15 @@ def keyrange_batched_join(
     pb = key_batch_ids([hp[k] for k in keys], n_batches)
 
     def _bin(cols, ids):
-        return [
-            {n: c[ids == b] for n, c in cols.items()}
-            for b in range(n_batches)
-        ]
+        # Column-at-a-time, releasing each source column as it is
+        # binned: peak host overhead is one column, not a second full
+        # copy of the dataset (this path exists for near-RAM tables).
+        out = [{} for _ in range(n_batches)]
+        for nm in list(cols):
+            c = cols.pop(nm)
+            for b in range(n_batches):
+                out[b][nm] = c[ids == b]
+        return out
 
     return batched_join_host(
         _bin(hb, bb), _bin(hp, pb), comm, key=key,

@@ -28,6 +28,33 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def measure_chained(name: str, make_body: Callable, *args,
+                    iters: int = 8) -> float:
+    """Time one primitive with the chained-loop protocol:
+    ``make_body(i, *args) -> scalar`` is run ``iters`` dependent times
+    inside a single jitted ``fori_loop`` (the loop counter perturbed by
+    the carry so nothing hoists), compiled+warmed once, then timed with
+    one scalar fetch. Prints and returns seconds per iteration. Used by
+    the scripts/profile_*.py microbenchmarks."""
+    import time as _time
+
+    import jax
+
+    def looped(*args):
+        def body(i, acc):
+            return acc + make_body(i + acc % 2, *args).astype(jnp.int64)
+
+        return lax.fori_loop(0, iters, body, jnp.int64(0))
+
+    fn = jax.jit(looped)
+    int(fn(*args))  # compile + warmup
+    t0 = _time.perf_counter()
+    int(fn(*args))
+    dt = (_time.perf_counter() - t0) / iters
+    print(f"{name:52s} {dt * 1e3:9.1f} ms", flush=True)
+    return dt
+
+
 def measure(fn: Callable, fetch: Callable, iters: int) -> float:
     """Warm up ``fn`` (compiles + runs), then time it; returns seconds
     per iteration. ``fetch(result)`` must force completion by pulling at

@@ -1,0 +1,118 @@
+"""Stage-by-stage ablation of the join core, in-program (chained-loop
+protocol). Mirrors ops/join.py's stages; each variant adds one stage.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_ablation.py
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import distributed_join_tpu  # noqa: F401
+from distributed_join_tpu.utils.benchmarking import (  # noqa: E402
+    measure_chained as timeit,
+)
+from distributed_join_tpu.ops.join import _dtype_sentinel_max
+from distributed_join_tpu.utils.generators import generate_build_probe_tables
+
+N = 10_000_000
+OUT = 7_500_000
+ITERS = 8
+
+
+def stages(i, build, probe, upto):
+    bk = build.columns["key"] + i
+    pk = probe.columns["key"] + i
+    bpay = build.columns["build_payload"]
+    ppay = probe.columns["probe_payload"]
+    bvalid, pvalid = build.valid, probe.valid
+    nb, npr = bk.shape[0], pk.shape[0]
+    n = nb + npr
+    sent = _dtype_sentinel_max(bk.dtype)
+
+    # stage 1: build sort
+    btag = jnp.where(bvalid, jnp.int8(0), jnp.int8(1))
+    sorted_b = lax.sort(
+        (jnp.where(bvalid, bk, sent), btag, bpay), num_keys=2
+    )
+    sb_pay = sorted_b[2]
+    acc = sorted_b[0][0].astype(jnp.int64)
+    if upto == 1:
+        return acc
+
+    # stage 2: merged sort
+    mkey = jnp.concatenate([
+        jnp.where(bvalid, bk, sent), jnp.where(pvalid, pk, sent)
+    ])
+    tag = jnp.concatenate([
+        jnp.where(bvalid, jnp.int8(0), jnp.int8(2)),
+        jnp.where(pvalid, jnp.int8(1), jnp.int8(2)),
+    ])
+    mpay = jnp.concatenate([jnp.zeros((nb,), ppay.dtype), ppay])
+    sorted_m = lax.sort((mkey, tag, mpay), num_keys=2)
+    skey, stag, sp_pay = sorted_m
+    acc = acc + skey[0].astype(jnp.int64)
+    if upto == 2:
+        return acc
+
+    # stage 3: scans
+    is_build = stag == jnp.int8(0)
+    is_probe = stag == jnp.int8(1)
+    f_incl = jnp.cumsum(is_build.astype(jnp.int32))
+    b_before = f_incl - is_build.astype(jnp.int32)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    prev = jnp.concatenate([skey[:1], skey[:-1]])
+    first = (skey != prev) | (iota == 0)
+    lo = lax.cummax(jnp.where(first, b_before, 0))
+    cnt = jnp.where(is_probe, b_before - lo, 0)
+    csum = jnp.cumsum(cnt)
+    total = jnp.sum(cnt.astype(jnp.int64))
+    start_out = csum - cnt
+    acc = acc + total
+    if upto == 3:
+        return acc
+
+    # stage 4: expansion scatters + cummax
+    j = jnp.arange(OUT, dtype=jnp.int32)
+    slot = jnp.where(is_probe & (cnt > 0), start_out, OUT)
+    zeros_out = jnp.zeros((OUT,), dtype=jnp.int32)
+    marks = zeros_out.at[slot].max(iota + 1, mode="drop")
+    m = jnp.maximum(lax.cummax(marks) - 1, 0)
+    lo_b = lax.cummax(zeros_out.at[slot].max(lo, mode="drop"))
+    start_b = lax.cummax(jnp.where(marks > 0, j, 0))
+    build_rank = jnp.clip(lo_b + (j - start_b), 0, nb - 1)
+    acc = acc + m[0].astype(jnp.int64) + build_rank[-1].astype(jnp.int64)
+    if upto == 4:
+        return acc
+
+    # stage 5: probe-side packed gather (key + payload)
+    pack = jnp.stack([skey, sp_pay], axis=1)
+    rows = pack[m]
+    okey, opay = rows[:, 0], rows[:, 1]
+    acc = acc + okey[0].astype(jnp.int64) + opay[-1].astype(jnp.int64)
+    if upto == 5:
+        return acc
+
+    # stage 6: build-side gather
+    ob = sb_pay[build_rank]
+    out_valid = j < total
+    acc = acc + jnp.sum(jnp.where(out_valid, ob, 0)).astype(jnp.int64)
+    return acc
+
+
+def main():
+    build, probe = generate_build_probe_tables(
+        seed=42, build_nrows=N, probe_nrows=N, selectivity=0.3
+    )
+    jax.block_until_ready((build, probe))
+    for upto in range(1, 7):
+        timeit(f"stages 1..{upto}", lambda i, b, p, u=upto: stages(i, b, p, u),
+               build, probe)
+
+
+if __name__ == "__main__":
+    main()

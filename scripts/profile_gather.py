@@ -13,26 +13,13 @@ import jax.numpy as jnp
 from jax import lax
 
 import distributed_join_tpu  # noqa: F401
+from distributed_join_tpu.utils.benchmarking import (  # noqa: E402
+    measure_chained as timeit,
+)
 
 N = 10_000_000
 OUT = 7_500_000
 ITERS = 8
-
-
-def timeit(name, make_body, *args):
-    def looped(*args):
-        def body(i, acc):
-            return acc + make_body(i + acc % 2, *args).astype(jnp.int64)
-
-        return lax.fori_loop(0, ITERS, body, jnp.int64(0))
-
-    fn = jax.jit(looped)
-    int(fn(*args))
-    t0 = time.perf_counter()
-    int(fn(*args))
-    dt = (time.perf_counter() - t0) / ITERS
-    print(f"{name:52s} {dt * 1e3:9.1f} ms", flush=True)
-    return dt
 
 
 def main():

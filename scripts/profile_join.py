@@ -21,7 +21,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-import distributed_join_tpu  # noqa: F401  (enables x64)
+import distributed_join_tpu  # noqa: F401
+from distributed_join_tpu.utils.benchmarking import (  # noqa: E402
+    measure_chained as timeit,
+)
 from distributed_join_tpu.ops.join import sort_merge_inner_join
 from distributed_join_tpu.table import Table
 from distributed_join_tpu.utils.generators import generate_build_probe_tables
@@ -29,24 +32,6 @@ from distributed_join_tpu.utils.generators import generate_build_probe_tables
 N = 10_000_000
 OUT_CAP = 7_500_000
 ITERS = 8
-
-
-def timeit(name, make_body, *args):
-    """make_body(i, *args) -> scalar; chained through a fori_loop."""
-
-    def looped(*args):
-        def body(i, acc):
-            return acc + make_body(i + acc % 2, *args).astype(jnp.int64)
-
-        return lax.fori_loop(0, ITERS, body, jnp.int64(0))
-
-    fn = jax.jit(looped)
-    int(fn(*args))  # compile + warmup
-    t0 = time.perf_counter()
-    int(fn(*args))
-    dt = (time.perf_counter() - t0) / ITERS
-    print(f"{name:46s} {dt * 1e3:9.1f} ms")
-    return dt
 
 
 def main():

@@ -88,3 +88,22 @@ def test_two_process_all_to_all_runs(tmp_path):
     record = json.loads(out.read_text())
     assert record["n_ranks"] == 8
     assert record["aggregate_offchip_gb_per_sec"] > 0
+
+
+def test_package_import_does_not_initialize_backend():
+    """Importing the package must not create device arrays: the
+    multi-host bootstrap requires jax.distributed.initialize to run
+    BEFORE any backend initialization (a module-level jnp constant
+    anywhere in the package breaks every tpu-launch worker)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import distributed_join_tpu\n"
+         "from jax._src import xla_bridge\n"
+         "assert not xla_bridge._backends, list(xla_bridge._backends)\n"
+         "print('clean')"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    assert "clean" in r.stdout
