@@ -79,17 +79,26 @@ def main(argv=None) -> int:
         subprocess.Popen(args.command, env=_env_for(args, pid))
         for pid in range(args.num_processes)
     ]
+    # mpirun semantics: the FIRST rank death (any rank — poll them all,
+    # don't block on rank 0) kills the job, because the survivors are
+    # blocked in a collective waiting for the dead peer and would never
+    # exit on their own.
     rc = 0
-    for p in procs:
-        code = p.wait()  # always reap every process, even after a failure
-        if code and not rc:
-            rc = code
-            # mpirun semantics: first rank death kills the job — the
-            # survivors are blocked in a collective waiting for the
-            # dead peer and would hang this wait loop forever.
-            for q in procs:
-                if q.poll() is None:
+    live = list(procs)
+    while live:
+        for p in list(live):
+            code = p.poll()
+            if code is None:
+                continue
+            live.remove(p)
+            if code and not rc:
+                rc = code
+                for q in live:
                     q.terminate()
+        if live:
+            import time
+
+            time.sleep(0.05)
     return rc
 
 

@@ -145,7 +145,6 @@ def run(args) -> dict:
         )
         sec, matches, overflow = timed_join_throughput(
             comm, step, build, probe, args.iterations,
-            dce_payload="o_totalprice",
         )
 
     # Valid-row counts (post-filter), same semantics as the host path.

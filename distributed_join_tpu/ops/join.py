@@ -21,11 +21,15 @@ is built around:
   array for k = 1..4: packing columns amortizes gathers to one per
   dtype group instead of one per column.
 
-Hence the structure — TWO sorts that carry all values, two int32
-scatters sharing one index computation, and one packed row-gather per
-(side, dtype) group (a third "run-record compaction" sort was tried in
-place of the scatters and measured SLOWER end-to-end — 29.5 vs 33.3 M
-rows/s — because XLA fuses same-index scatters into one pass):
+One more measured fact shaped the final design: a benchmark that
+consumes only part of the output lets XLA dead-code-eliminate the rest
+(an early guard consumed one column and silently deleted half the
+join); all variant comparisons below were re-run with every output
+column consumed (utils/benchmarking.py consume_all_columns). Under
+honest consumption, the scatter-based expansion cost 486 ms of a
+1050 ms 10Mx10M join — so the expansion was moved out of the merged
+domain entirely. The structure — THREE sorts that carry all values,
+ONE small int32 scatter, one packed row-gather per dtype group:
 
   1. build-side sort: build keys + validity tag + all 1-D build payload
      columns ride one nb-row sort. Valid build rows land in a key-sorted
@@ -40,15 +44,15 @@ rows/s — because XLA fuses same-index scatters into one pass):
      broadcast of run-start values; no gathers, no searchsorted (a v5e
      binary search is ~25 random-gather rounds — measured 3.8 s at 10M
      queries in round 1).
-  4. expansion: each matching probe posts (its merged position, its lo)
-     at its first output slot — two int32 scatters over the same unique
-     slots, fused by XLA — and cummax broadcasts both down the run, so
-     every output slot knows its probe's merged position m and its rank
-     within the run. The same trick ``jnp.repeat`` uses, inverted scan
-     and all, with no searchsorted.
-  5. packed row-gathers materialize the output: probe-side values
-     (keys + payloads) from the merged-sort arrays at m, build-side
-     values from the step-1 sorted prefix at the build rank.
+  4. run-record compaction sort: one record per matching probe, keyed
+     by its first output slot, with every probe-side output value plus
+     the run geometry riding; the records land in a dense
+     output-ordered prefix.
+  5. ONE int32 scatter (out_capacity operand, unique slots) posts each
+     record's index at its first output slot; a cummax broadcasts it
+     down the run; then one packed row-gather per dtype group pulls
+     probe-side values from the records and build-side values from the
+     step-1 sorted prefix at the in-run build rank.
 
 Output capacity is static (XLA constraint); the true match count and
 an overflow flag are returned alongside. Duplicate keys on either side
@@ -81,6 +85,23 @@ def _dtype_sentinel_max(dt):
     if jnp.issubdtype(dt, jnp.floating):
         return jnp.asarray(jnp.inf, dtype=dt)
     raise TypeError(f"unsupported key dtype {dt}")
+
+
+# A plain int, NOT jnp.int32(...): a module-level device constant would
+# initialize the XLA backend at import time, which breaks the multi-host
+# bootstrap contract (jax.distributed.initialize must run first).
+_I32_MAX = 2**31 - 1
+
+
+def _holds_i32_exactly(dt) -> bool:
+    """Can dt round-trip any NON-NEGATIVE int32 value (for riding the
+    int32 run-geometry lanes in the key dtype's gather pack)? f32's
+    24-bit mantissa cannot."""
+    if jnp.issubdtype(dt, jnp.integer):
+        return jnp.iinfo(dt).bits >= 32
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.finfo(dt).nmant >= 31
+    return False
 
 
 @jax.tree_util.register_dataclass
@@ -248,35 +269,63 @@ def sort_merge_inner_join(
     total = jnp.sum(cnt.astype(jnp.int64))
     start_out = csum - cnt            # first output slot of each run
 
-    # -- 4. expansion WITHOUT searchsorted: each matching probe posts
-    #    its merged position (iota+1) and its lo at its first output
-    #    slot — the slots are unique (csum is strictly increasing over
-    #    cnt>0 probes) — and cummaxes broadcast both down the run
-    #    (every scattered quantity is non-decreasing in slot order).
-    #    XLA fuses the same-index scatters into one pass: measured
-    #    variants that removed the lo scatter (riding lo through the
-    #    gather pack, start_b via cummax) were 2-6% SLOWER end-to-end,
-    #    so two scatters + cummaxes it is.
+    # -- 4. run-record compaction sort: one record per probe row with
+    #    matches, keyed by its first output slot (strictly increasing
+    #    over such probes, so keys are unique). EVERYTHING an output
+    #    slot will need rides as value operands: the probe's key and
+    #    payload values, lo, start_out, the 2-D row index. This moves
+    #    the expansion out of the 20M merged domain: the scatter below
+    #    has an out_capacity operand instead of n, and the probe-side
+    #    output gather reads the compact records directly. (The
+    #    scatter-only expansion this replaces measured 486 ms of a
+    #    1050 ms join at 10M x 10M — sorts move values almost for free,
+    #    scatters pay per operand element.)
+    is_rec = is_probe & (cnt > 0)
+    rkey = jnp.where(is_rec, start_out, _I32_MAX)
+    kdt = skeys[0].dtype
+    geom_dt = kdt if _holds_i32_exactly(kdt) else jnp.int32
+    rec_cols = {f"__key{i}": sk for i, sk in enumerate(skeys)}
+    for nm in p1d:
+        rec_cols[nm] = sp_payload[nm]
+    rec_cols["__lo"] = lo.astype(geom_dt)
+    if p2d:
+        rec_cols["__prow"] = sp_rowidx
+    rec_names = list(rec_cols)
+    sorted_r = lax.sort(
+        (rkey, *[rec_cols[nm] for nm in rec_names]), num_keys=1
+    )
+
+    #    Records beyond out_capacity could only start at overflow slots.
+    def _prefix(a, fill):
+        if n >= out_capacity:
+            return a[:out_capacity]
+        pad = jnp.full((out_capacity - n,), fill, dtype=a.dtype)
+        return jnp.concatenate([a, pad])
+
+    S = _prefix(sorted_r[0], _I32_MAX)
+    recs = {
+        nm: _prefix(c, jnp.zeros((), c.dtype))
+        for nm, c in zip(rec_names, sorted_r[1:])
+    }
+
+    # -- 5. ONE small scatter posts each record's index at its first
+    #    output slot (unique; sentinels drop) and a cummax broadcasts it
+    #    down the run; a packed row-gather per dtype group then pulls
+    #    every probe-side value plus the run geometry from the records,
+    #    and the build-side gather reads the step-1 sorted prefix at the
+    #    in-run build rank.
     j = jnp.arange(out_capacity, dtype=jnp.int32)
-    slot = jnp.where(is_probe & (cnt > 0), start_out, out_capacity)
-    zeros_out = jnp.zeros((out_capacity,), dtype=jnp.int32)
-    marks = zeros_out.at[slot].max(iota + 1, mode="drop")
-    m = jnp.maximum(lax.cummax(marks) - 1, 0)  # merged position per slot
-    lo_b = lax.cummax(zeros_out.at[slot].max(lo, mode="drop"))
-    # The run's first slot is where its mark landed.
-    start_b = lax.cummax(jnp.where(marks > 0, j, 0))
+    raw = jnp.zeros((out_capacity,), jnp.int32).at[S].set(
+        j + 1, mode="drop", unique_indices=True
+    )
+    ridx = jnp.maximum(lax.cummax(raw) - 1, 0)
+    out_vals = _grouped_row_gather(recs, ridx)
+    lo_b = out_vals.pop("__lo").astype(jnp.int32)
+    # The run's first slot is where its raw mark landed — cheaper as an
+    # out-domain cummax than as another ridden sort lane.
+    start_b = lax.cummax(jnp.where(raw > 0, j, 0))
     build_rank = lo_b + (j - start_b)
     safe_rank = jnp.clip(build_rank, 0, max(nb - 1, 0))
-
-    # -- 5. packed row-gathers. Probe-side values (keys + payloads) come
-    #    from the merged-sort arrays at m; build-side values from the
-    #    step-1 sorted prefix at the in-run build rank.
-    probe_src = {f"__key{i}": sk for i, sk in enumerate(skeys)}
-    for nm in p1d:
-        probe_src[nm] = sp_payload[nm]
-    if p2d:
-        probe_src["__prow"] = sp_rowidx
-    out_vals = _grouped_row_gather(probe_src, m)
 
     out_cols = {}
     for i, k in enumerate(keys):

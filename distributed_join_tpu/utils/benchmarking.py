@@ -65,6 +65,31 @@ def measure(fn: Callable, fetch: Callable, iters: int) -> float:
     return (time.perf_counter() - t0) / iters
 
 
+def consume_all_columns(table) -> "jnp.ndarray":
+    """Reduce EVERY output column into one int64 scalar so no part of
+    the result materialization can be dead-code-eliminated.
+
+    This matters: an earlier guard consumed a single payload column,
+    and XLA silently deleted the key and build-payload gathers AND the
+    whole build-side sort from the timed program — the "join" being
+    measured materialized one column. The reference's cudf::inner_join
+    materializes every output column inside the timed region; honest
+    parity requires consuming them all.
+    """
+    acc = jnp.int64(0)
+    for c in table.columns.values():
+        if jnp.issubdtype(c.dtype, jnp.floating):
+            c = lax.convert_element_type(c, jnp.int32)
+        if c.ndim > 1:  # string columns: every byte, not just byte 0
+            c = jnp.sum(
+                c.reshape((c.shape[0], -1)).astype(jnp.int32), axis=1
+            )
+        acc = acc + jnp.sum(
+            jnp.where(table.valid, c.astype(jnp.int64), 0)
+        )
+    return acc
+
+
 def timed_join_throughput(
     comm,
     step: Callable,
@@ -72,7 +97,6 @@ def timed_join_throughput(
     probe,
     iters: int,
     key: str = "key",
-    dce_payload: str = "probe_payload",
 ):
     """Time ``iters`` chained join steps; returns
     ``(sec_per_join, total_matches_per_join, overflow)``.
@@ -82,8 +106,9 @@ def timed_join_throughput(
       preserves hit/miss structure — the generator's miss keys occupy a
       disjoint range that shifts rigidly with the hits — but makes every
       hash/sort/shuffle stage loop-variant so nothing hoists);
-    - an output payload column is reduced into the carry so the result
-      materialization cannot be dead-code-eliminated;
+    - EVERY output column is reduced into the carry so no part of the
+      result materialization can be dead-code-eliminated (see
+      consume_all_columns);
     - the per-rank carry is initialized from sharded data (a literal
       zero is unvarying in shard_map's vma tracking and is rejected as
       a carry init for a varying accumulator);
@@ -109,18 +134,14 @@ def timed_join_throughput(
             pcols = dict(probe.columns)
             pcols[shift_key] = pcols[shift_key] + shift
             res = step(Table(bcols, build.valid), Table(pcols, probe.valid))
-            out = res.table
-            consumed = jnp.sum(
-                jnp.where(out.valid, out.columns[dce_payload], 0)
-            ).astype(jnp.int64)
+            consumed = consume_all_columns(res.table)
             return (
                 acc[0] + res.total.astype(jnp.int64),
                 acc[1] | res.overflow,
                 acc[2] + consumed,
             )
 
-        # Any probe column works for the varying all-zero init;
-        # dce_payload itself may be a build-side column.
+        # Any probe column works for the varying all-zero init.
         first_col = next(iter(probe.columns.values()))
         vzero = (first_col[0] * 0).astype(jnp.int64)
         total, overflow, consumed = lax.fori_loop(

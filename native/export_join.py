@@ -1,0 +1,154 @@
+"""Export the compiled single-chip join step as a StableHLO artifact
+for the native C++/PJRT driver (SURVEY.md §7 step 6b).
+
+The reference's benchmark driver is native C++ (CUDA, SURVEY.md §2
+"Join benchmark driver"); the TPU-native equivalent keeps the compute
+definition in JAX but runs it from a thin C++ ``main`` over the PJRT C
+API — the same split the reference has between its C++ driver and the
+cuDF kernels it calls. This tool stages the handoff:
+
+  1. build the join step (``make_join_step`` over a
+     ``LocalCommunicator``) with ``--iterations`` dependent repetitions
+     chained in one ``lax.fori_loop`` (the honest-timing protocol of
+     utils/benchmarking.py, baked into the program so the C++ driver
+     times one execution);
+  2. ``jax.export`` it for the TPU platform; write the serialized
+     StableHLO portable artifact next to a JSON sidecar describing the
+     argument order/shapes/dtypes and the benchmark metadata the C++
+     driver reports.
+
+The artifact is shape-specialized (XLA compiles static shapes — the
+same reason the Python drivers fix capacities); regenerate it for other
+table sizes:
+
+    python native/export_join.py --build-table-nrows 10000000 \
+        --probe-table-nrows 10000000 --iterations 8 -o native/artifacts
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import export, lax
+
+
+def build_looped_join(b_rows: int, p_rows: int, iterations: int,
+                      out_rows: int, key_dtype, payload_dtype):
+    from distributed_join_tpu.parallel.communicator import LocalCommunicator
+    from distributed_join_tpu.parallel.distributed_join import make_join_step
+    from distributed_join_tpu.table import Table
+
+    comm = LocalCommunicator()
+    step = make_join_step(comm, key="key", out_rows_per_rank=out_rows)
+
+    def looped(bkey, bpay, bvalid, pkey, ppay, pvalid):
+        def body(i, acc):
+            shift = i.astype(key_dtype)
+            build = Table({"key": bkey + shift, "build_payload": bpay},
+                          bvalid)
+            probe = Table({"key": pkey + shift, "probe_payload": ppay},
+                          pvalid)
+            res = step(build, probe)
+            consumed = jnp.sum(
+                jnp.where(res.table.valid,
+                          res.table.columns["probe_payload"], 0)
+            ).astype(jnp.int64)
+            return (acc[0] + res.total.astype(jnp.int64),
+                    acc[1] | res.overflow,
+                    acc[2] + consumed)
+
+        total, overflow, consumed = lax.fori_loop(
+            0, iterations, body,
+            (jnp.int64(0), jnp.bool_(False), jnp.int64(0)),
+        )
+        return total, overflow, consumed
+
+    args = (
+        jax.ShapeDtypeStruct((b_rows,), key_dtype),
+        jax.ShapeDtypeStruct((b_rows,), payload_dtype),
+        jax.ShapeDtypeStruct((b_rows,), jnp.bool_),
+        jax.ShapeDtypeStruct((p_rows,), key_dtype),
+        jax.ShapeDtypeStruct((p_rows,), payload_dtype),
+        jax.ShapeDtypeStruct((p_rows,), jnp.bool_),
+    )
+    return looped, args
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--build-table-nrows", type=int, default=1_000_000)
+    p.add_argument("--probe-table-nrows", type=int, default=1_000_000)
+    p.add_argument("--selectivity", type=float, default=0.3,
+                   help="recorded in the sidecar; also sizes the output "
+                        "block (matches x 2 plus slack)")
+    p.add_argument("--iterations", type=int, default=8)
+    p.add_argument("--out-capacity-factor", type=float, default=1.2)
+    p.add_argument("-o", "--output-dir", default="native/artifacts")
+    args = p.parse_args(argv)
+
+    import distributed_join_tpu  # noqa: F401  (x64 on, before tracing)
+
+    b, pr = args.build_table_nrows, args.probe_table_nrows
+    out_rows = int(math.ceil(pr * args.out_capacity_factor))
+    looped, arg_specs = build_looped_join(
+        b, pr, args.iterations, out_rows, jnp.int64, jnp.int64
+    )
+    exp = export.export(jax.jit(looped))(*arg_specs)
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    mlir_path = os.path.join(args.output_dir, "join_step.stablehlo.bc")
+    with open(mlir_path, "wb") as f:
+        f.write(exp.mlir_module_serialized)
+    sidecar = {
+        "artifact": os.path.basename(mlir_path),
+        "platforms": list(exp.platforms),
+        "iterations": args.iterations,
+        "build_table_nrows": b,
+        "probe_table_nrows": pr,
+        "selectivity": args.selectivity,
+        "out_rows": out_rows,
+        "args": [
+            {"name": nm, "shape": list(s.shape), "dtype": str(s.dtype)}
+            for nm, s in zip(
+                ["build_key", "build_payload", "build_valid",
+                 "probe_key", "probe_payload", "probe_valid"],
+                arg_specs,
+            )
+        ],
+        "outputs": [
+            {"name": "total_matches_x_iters", "dtype": "int64"},
+            {"name": "overflow", "dtype": "bool"},
+            {"name": "dce_guard_checksum", "dtype": "int64"},
+        ],
+    }
+    with open(os.path.join(args.output_dir, "join_step.json"), "w") as f:
+        json.dump(sidecar, f, indent=2)
+
+    # Serialized xla.CompileOptionsProto — PJRT_Client_Compile requires
+    # one; generating it here keeps the C++ driver free of proto deps.
+    from jax._src.lib import xla_client
+
+    with open(os.path.join(args.output_dir, "compile_options.pb"),
+              "wb") as f:
+        f.write(xla_client.CompileOptions().SerializeAsString())
+
+    # key=value sidecar for the C++ driver (no JSON parser needed there).
+    with open(os.path.join(args.output_dir, "join_step.meta"), "w") as f:
+        f.write(
+            f"iterations={args.iterations}\n"
+            f"build_table_nrows={b}\n"
+            f"probe_table_nrows={pr}\n"
+            f"selectivity={args.selectivity}\n"
+            f"out_rows={out_rows}\n"
+        )
+    print(f"exported {mlir_path} ({len(exp.mlir_module_serialized)} bytes) "
+          f"for platforms {exp.platforms}")
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,6 @@ Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_ablation.py
 
 from __future__ import annotations
 
-import time
 
 import jax
 import jax.numpy as jnp
@@ -21,7 +20,6 @@ from distributed_join_tpu.utils.generators import generate_build_probe_tables
 
 N = 10_000_000
 OUT = 7_500_000
-ITERS = 8
 
 
 def stages(i, build, probe, upto):
@@ -40,7 +38,12 @@ def stages(i, build, probe, upto):
         (jnp.where(bvalid, bk, sent), btag, bpay), num_keys=2
     )
     sb_pay = sorted_b[2]
-    acc = sorted_b[0][0].astype(jnp.int64)
+    # Consume EVERY sort output fully — single-element consumption lets
+    # XLA strip unused sort operands and shrink gathers, corrupting the
+    # per-stage deltas (same trap consume_all_columns closes in the
+    # real benchmark).
+    acc = (jnp.sum(sorted_b[0]) + jnp.sum(sb_pay)
+           + jnp.sum(sorted_b[1].astype(jnp.int64))).astype(jnp.int64)
     if upto == 1:
         return acc
 
@@ -55,7 +58,8 @@ def stages(i, build, probe, upto):
     mpay = jnp.concatenate([jnp.zeros((nb,), ppay.dtype), ppay])
     sorted_m = lax.sort((mkey, tag, mpay), num_keys=2)
     skey, stag, sp_pay = sorted_m
-    acc = acc + skey[0].astype(jnp.int64)
+    acc = acc + (jnp.sum(skey) + jnp.sum(sp_pay)
+                 + jnp.sum(stag.astype(jnp.int64)))
     if upto == 2:
         return acc
 
@@ -85,7 +89,7 @@ def stages(i, build, probe, upto):
     lo_b = lax.cummax(zeros_out.at[slot].max(lo, mode="drop"))
     start_b = lax.cummax(jnp.where(marks > 0, j, 0))
     build_rank = jnp.clip(lo_b + (j - start_b), 0, nb - 1)
-    acc = acc + m[0].astype(jnp.int64) + build_rank[-1].astype(jnp.int64)
+    acc = acc + jnp.sum(m.astype(jnp.int64)) + jnp.sum(build_rank.astype(jnp.int64))
     if upto == 4:
         return acc
 
@@ -93,7 +97,7 @@ def stages(i, build, probe, upto):
     pack = jnp.stack([skey, sp_pay], axis=1)
     rows = pack[m]
     okey, opay = rows[:, 0], rows[:, 1]
-    acc = acc + okey[0].astype(jnp.int64) + opay[-1].astype(jnp.int64)
+    acc = acc + jnp.sum(okey) + jnp.sum(opay)
     if upto == 5:
         return acc
 

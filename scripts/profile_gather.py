@@ -6,7 +6,6 @@ Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_gather.py
 
 from __future__ import annotations
 
-import time
 
 import jax
 import jax.numpy as jnp
@@ -19,7 +18,6 @@ from distributed_join_tpu.utils.benchmarking import (  # noqa: E402
 
 N = 10_000_000
 OUT = 7_500_000
-ITERS = 8
 
 
 def main():

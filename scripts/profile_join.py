@@ -15,7 +15,6 @@ Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_join.py
 
 from __future__ import annotations
 
-import time
 
 import jax
 import jax.numpy as jnp
@@ -31,7 +30,6 @@ from distributed_join_tpu.utils.generators import generate_build_probe_tables
 
 N = 10_000_000
 OUT_CAP = 7_500_000
-ITERS = 8
 
 
 def main():

@@ -1,0 +1,131 @@
+"""Substitution ablation: run the FULL join with one stage replaced by
+a shape-preserving cheap fake; the throughput delta vs the real join is
+that stage's true in-program cost (the additive ablation in
+profile_ablation.py breaks XLA fusion and over-counts).
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_substitution.py
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import distributed_join_tpu  # noqa: F401
+from distributed_join_tpu.utils.benchmarking import (  # noqa: E402
+    measure_chained as timeit,
+)
+from distributed_join_tpu.ops.join import _dtype_sentinel_max
+from distributed_join_tpu.utils.generators import generate_build_probe_tables
+
+N = 10_000_000
+OUT = 7_500_000
+
+
+def join_variant(i, build, probe, fake_scatter, fake_pgather, fake_bgather,
+                 skip_bsort):
+    bk = build.columns["key"] + i
+    pk = probe.columns["key"] + i
+    bpay = build.columns["build_payload"]
+    ppay = probe.columns["probe_payload"]
+    bvalid, pvalid = build.valid, probe.valid
+    nb = bk.shape[0]
+    n = nb + pk.shape[0]
+    sent = _dtype_sentinel_max(bk.dtype)
+
+    if skip_bsort:
+        sb_pay = bpay
+    else:
+        sorted_b = lax.sort(
+            (jnp.where(bvalid, bk, sent),
+             jnp.where(bvalid, jnp.int8(0), jnp.int8(1)), bpay),
+            num_keys=2,
+        )
+        sb_pay = sorted_b[2]
+
+    mkey = jnp.concatenate([
+        jnp.where(bvalid, bk, sent), jnp.where(pvalid, pk, sent)
+    ])
+    tag = jnp.concatenate([
+        jnp.where(bvalid, jnp.int8(0), jnp.int8(2)),
+        jnp.where(pvalid, jnp.int8(1), jnp.int8(2)),
+    ])
+    mpay = jnp.concatenate([jnp.zeros((nb,), ppay.dtype), ppay])
+    skey, stag, sp_pay = lax.sort((mkey, tag, mpay), num_keys=2)
+
+    is_build = stag == jnp.int8(0)
+    is_probe = stag == jnp.int8(1)
+    f_incl = jnp.cumsum(is_build.astype(jnp.int32))
+    b_before = f_incl - is_build.astype(jnp.int32)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    prev = jnp.concatenate([skey[:1], skey[:-1]])
+    first = (skey != prev) | (iota == 0)
+    lo = lax.cummax(jnp.where(first, b_before, 0))
+    cnt = jnp.where(is_probe, b_before - lo, 0)
+    csum = jnp.cumsum(cnt)
+    total = jnp.sum(cnt.astype(jnp.int64))
+    start_out = csum - cnt
+
+    j = jnp.arange(OUT, dtype=jnp.int32)
+    if fake_scatter:
+        # shape/dtype-preserving fake: monotone-ish, data-dependent on
+        # one scalar so nothing constant-folds
+        base = (j.astype(jnp.int64) * n // (OUT + 1)).astype(jnp.int32)
+        m = jnp.clip(base + (total % 2).astype(jnp.int32), 0, n - 1)
+        lo_b = jnp.clip(m // 3, 0, nb - 1)
+        start_b = jnp.maximum(j - 2, 0)
+    else:
+        slot = jnp.where(is_probe & (cnt > 0), start_out, OUT)
+        zeros_out = jnp.zeros((OUT,), dtype=jnp.int32)
+        marks = zeros_out.at[slot].max(iota + 1, mode="drop")
+        m = jnp.maximum(lax.cummax(marks) - 1, 0)
+        lo_b = lax.cummax(zeros_out.at[slot].max(lo, mode="drop"))
+        start_b = lax.cummax(jnp.where(marks > 0, j, 0))
+    build_rank = jnp.clip(lo_b + (j - start_b), 0, nb - 1)
+
+    if fake_pgather:
+        okey = skey[:OUT] + m[0]
+        opay = sp_pay[:OUT]
+    else:
+        pack = jnp.stack([skey, sp_pay], axis=1)
+        rows = pack[m]
+        okey, opay = rows[:, 0], rows[:, 1]
+
+    if fake_bgather:
+        ob = sb_pay[:OUT] + build_rank[0]
+    else:
+        ob = sb_pay[build_rank]
+
+    out_valid = j < total
+    return (total
+            + jnp.sum(jnp.where(out_valid, okey, 0)).astype(jnp.int64)
+            + jnp.sum(jnp.where(out_valid, opay, 0)).astype(jnp.int64)
+            + jnp.sum(jnp.where(out_valid, ob, 0)).astype(jnp.int64))
+
+
+def main():
+    build, probe = generate_build_probe_tables(
+        seed=42, build_nrows=N, probe_nrows=N, selectivity=0.3
+    )
+    jax.block_until_ready((build, probe))
+
+    def var(name, **kw):
+        flags = dict(fake_scatter=False, fake_pgather=False,
+                     fake_bgather=False, skip_bsort=False)
+        flags.update(kw)
+        timeit(name,
+               lambda i, b, p: join_variant(i, b, p, **flags),
+               build, probe)
+
+    var("full join (baseline)")
+    var("- expansion scatters faked", fake_scatter=True)
+    var("- probe pack gather faked", fake_pgather=True)
+    var("- build gather faked", fake_bgather=True)
+    var("- build sort skipped", skip_bsort=True)
+    var("- everything faked (sorts+scans only)",
+        fake_scatter=True, fake_pgather=True, fake_bgather=True)
+
+
+if __name__ == "__main__":
+    main()
