@@ -26,6 +26,7 @@ import argparse
 import os
 import subprocess
 import sys
+import time
 
 from distributed_join_tpu.parallel.bootstrap import (
     ENV_COORDINATOR,
@@ -96,8 +97,6 @@ def main(argv=None) -> int:
                 for q in live:
                     q.terminate()
         if live:
-            import time
-
             time.sleep(0.05)
     return rc
 

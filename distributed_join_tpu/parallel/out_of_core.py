@@ -263,11 +263,13 @@ def keyrange_batched_join(
 
     def _bin(cols, ids):
         # Column-at-a-time, releasing each source column as it is
-        # binned: peak host overhead is one column plus the (much
-        # smaller) index arrays, not a second full copy of the dataset
-        # (this path exists for near-RAM tables). The batch masks are
-        # resolved to index arrays ONCE, not per (column, batch).
-        idx = [np.flatnonzero(ids == b) for b in range(n_batches)]
+        # binned: peak host overhead is one column plus the int32
+        # index arrays (half a column-width in total), not a second
+        # full copy of the dataset (this path exists for near-RAM
+        # tables). The batch masks are resolved to index arrays ONCE,
+        # not per (column, batch).
+        idx = [np.flatnonzero(ids == b).astype(np.int32)
+               for b in range(n_batches)]
         out = [{} for _ in range(n_batches)]
         for nm in list(cols):
             c = cols.pop(nm)

@@ -46,6 +46,8 @@ def build_looped_join(b_rows: int, p_rows: int, iterations: int,
     comm = LocalCommunicator()
     step = make_join_step(comm, key="key", out_rows_per_rank=out_rows)
 
+    from distributed_join_tpu.utils.benchmarking import consume_all_columns
+
     def looped(bkey, bpay, bvalid, pkey, ppay, pvalid):
         def body(i, acc):
             shift = i.astype(key_dtype)
@@ -54,10 +56,11 @@ def build_looped_join(b_rows: int, p_rows: int, iterations: int,
             probe = Table({"key": pkey + shift, "probe_payload": ppay},
                           pvalid)
             res = step(build, probe)
-            consumed = jnp.sum(
-                jnp.where(res.table.valid,
-                          res.table.columns["probe_payload"], 0)
-            ).astype(jnp.int64)
+            # EVERY output column: partial consumption lets XLA delete
+            # part of the join from the measured program AND drop the
+            # now-unused args from the exported module's signature
+            # (which breaks the C++ driver's argument list).
+            consumed = consume_all_columns(res.table)
             return (acc[0] + res.total.astype(jnp.int64),
                     acc[1] | res.overflow,
                     acc[2] + consumed)
@@ -84,8 +87,9 @@ def main(argv=None):
     p.add_argument("--build-table-nrows", type=int, default=1_000_000)
     p.add_argument("--probe-table-nrows", type=int, default=1_000_000)
     p.add_argument("--selectivity", type=float, default=0.3,
-                   help="recorded in the sidecar; also sizes the output "
-                        "block (matches x 2 plus slack)")
+                   help="recorded in the sidecar (the native generator "
+                        "mirrors it); output capacity is probe rows x "
+                        "--out-capacity-factor")
     p.add_argument("--iterations", type=int, default=8)
     p.add_argument("--out-capacity-factor", type=float, default=1.2)
     p.add_argument("-o", "--output-dir", default="native/artifacts")
@@ -131,13 +135,24 @@ def main(argv=None):
 
     # Serialized xla.CompileOptionsProto — PJRT_Client_Compile requires
     # one; generating it here keeps the C++ driver free of proto deps.
-    from jax._src.lib import xla_client
+    # Built exactly the way jax builds options for a 1-device jit
+    # (num_replicas/num_partitions/device_assignment populated — a bare
+    # CompileOptions() leaves them unset and the backend may reject it).
+    from jax._src.compiler import get_compile_options
 
+    co = get_compile_options(
+        num_replicas=1, num_partitions=1,
+        device_assignment=[[0]],
+    )
     with open(os.path.join(args.output_dir, "compile_options.pb"),
               "wb") as f:
-        f.write(xla_client.CompileOptions().SerializeAsString())
+        f.write(co.SerializeAsString())
 
-    # key=value sidecar for the C++ driver (no JSON parser needed there).
+    # key=value sidecar for the C++ driver (no JSON parser needed
+    # there). kept_args: jax.export drops unused module parameters
+    # (module_kept_var_idx); the driver must pass exactly the kept ones
+    # — a stale/wrong argument list crashes the backend session.
+    kept = ",".join(str(i) for i in exp.module_kept_var_idx)
     with open(os.path.join(args.output_dir, "join_step.meta"), "w") as f:
         f.write(
             f"iterations={args.iterations}\n"
@@ -145,6 +160,7 @@ def main(argv=None):
             f"probe_table_nrows={pr}\n"
             f"selectivity={args.selectivity}\n"
             f"out_rows={out_rows}\n"
+            f"kept_args={kept}\n"
         )
     print(f"exported {mlir_path} ({len(exp.mlir_module_serialized)} bytes) "
           f"for platforms {exp.platforms}")
