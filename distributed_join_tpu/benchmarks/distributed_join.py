@@ -90,6 +90,9 @@ def parse_args(argv=None):
                         "fraction of one rank's probe rows")
     p.add_argument("--hh-slots", type=int, default=64,
                    help="static heavy-hitter key slots")
+    p.add_argument("--hh-out-capacity", type=int, default=None,
+                   help="HH-path output rows per rank (default half "
+                        "the local probe rows; size up for heavy Zipf)")
     p.add_argument("--key-columns", type=int, default=1,
                    help=">1 joins on a composite multi-column key "
                         "(BASELINE config 5)")
@@ -172,6 +175,7 @@ def run(args) -> dict:
         out_capacity_factor=args.out_capacity_factor,
         skew_threshold=args.skew_threshold,
         hh_slots=args.hh_slots,
+        hh_out_capacity=args.hh_out_capacity,
     )
     iters = args.iterations
 

@@ -100,6 +100,10 @@ def run(args) -> dict:
             "generate_s": gen_s,
             "batch_build_capacity": stats["build_capacity"],
             "batch_probe_capacity": stats["probe_capacity"],
+            "pad_s": stats["pad_s"],
+            "put_s": stats["put_s"],
+            "dispatch_s": stats["dispatch_s"],
+            "fetch_s": stats["fetch_s"],
         }
         return _report(args, comm, orders_rows, lineitem_rows, rows,
                        total, overflow, sec, record_extra)

@@ -92,7 +92,12 @@ def make_join_step(
     rows skip the shuffle and stay local; HH build rows are broadcast
     (``hh_build_capacity`` slots per rank, default ``hh_slots * 32``)
     and joined locally into an extra output block of
-    ``hh_out_capacity`` rows (default local probe rows).
+    ``hh_out_capacity`` rows (default: HALF of local probe rows — a
+    full-probe-size block doubled peak memory whether or not skew
+    existed). Heavy-hitter mass above that (Zipf alpha >= ~1.4 puts
+    ~90% of probe rows in the top keys) overflows and is caught by the
+    flag / ``auto_retry`` doubling; size it explicitly for known-heavy
+    workloads.
     """
     n = comm.n_ranks
     k = over_decomposition
@@ -155,7 +160,7 @@ def make_join_step(
             hh_probe = Table(probe_local.columns, probe_local.valid & is_hh_p)
             hh_res = sort_merge_inner_join(
                 hh_build, hh_probe, keys,
-                hh_out_capacity or p_rows,
+                hh_out_capacity or max(p_rows // 2, 1024),
                 build_payload=build_payload, probe_payload=probe_payload,
             )
             parts.append(hh_res.table)
@@ -257,7 +262,7 @@ def distributed_inner_join(
         hh_build_cap = hh_build_cap or (
             opts.get("hh_slots", DEFAULT_HH_SLOTS) * HH_BUILD_SLOTS_PER_HH
         )
-        hh_out_cap = hh_out_cap or probe.capacity // n
+        hh_out_cap = hh_out_cap or max(probe.capacity // (2 * n), 1024)
     out_rows = opts.pop("out_rows_per_rank", None)
     for attempt in range(auto_retry + 1):
         fn = make_distributed_join(

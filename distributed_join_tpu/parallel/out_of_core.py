@@ -13,8 +13,8 @@ batch, so batch joins are independent and their totals sum.
 
 This is the framework's answer to the reference's "tables larger than
 per-chip HBM" axis; the host loop costs one H2D transfer per batch,
-which a real deployment would overlap with compute via double-buffered
-``jax.device_put`` (left for the profiling round).
+overlapped with device compute by :func:`batched_join_host`'s staging
+thread.
 """
 
 from __future__ import annotations
@@ -150,23 +150,27 @@ def batched_join_host(
     one-batch-ahead H2D staging; returns (total_matches, any_overflow).
 
     This is the out-of-core hot path (VERDICT r1 weak #5: the r1 loop
-    was fully serial). Pipelining here is plain dispatch-order
-    asynchrony — no threads, no streams:
+    was fully serial). The pipeline, per loop iteration:
 
       1. batch b's join is DISPATCHED (async under JAX);
-      2. the host then fetches batch b-1's match count — backpressure:
-         staging b+1 cannot begin until b-1 has finished and its
-         buffers are freeable, which bounds device residency at ~2
-         batches of inputs + outputs regardless of n_batches (without
-         this, a fast host would stage EVERY batch while batch 0 still
-         computes and OOM at exactly the scale this path exists for);
-      3. only then does it pack batch b+1's padded buffers and enqueue
-         their H2D transfer, overlapping batch b's device work.
+      2. batch b+1's pad + H2D transfer starts on the staging thread;
+      3. the host thread then fetches batch b-1's match count —
+         backpressure: batch b+2 cannot stage until b-1 has finished
+         and its buffers are freeable, which bounds device residency
+         at ~3 batches of inputs + in-flight outputs regardless of
+         n_batches (without backpressure, a fast host would stage
+         EVERY batch while batch 0 still computes and OOM at exactly
+         the scale this path exists for). Size ``n_batches`` so three
+         batches of inputs fit HBM alongside one output block.
 
     The reference overlaps comm/compute with CUDA streams + helper
-    threads (SURVEY.md §2 "Over-decomposition"); on TPU the runtime's
-    async dispatch gives the same one-ahead overlap once the host
-    blocks only on the batch BEFORE the one in flight.
+    threads (SURVEY.md §2 "Over-decomposition"); here a single staging
+    THREAD does the same job: measured phase timings showed
+    ``jax.device_put`` of host batches is effectively synchronous (at
+    SF-10 the phase sums equaled the elapsed time — zero overlap), so
+    batch b+1's pad+transfer runs on a worker thread while this thread
+    waits on batch b-1's result. numpy copies and the transfer both
+    release the GIL, so the overlap is real even on a 1-CPU host.
 
     Every batch runs through ONE compiled join (capacities = max batch
     rows, rank-rounded), so there is exactly one XLA compile.
@@ -191,43 +195,69 @@ def batched_join_host(
 
     bcap, pcap = _cap(build_batches), _cap(probe_batches)
 
+    phase = {"pad_s": 0.0, "put_s": 0.0, "dispatch_s": 0.0,
+             "fetch_s": 0.0}
+
     def stage(b):
+        t0 = time.perf_counter()
         bt = _pad_host(build_batches[b], bcap)
         pt = _pad_host(probe_batches[b], pcap)
-        return comm.device_put_sharded((bt, pt))
+        t1 = time.perf_counter()
+        out = comm.device_put_sharded((bt, pt))
+        phase["pad_s"] += t1 - t0
+        phase["put_s"] += time.perf_counter() - t1
+        return out
+
+    from concurrent.futures import ThreadPoolExecutor
 
     fn = make_distributed_join(comm, key=key, **join_opts)
+    pool = ThreadPoolExecutor(max_workers=1)
     nxt = None
     if warmup:
         nxt = stage(0)
         int(fn(*nxt).total)  # compile + run, result discarded; the
         # staged inputs are reused as the measured loop's first batch
 
+    # Warmup staged batch 0 before t0: reset the phase counters so
+    # the breakdown covers exactly the [t0, end) window it is reported
+    # against (otherwise pad_s/put_s over-count by one batch).
+    for k_ in phase:
+        phase[k_] = 0.0
     t0 = time.perf_counter()
-    if nxt is None:
-        nxt = stage(0)
+    fut = (pool.submit(lambda: nxt) if nxt is not None
+           else pool.submit(stage, 0))
     totals, overflows = [], []
     for b in range(n_batches):
-        bt, pt = nxt
+        bt, pt = fut.result()
+        td = time.perf_counter()
         res = fn(bt, pt)
+        phase["dispatch_s"] += time.perf_counter() - td
         totals.append(res.total)
         overflows.append(res.overflow)
         if b + 1 < n_batches:
+            # Stage b+1 on the worker thread, overlapping both batch
+            # b's device work and the backpressure wait below.
+            fut = pool.submit(stage, b + 1)
             if b >= 1:
                 # Backpressure (see docstring): b-1 must be done before
                 # a third batch's buffers exist. A scalar fetch, not
                 # block_until_ready — the only sync that also holds
                 # under this environment's RPC relay.
+                tf = time.perf_counter()
                 totals[b - 1] = int(totals[b - 1])
-            nxt = stage(b + 1)  # overlaps batch b's device work
+                phase["fetch_s"] += time.perf_counter() - tf
         if on_batch_result is not None:
             on_batch_result(b, res)
+    pool.shutdown(wait=False)
+    tf = time.perf_counter()
     total = sum(int(t) for t in totals)
     overflow = any(bool(o) for o in overflows)
+    phase["fetch_s"] += time.perf_counter() - tf
     if stats is not None:
         stats["elapsed_s"] = time.perf_counter() - t0
         stats["build_capacity"] = bcap
         stats["probe_capacity"] = pcap
+        stats.update(phase)
     return total, overflow
 
 
@@ -263,12 +293,15 @@ def keyrange_batched_join(
 
     def _bin(cols, ids):
         # Column-at-a-time, releasing each source column as it is
-        # binned: peak host overhead is one column plus the int32
-        # index arrays (half a column-width in total), not a second
-        # full copy of the dataset (this path exists for near-RAM
-        # tables). The batch masks are resolved to index arrays ONCE,
-        # not per (column, batch).
-        idx = [np.flatnonzero(ids == b).astype(np.int32)
+        # binned: peak host overhead is one column plus the index
+        # arrays (int32 = half a column-width in total — but only
+        # below 2^31 rows; a silent int32 wrap would route rows into
+        # wrong batches with wrong data), not a second full copy of
+        # the dataset (this path exists for near-RAM tables). The
+        # batch masks are resolved to index arrays ONCE, not per
+        # (column, batch).
+        idx_dt = np.int32 if len(ids) < 2**31 else np.int64
+        idx = [np.flatnonzero(ids == b).astype(idx_dt)
                for b in range(n_batches)]
         out = [{} for _ in range(n_batches)]
         for nm in list(cols):

@@ -1,0 +1,119 @@
+"""Verify comm/compute overlap of the distributed join (VERDICT r1
+weak #3: "overlap is asserted, never measured").
+
+Two artifacts:
+
+1. STATIC — compile the 8-rank distributed join at over-decomposition
+   k in {1, 2, 4} and inspect the optimized HLO schedule: are the
+   all-to-all collectives emitted as async start/done pairs, and how
+   many non-collective instructions does the scheduler place between a
+   start and its done? >0 interleaved ops = the compiler overlaps the
+   shuffle with compute, which is the design claim in
+   parallel/distributed_join.py (the reference hand-builds the same
+   overlap with CUDA streams + threads).
+
+2. TIMED — on whatever devices are present, run k in {1, 2, 4} with
+   the chained-loop protocol and report per-join time (on a 1-chip or
+   CPU-mesh host this measures the batching overhead of k, not ICI).
+
+Run: PYTHONPATH=. python scripts/check_overlap.py [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+
+from distributed_join_tpu.benchmarks import add_platform_arg, apply_platform
+
+
+def analyze_schedule(hlo: str) -> dict:
+    """Count async collective pairs and the instructions scheduled
+    between each start/done (module order == schedule for a scheduled
+    HLO module)."""
+    lines = [ln.strip() for ln in hlo.splitlines()]
+    starts: dict[str, int] = {}
+    gaps = []
+    n_async = 0
+    for i, ln in enumerate(lines):
+        m = re.match(r"%?([\w.-]+) = .*(all-to-all|all-gather)-start", ln)
+        if m:
+            starts[m.group(1)] = i
+            n_async += 1
+            continue
+        m = re.search(r"(all-to-all|all-gather)-done\(%?([\w.-]+)\)", ln)
+        if m and m.group(2) in starts:
+            # real ops between start and done, excluding trivial ones
+            between = [
+                x for x in lines[starts[m.group(2)] + 1 : i]
+                if "=" in x and not re.search(
+                    r"parameter|constant|get-tuple-element|bitcast", x)
+            ]
+            gaps.append(len(between))
+    return {
+        "async_collective_pairs": n_async,
+        "ops_between_start_done": gaps,
+        "overlapped": bool(gaps) and max(gaps) > 0,
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n-ranks", type=int, default=8)
+    p.add_argument("--rows-per-rank", type=int, default=65536)
+    p.add_argument("--skip-timed", action="store_true")
+    add_platform_arg(p)
+    args = p.parse_args()
+    apply_platform(args.platform, args.n_ranks)
+
+    import jax
+
+    import distributed_join_tpu as dj
+    from distributed_join_tpu.parallel.distributed_join import (
+        make_distributed_join, make_join_step,
+    )
+    from distributed_join_tpu.utils.benchmarking import (
+        measure, timed_join_throughput,
+    )
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+    )
+
+    n = min(args.n_ranks, len(jax.devices()))
+    comm = dj.make_communicator("tpu" if n > 1 else "local", n_ranks=n)
+    rows = args.rows_per_rank * n
+    build, probe = generate_build_probe_tables(
+        seed=42, build_nrows=rows, probe_nrows=rows, selectivity=0.3
+    )
+    build, probe = comm.device_put_sharded((build, probe))
+
+    report = {"n_ranks": n, "rows": rows, "k": {}}
+    for k in (1, 2, 4):
+        fn = make_distributed_join(
+            comm, key="key", over_decomposition=k, out_capacity_factor=3.0
+        )
+        # make_distributed_join returns a jax.jit-wrapped callable.
+        hlo = fn.lower(build, probe).compile().as_text()
+        sched = analyze_schedule(hlo)
+        entry = {"schedule": sched}
+        if not args.skip_timed:
+            step = make_join_step(
+                comm, key="key", over_decomposition=k,
+                out_capacity_factor=3.0,
+            )
+            sec, total, overflow = timed_join_throughput(
+                comm, step, build, probe, 4
+            )
+            entry["sec_per_join"] = sec
+            entry["matches"] = total
+        report["k"][k] = entry
+        print(f"k={k}: {json.dumps(entry)}")
+
+    print(json.dumps(report))
+    with open("results/overlap_report.json", "w") as f:
+        json.dump(report, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
