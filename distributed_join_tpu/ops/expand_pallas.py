@@ -1,0 +1,235 @@
+"""Pallas expand-gather: the join's output expansion as one streaming
+kernel.
+
+The join core (ops/join.py) turns compact run records into output rows
+with scatter + cummax + a packed row-gather — measured at ~300 ms of a
+~900 ms honest 10Mx10M join (docs/ROOFLINE.md). All three are random-
+access primitives that XLA executes at ~10-20 ns/element. But the
+access pattern is NOT random: record start-slots ``S`` are sorted, so
+the records covering one block of output rows are a CONTIGUOUS window,
+and expansion is a streaming merge. This kernel exploits that:
+
+- grid over output blocks of ``B`` rows; a scalar-prefetched per-block
+  record offset (one tiny searchsorted outside) selects a 2B-record
+  window — since every record covers at least one output row, <= B+1
+  records cover a block, and a down-aligned 2B window always contains
+  them;
+- the window is DMA'd into VMEM at a dynamic offset (block-aligned so
+  Mosaic can prove tiling divisibility); record values live TRANSPOSED
+  as (lanes, m) so the windowed dimension is the 128-tiled one;
+- in-VMEM, chunked comparisons of output positions against the
+  window's start-slots isolate each row's covering record as a one-hot
+  column (cmp minus left-shifted cmp);
+- the "gather" is then ``values_window @ onehot^T`` on the MXU — the
+  TPU-native trick for data-dependent selection: a one-hot f32 matmul
+  copies exactly one element per output, bit-exactly, because every
+  partial product is 0 or the element itself.
+
+int64 value columns ride as 22-bit f32 chunks (f32 holds integers
+<= 2^24 exactly; split/recombined OUTSIDE the kernel with cheap
+elementwise ops), so arbitrary 64-bit payloads survive the float
+matmul without loss.
+
+Everything the kernel touches moves sequentially (record windows and
+output blocks); the only random access left in the join would be the
+build-side rank gather. ``expand_gather_reference`` is the XLA
+formulation used for correctness tests and as a CPU fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _split_rows(cols_u64: Sequence[jax.Array]):
+    """k 1-D uint64 columns -> list of 3k 1-D f32 rows of exact 22-bit
+    chunks (c0s, then c1s, then c2s)."""
+    rows = []
+    for shift, mask in ((0, 0x3FFFFF), (22, 0x3FFFFF), (44, 0xFFFFF)):
+        for c in cols_u64:
+            rows.append(
+                ((c >> jnp.uint64(shift)) & jnp.uint64(mask)).astype(
+                    jnp.float32
+                )
+            )
+    return rows
+
+
+def _merge_rows(rows_f32: jax.Array, k: int):
+    """(3k, n) f32 -> list of k 1-D uint64 columns."""
+    out = []
+    for i in range(k):
+        c0 = rows_f32[i].astype(jnp.uint64)
+        c1 = rows_f32[k + i].astype(jnp.uint64)
+        c2 = rows_f32[2 * k + i].astype(jnp.uint64)
+        out.append(c0 | (c1 << jnp.uint64(22)) | (c2 << jnp.uint64(44)))
+    return out
+
+
+def _expand_kernel(r0b_ref, s_hbm, v_hbm, out_ref, s_vmem, v_vmem, sem_s,
+                   sem_v, *, block: int, chunk: int = 256):
+    """Per-output-block body; see module docstring for the scheme.
+
+    Mosaic constraints shaping this code:
+    - dynamic DMA offsets must be PROVABLY divisible by the tiling
+      (1024 for 1-D int32, 128 lanes for 2-D f32): the window start is
+      down-aligned to a block multiple and passed pre-divided, so the
+      prover sees ``x * block``;
+    - the windowed dimension must be the 128-tiled LANE dimension:
+      values arrive transposed as (lane_rows, m);
+    - a full (block, 2*block) comparison matrix would blow VMEM at
+      block=1024 (8 MB per temporary), so the window is processed in
+      ``chunk``-wide slices, each one MXU matmul into the accumulator.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = block
+    i = pl.program_id(0)
+    w = r0b_ref[i] * b  # provably block-aligned
+    dma_s = pltpu.make_async_copy(s_hbm.at[pl.ds(w, 2 * b)], s_vmem, sem_s)
+    dma_v = pltpu.make_async_copy(
+        v_hbm.at[:, pl.ds(w, 2 * b)], v_vmem, sem_v
+    )
+    dma_s.start()
+    dma_v.start()
+    dma_s.wait()
+    dma_v.wait()
+
+    # Global output position of each row in this block, as a COLUMN
+    # (broadcasted_iota emits 2-D directly; Mosaic cannot reshape a
+    # 1-D vector into the sublane dimension).
+    j = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0) + i * b
+    s_win = s_vmem[...]
+    acc = jnp.zeros(out_ref.shape, jnp.float32)
+    for t in range(0, 2 * b, chunk):
+        # Record r covers j iff S[r] <= j and S[r+1] > j; the element
+        # past the window counts as "not started", which is exact (the
+        # last covering record sits strictly inside the window).
+        sl = s_win[t : t + chunk]
+        cmp_a = (sl[None, :] <= j).astype(jnp.float32)      # (b, chunk)
+        if t + chunk < 2 * b:
+            sl_b = s_win[t + 1 : t + chunk + 1]
+            cmp_b = (sl_b[None, :] <= j).astype(jnp.float32)
+        else:
+            sl_b = s_win[t + 1 : t + chunk]
+            cmp_b = jnp.pad(
+                (sl_b[None, :] <= j).astype(jnp.float32),
+                ((0, 0), (0, 1)),
+            )
+        onehot = cmp_a - cmp_b                              # {0,1}
+        # (ck, chunk) x (b, chunk) contracting chunk -> (ck, b); the
+        # transposed contraction avoids materializing onehot^T.
+        # Precision.HIGHEST: the default lets the MXU run this at bf16
+        # (8-bit mantissa), silently truncating the 22-bit chunks.
+        acc = acc + jax.lax.dot_general(
+            v_vmem[:, t : t + chunk], onehot,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    out_ref[...] = acc
+
+
+def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
+                  out_capacity: int, block: int = 1024,
+                  interpret: bool = False):
+    """For each output slot j in [0, out_capacity): find the covering
+    record r = max{r : S[r] <= j} and return each column's value at r.
+
+    S: (m,) int32, sorted ascending, unique among real records, with
+       INT32_MAX sentinels after them; S[0] == 0 whenever any real
+       record exists (the first record starts at slot 0).
+    cols: k 1-D uint64 arrays of length m.
+
+    Returns k 1-D uint64 arrays of length out_capacity.
+
+    ``block`` must be a multiple of 1024 on real TPUs (the 1-D int32
+    DMA tiling; the kernel proves window offsets divisible by it);
+    interpret mode accepts any block.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k = len(cols)
+    m = S.shape[0]
+    rows = _split_rows(cols)                         # 3k rows of (m,)
+    ck = _round_up(len(rows), 8)                     # f32 sublane tile
+    out_pad = _round_up(out_capacity, block)
+    pad_cols = out_pad + 2 * block - m
+    if pad_cols > 0:
+        S = jnp.concatenate(
+            [S, jnp.full((pad_cols,), 2**31 - 1, jnp.int32)]
+        )
+        rows = [
+            jnp.concatenate([r, jnp.zeros((pad_cols,), jnp.float32)])
+            for r in rows
+        ]
+    vT = jnp.stack(
+        rows + [jnp.zeros_like(rows[0])] * (ck - len(rows)), axis=0
+    )                                                # (ck, m_pad)
+
+    # Per-output-block record offset. A record's start slot is >= its
+    # index (each earlier record covers >= 1 slot), so r0[i] <= i*block
+    # and the [r0b*block, r0b*block + 2*block) windows stay in-bounds.
+    starts = jnp.arange(out_pad // block, dtype=jnp.int32) * block
+    r0 = jnp.maximum(
+        jnp.searchsorted(S, starts, side="right").astype(jnp.int32) - 1,
+        0,
+    )
+    r0b = r0 // block
+
+    # Under shard_map with vma checking, the out_shape must carry how
+    # the output varies over mesh axes — same as the inputs.
+    vma = getattr(jax.typeof(vT), "vma", None)
+    out_shape = (
+        jax.ShapeDtypeStruct((ck, out_pad), jnp.float32, vma=vma)
+        if vma is not None
+        else jax.ShapeDtypeStruct((ck, out_pad), jnp.float32)
+    )
+    # Global x64 breaks Mosaic legalization ("failed to legalize
+    # func.return" — i64 index plumbing); every type here is explicit
+    # i32/f32, so scope x64 off around the kernel. The offsets ride a
+    # plain SMEM input + manual DMA because PrefetchScalarGridSpec
+    # also fails to legalize with this toolchain.
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_expand_kernel, block=block),
+            grid=(out_pad // block,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=pl.BlockSpec((ck, block), lambda i: (0, i)),
+            scratch_shapes=[
+                pltpu.VMEM((2 * block,), jnp.int32),
+                pltpu.VMEM((ck, 2 * block), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+            out_shape=out_shape,
+            interpret=interpret,
+        )(r0b, S, vT)
+    return [c[:out_capacity] for c in _merge_rows(out, k)]
+
+
+def expand_gather_reference(S: jax.Array, cols: Sequence[jax.Array],
+                            out_capacity: int):
+    """XLA reference (the ops/join.py formulation: one scatter + cummax
+    + row gather), for correctness tests and as a CPU fallback."""
+    r = jnp.arange(S.shape[0], dtype=jnp.int32)
+    raw = jnp.zeros((out_capacity,), jnp.int32).at[S].set(
+        r + 1, mode="drop", unique_indices=True
+    )
+    ridx = jnp.clip(lax.cummax(raw) - 1, 0, S.shape[0] - 1)
+    return [c[ridx] for c in cols]

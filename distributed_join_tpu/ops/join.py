@@ -112,6 +112,102 @@ class JoinResult:
     overflow: jax.Array   # bool: total > capacity, rows were truncated
 
 
+def _to_u64_lane(c: jax.Array):
+    """Bit-exact uint64 encoding of a column, or None if impossible on
+    TPU (f64: the x64 bitcast rewrite is unimplemented there)."""
+    dt = c.dtype
+    if dt in (jnp.int64, jnp.uint64):
+        return c.astype(jnp.uint64)  # two's-complement wrap: same bits
+    if jnp.issubdtype(dt, jnp.integer) and jnp.iinfo(dt).bits <= 32:
+        # zero-extend the BIT PATTERN (astype of signed would
+        # sign-extend and change the upper lanes)
+        unsigned = jnp.dtype(f"uint{jnp.iinfo(dt).bits}")
+        return c.astype(unsigned).astype(jnp.uint64)
+    if dt == jnp.float32:
+        return lax.bitcast_convert_type(c, jnp.uint32).astype(jnp.uint64)
+    return None
+
+
+def _from_u64_lane(c64: jax.Array, dt):
+    if dt in (jnp.int64, jnp.uint64):
+        return c64.astype(dt)
+    if jnp.issubdtype(dt, jnp.integer):
+        unsigned = jnp.dtype(f"uint{jnp.iinfo(dt).bits}")
+        return c64.astype(unsigned).astype(dt)
+    if dt == jnp.float32:
+        return lax.bitcast_convert_type(
+            c64.astype(jnp.uint32), jnp.float32
+        )
+    raise TypeError(dt)
+
+
+def _expand_records(S, recs: dict, out_capacity: int, j):
+    """Broadcast each record's values down its output run.
+
+    Returns ``(out_vals: name -> (out_capacity,) array, start_b)``
+    where start_b[i] is the first output slot of slot i's run.
+
+    XLA path: one unique-slot int32 scatter + cummax gives each slot
+    its record index; packed row-gathers per dtype group pull the
+    values; start_b is a second cummax over the raw marks.
+
+    Pallas path (default on TPU; DJTPU_PALLAS_EXPAND=0 disables, =1
+    forces it through the interpreter elsewhere; non-f64 columns only):
+    the streaming one-hot-matmul kernel of ops/expand_pallas.py, with
+    start_b riding as one more u64 lane (= S itself). Measured on v5e:
+    27.5 vs 22.0 M rows/s/chip end-to-end on the honest 10Mx10M bench.
+    """
+    import os
+
+    env = os.environ.get("DJTPU_PALLAS_EXPAND")
+    if env == "0":
+        use_pallas = False
+    elif env == "1":
+        use_pallas = True
+    else:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        # Inside shard_map the scalar-prefetch index_map mixes
+        # rank-varying offsets with the unvarying grid index, which
+        # the vma checker rejects; scope the kernel to the non-mapped
+        # (single-rank / LocalCommunicator) path for now.
+        if getattr(jax.typeof(S), "vma", None):
+            use_pallas = False
+    if use_pallas:
+        lanes = {nm: _to_u64_lane(c) for nm, c in recs.items()}
+        if all(v is not None for v in lanes.values()):
+            from distributed_join_tpu.ops.expand_pallas import (
+                expand_gather,
+            )
+
+            names = list(lanes)
+            cols = [lanes[nm] for nm in names] + [
+                S.astype(jnp.uint32).astype(jnp.uint64)
+            ]
+            gathered = expand_gather(
+                S, cols, out_capacity,
+                # Mosaic targets TPU; everywhere else (the CPU test
+                # mesh) the kernel runs interpreted.
+                interpret=jax.default_backend() != "tpu",
+            )
+            out_vals = {
+                nm: _from_u64_lane(gathered[i], recs[nm].dtype)
+                for i, nm in enumerate(names)
+            }
+            start_b = gathered[-1].astype(jnp.int32)
+            return out_vals, start_b
+
+    raw = jnp.zeros((out_capacity,), jnp.int32).at[S].set(
+        j + 1, mode="drop", unique_indices=True
+    )
+    ridx = jnp.maximum(lax.cummax(raw) - 1, 0)
+    out_vals = _grouped_row_gather(recs, ridx)
+    # The run's first slot is where its raw mark landed — cheaper as an
+    # out-domain cummax than as another ridden sort lane.
+    start_b = lax.cummax(jnp.where(raw > 0, j, 0))
+    return out_vals, start_b
+
+
 def _grouped_row_gather(cols: dict, idx: jax.Array) -> dict:
     """Gather rows ``idx`` from every 1-D column, one packed 2-D gather
     per dtype group (columns of a dtype are stacked, gathered once,
@@ -308,22 +404,17 @@ def sort_merge_inner_join(
         for nm, c in zip(rec_names, sorted_r[1:])
     }
 
-    # -- 5. ONE small scatter posts each record's index at its first
-    #    output slot (unique; sentinels drop) and a cummax broadcasts it
-    #    down the run; a packed row-gather per dtype group then pulls
-    #    every probe-side value plus the run geometry from the records,
-    #    and the build-side gather reads the step-1 sorted prefix at the
-    #    in-run build rank.
+    # -- 5. expansion: either ONE small scatter + cummax + packed row
+    #    gathers (XLA primitives), or the Pallas streaming kernel
+    #    (ops/expand_pallas.py) that replaces all three with sequential
+    #    record windows + a one-hot MXU matmul. The kernel path is
+    #    DEFAULT ON TPU (DJTPU_PALLAS_EXPAND=0 disables, =1 forces
+    #    the interpreter elsewhere); falls back for dtypes a u64 lane
+    #    can't carry bit-exactly on TPU (f64: x64 bitcast is not
+    #    implemented there) and inside shard_map.
     j = jnp.arange(out_capacity, dtype=jnp.int32)
-    raw = jnp.zeros((out_capacity,), jnp.int32).at[S].set(
-        j + 1, mode="drop", unique_indices=True
-    )
-    ridx = jnp.maximum(lax.cummax(raw) - 1, 0)
-    out_vals = _grouped_row_gather(recs, ridx)
+    out_vals, start_b = _expand_records(S, recs, out_capacity, j)
     lo_b = out_vals.pop("__lo").astype(jnp.int32)
-    # The run's first slot is where its raw mark landed — cheaper as an
-    # out-domain cummax than as another ridden sort lane.
-    start_b = lax.cummax(jnp.where(raw > 0, j, 0))
     build_rank = lo_b + (j - start_b)
     safe_rank = jnp.clip(build_rank, 0, max(nb - 1, 0))
 

@@ -227,28 +227,32 @@ def batched_join_host(
     fut = (pool.submit(lambda: nxt) if nxt is not None
            else pool.submit(stage, 0))
     totals, overflows = [], []
-    for b in range(n_batches):
-        bt, pt = fut.result()
-        td = time.perf_counter()
-        res = fn(bt, pt)
-        phase["dispatch_s"] += time.perf_counter() - td
-        totals.append(res.total)
-        overflows.append(res.overflow)
-        if b + 1 < n_batches:
-            # Stage b+1 on the worker thread, overlapping both batch
-            # b's device work and the backpressure wait below.
-            fut = pool.submit(stage, b + 1)
-            if b >= 1:
-                # Backpressure (see docstring): b-1 must be done before
-                # a third batch's buffers exist. A scalar fetch, not
-                # block_until_ready — the only sync that also holds
-                # under this environment's RPC relay.
-                tf = time.perf_counter()
-                totals[b - 1] = int(totals[b - 1])
-                phase["fetch_s"] += time.perf_counter() - tf
-        if on_batch_result is not None:
-            on_batch_result(b, res)
-    pool.shutdown(wait=False)
+    try:
+        for b in range(n_batches):
+            bt, pt = fut.result()
+            td = time.perf_counter()
+            res = fn(bt, pt)
+            phase["dispatch_s"] += time.perf_counter() - td
+            totals.append(res.total)
+            overflows.append(res.overflow)
+            if b + 1 < n_batches:
+                # Stage b+1 on the worker thread, overlapping both
+                # batch b's device work and the backpressure wait.
+                fut = pool.submit(stage, b + 1)
+                if b >= 1:
+                    # Backpressure (see docstring): b-1 must be done
+                    # before a third batch's buffers exist. A scalar
+                    # fetch, not block_until_ready — the only sync that
+                    # also holds under this environment's RPC relay.
+                    tf = time.perf_counter()
+                    totals[b - 1] = int(totals[b - 1])
+                    phase["fetch_s"] += time.perf_counter() - tf
+            if on_batch_result is not None:
+                on_batch_result(b, res)
+    finally:
+        # Also on error: an orphaned stage() worker would hang the
+        # interpreter at exit via ThreadPoolExecutor's atexit join.
+        pool.shutdown(wait=False, cancel_futures=True)
     tf = time.perf_counter()
     total = sum(int(t) for t in totals)
     overflow = any(bool(o) for o in overflows)
