@@ -13,9 +13,10 @@ up one level of abstraction:
 - ``initialize`` becomes mesh construction + (multi-host) the JAX
   distributed runtime handshake — coordinator over TCP/DCN replaces the
   reference's ``MPI_Bcast`` of the NCCL unique id (SURVEY.md §3.3);
-- ``waitall`` disappears: XLA schedules the collective asynchronously
-  inside the compiled program and overlaps it with compute, which is the
-  reference's over-decomposition pipeline done by the compiler.
+- ``waitall`` disappears: the collective completes inside the compiled
+  program with no user-visible handle. (Whether the compiler ALSO
+  overlaps it with compute is an empirical question — measured in
+  round 2: on the v5e toolchain it does not; see docs/OVERLAP.md.)
 
 Implementations:
 

@@ -5,15 +5,21 @@ TPU re-design of the reference's ``distributed_inner_join``
 local join, with over-decomposition batching. Two deliberate departures
 from the reference's shape:
 
-- The whole pipeline is ONE compiled SPMD program (``jit(shard_map)``):
-  the reference hand-pipelines comm of batch b+1 against the join of
-  batch b on CUDA streams with helper threads; under XLA the unrolled
-  batch loop exposes the same overlap to the compiler's async collective
-  scheduler, so there is no stream/thread machinery to write.
-- Over-decomposition's second purpose — capping resident shuffled data
-  at 1/k of the table (the reference's answer to tables bigger than
-  device memory, SURVEY.md §5 "Long-context") — is preserved: each batch
-  materializes only its own shuffle buffers and join output block.
+- The whole pipeline is ONE compiled SPMD program (``jit(shard_map)``).
+  The reference hand-pipelines comm of batch b+1 against the join of
+  batch b on CUDA streams with helper threads. Round 2 MEASURED what
+  XLA does with the unrolled batch loop on the v5e toolchain: the
+  all-to-alls lower as SYNCHRONOUS HLO ops scheduled back to back —
+  no async start/done pairs, no comm/compute interleaving (the
+  compiled-schedule artifacts and what explicit overlap would take
+  are in docs/OVERLAP.md). Over-decomposition here therefore buys
+  memory capping, not overlap.
+- That second purpose — capping resident shuffled data at 1/k of the
+  table (the reference's answer to tables bigger than device memory,
+  SURVEY.md §5 "Long-context") — is preserved: each batch materializes
+  only its own shuffle buffers and join output block. The overlap that
+  IS real and measured lives in the host staging thread of
+  parallel/out_of_core.py.
 
 Bucket arithmetic: with n ranks and over-decomposition factor k, rows
 hash into ``bucket = h % (k*n)``; ``dest = bucket % n`` and
