@@ -65,6 +65,10 @@ def parse_args(argv=None):
     p.add_argument("--duplicate-build-keys", action="store_true",
                    help="draw build keys with replacement (default: unique)")
     p.add_argument("--over-decomposition-factor", type=int, default=1)
+    p.add_argument("--shuffle", choices=["padded", "ragged"],
+                   default="padded",
+                   help="ragged = exact-size lax.ragged_all_to_all "
+                        "exchange (no pad bytes on the wire)")
     p.add_argument("--communicator", default="tpu",
                    help="tpu | local (NCCL/UCX are the reference's GPU "
                         "backends and are rejected with guidance)")
@@ -170,6 +174,7 @@ def run(args) -> dict:
     step = make_join_step(
         comm,
         key=join_key,
+        shuffle=args.shuffle,
         over_decomposition=args.over_decomposition_factor,
         shuffle_capacity_factor=args.shuffle_capacity_factor,
         out_capacity_factor=args.out_capacity_factor,
@@ -195,6 +200,7 @@ def run(args) -> dict:
         "probe_table_nrows": p_rows,
         "selectivity": args.selectivity,
         "over_decomposition_factor": args.over_decomposition_factor,
+        "shuffle": args.shuffle,
         "zipf_alpha": args.zipf_alpha,
         "skew_threshold": args.skew_threshold,
         "key_columns": args.key_columns,

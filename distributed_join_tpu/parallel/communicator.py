@@ -79,6 +79,57 @@ class Communicator(abc.ABC):
         (e.g. after a psum).
         """
 
+    def axis_index(self):
+        """This rank's index, traced (0 on a single-rank backend)."""
+        return jnp.int32(0)
+
+    def ragged_all_to_all(self, operand, output, input_offsets,
+                          send_sizes, output_offsets, recv_sizes):
+        """Exact-size exchange (the reference's offsets+sizes send/recv
+        loop as ONE op): peer i receives ``operand[input_offsets[i] :
+        + send_sizes[i]]`` written at ``output_offsets[i]`` of its
+        ``output`` buffer. All size/offset vectors are (n_ranks,) int32
+        and must be mutually consistent across ranks (see
+        parallel/shuffle.ragged_plan). Must be called inside
+        :meth:`spmd`. Returns the filled output buffer."""
+        return self._ragged_emulate(
+            operand, output, input_offsets, send_sizes,
+            output_offsets, recv_sizes,
+        )
+
+    def pvary(self, x):
+        """Mark ``x`` as varying over the rank axis for shard_map's
+        vma checker (identity on single-rank backends)."""
+        return x
+
+    # Emulation of ragged_all_to_all for backends/platforms without the
+    # hardware op (XLA:CPU has no ragged-all-to-all thunk). Assembles
+    # the output from all-gathered operands with static-shape masked
+    # copies — wire-inefficient by construction, but bit-identical in
+    # semantics; tests and the virtual-device mesh run through it.
+    def _ragged_emulate(self, operand, output, input_offsets, send_sizes,
+                        output_offsets, recv_sizes):
+        n = self.n_ranks
+        me = self.axis_index()
+        g_op = self.all_gather(operand[None, ...])        # (n, len, ...)
+        g_in = self.all_gather(input_offsets[None, :])    # (n, n)
+        g_sz = self.all_gather(send_sizes[None, :])
+        g_out = self.all_gather(output_offsets[None, :])
+        out = output
+        idx = jnp.arange(output.shape[0], dtype=jnp.int32)
+        for j in range(n):
+            in_off = g_in[j, me]
+            sz = g_sz[j, me]
+            out_off = g_out[j, me]
+            rel = idx - out_off
+            take = (rel >= 0) & (rel < sz)
+            src = g_op[j][
+                jnp.clip(in_off + rel, 0, operand.shape[0] - 1)
+            ]
+            mask = take.reshape((-1,) + (1,) * (out.ndim - 1))
+            out = jnp.where(mask, src, out)
+        return out
+
     # -- small conveniences shared by backends ------------------------
 
     def psum(self, x):
@@ -111,6 +162,59 @@ class TpuCommunicator(Communicator):
 
     def all_gather(self, x: jax.Array) -> jax.Array:
         return lax.all_gather(x, self.axis_name, axis=0, tiled=True)
+
+    def axis_index(self):
+        return lax.axis_index(self.axis_name)
+
+    def pvary(self, x):
+        # Idempotent: lax.pvary rejects already-varying inputs.
+        vma = getattr(jax.typeof(x), "vma", None) or frozenset()
+        if self.axis_name in vma:
+            return x
+        return lax.pvary(x, self.axis_name)
+
+    def ragged_all_to_all(self, operand, output, input_offsets,
+                          send_sizes, output_offsets, recv_sizes):
+        if jax.default_backend() != "tpu":
+            # XLA:CPU has no ragged-all-to-all thunk.
+            return self._ragged_emulate(
+                operand, output, input_offsets, send_sizes,
+                output_offsets, recv_sizes,
+            )
+        dt = operand.dtype
+        if dt.itemsize == 8 and jnp.issubdtype(dt, jnp.integer):
+            # The TPU x64 rewriter does not implement 64-bit
+            # ragged-all-to-all; integer bitcasts ARE implemented, so
+            # 64-bit integer operands ride as (rows, ..., 2) uint32.
+            u = lax.bitcast_convert_type(operand, jnp.uint32)
+            out_u = lax.bitcast_convert_type(output, jnp.uint32)
+            res = lax.ragged_all_to_all(
+                u, out_u, input_offsets, send_sizes,
+                output_offsets, recv_sizes, axis_name=self.axis_name,
+            )
+            return lax.bitcast_convert_type(res, dt)
+        if dt.itemsize == 8:
+            # f64: neither the 64-bit op nor an f64 bitcast exists on
+            # TPU. The emulation is correct but all-gathers the whole
+            # column — MORE wire bytes than the padded shuffle; warn
+            # (once per trace) so the regression is never silent.
+            import warnings
+
+            warnings.warn(
+                "ragged_all_to_all: f64 operands fall back to an "
+                "all-gather emulation on TPU, which moves MORE bytes "
+                "than the padded shuffle; keep f64 columns on "
+                "shuffle='padded'",
+                stacklevel=2,
+            )
+            return self._ragged_emulate(
+                operand, output, input_offsets, send_sizes,
+                output_offsets, recv_sizes,
+            )
+        return lax.ragged_all_to_all(
+            operand, output, input_offsets, send_sizes,
+            output_offsets, recv_sizes, axis_name=self.axis_name,
+        )
 
     def psum(self, x):
         return lax.psum(x, self.axis_name)

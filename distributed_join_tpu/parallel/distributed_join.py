@@ -39,7 +39,10 @@ import jax.numpy as jnp
 from distributed_join_tpu.ops.join import JoinResult, sort_merge_inner_join
 from distributed_join_tpu.ops.partition import radix_hash_partition
 from distributed_join_tpu.parallel.communicator import Communicator
-from distributed_join_tpu.parallel.shuffle import shuffle_padded
+from distributed_join_tpu.parallel.shuffle import (
+    shuffle_padded,
+    shuffle_ragged,
+)
 from distributed_join_tpu.table import Table
 
 
@@ -53,7 +56,14 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int):
+def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
+                   mode: str = "padded"):
+    if mode == "ragged":
+        # Exact-size exchange: receive buffer = the same total rows the
+        # padded layout would flatten to, but wire bytes = actual rows.
+        return shuffle_ragged(
+            comm, pt, n_ranks * capacity, bucket_start=batch * n_ranks
+        )
     padded, counts, overflow, _ = pt.to_padded(
         capacity, bucket_start=batch * n_ranks, n_buckets=n_ranks
     )
@@ -74,8 +84,21 @@ def make_join_step(
     hh_slots: int = DEFAULT_HH_SLOTS,
     hh_build_capacity: Optional[int] = None,
     hh_out_capacity: Optional[int] = None,
+    shuffle: str = "padded",
 ):
     """The raw per-rank join step (partition -> shuffle -> local join).
+
+    ``shuffle``: "padded" (capacity-padded all_to_all, the default) or
+    "ragged" (exact-size ``lax.ragged_all_to_all`` — wire bytes equal
+    actual rows). Capacity semantics DIFFER between the modes: padded
+    enforces a per-(sender, destination) bucket capacity, checked
+    sender-side, while ragged pools the receiver's whole buffer
+    (n_ranks x the per-bucket capacity) and clamps receiver-side — a
+    single hot bucket that overflows padded mode can fit in ragged
+    mode, so auto_retry may fire under one mode and not the other.
+    The ragged hardware op exists only on TPU; other backends
+    transparently run the bit-identical emulation
+    (Communicator.ragged_all_to_all).
 
     Returns ``step(build_local, probe_local) -> JoinResult`` meant to run
     inside ``comm.spmd`` (collectives are unresolved outside it). Exposed
@@ -109,6 +132,11 @@ def make_join_step(
     k = over_decomposition
     if k < 1:
         raise ValueError("over_decomposition must be >= 1")
+    if shuffle not in ("padded", "ragged"):
+        # Validate for EVERY config — the single-rank path never
+        # reaches the shuffle, and a typo'd mode must not silently
+        # report success.
+        raise ValueError(f"unknown shuffle mode {shuffle!r}")
     nb = k * n
 
     keys = [key] if isinstance(key, str) else list(key)
@@ -195,8 +223,10 @@ def make_join_step(
             ptb = radix_hash_partition(build_local, keys, nb)
             ptp = radix_hash_partition(probe_local, keys, nb)
             for b in range(k):
-                recv_build, ovf_b = _batch_shuffle(comm, ptb, b, n, b_cap)
-                recv_probe, ovf_p = _batch_shuffle(comm, ptp, b, n, p_cap)
+                recv_build, ovf_b = _batch_shuffle(
+                    comm, ptb, b, n, b_cap, mode=shuffle)
+                recv_probe, ovf_p = _batch_shuffle(
+                    comm, ptp, b, n, p_cap, mode=shuffle)
                 res = sort_merge_inner_join(
                     recv_build, recv_probe, keys, out_cap,
                     build_payload=build_payload, probe_payload=probe_payload,

@@ -18,6 +18,17 @@ Bandwidth note: padding inflates bytes on the wire by ~1/load-factor.
 For uniform keys capacity_factor ~1.2-1.5 keeps that small; the skew
 path exists precisely because one hot bucket would otherwise set the pad
 for everyone (SURVEY.md §7 hard part #2).
+
+:func:`shuffle_ragged` removes the pad bytes entirely — the reference's
+exact-size exchange (counts first, then exactly ``count`` rows per
+peer), expressed with ``lax.ragged_all_to_all``. Phase 1 becomes an
+``all_gather`` of each rank's count vector: the full (n, n) count
+matrix is what lets every rank compute, consistently and without more
+communication, its send/recv sizes, where its blocks land in every
+receiver's buffer, and a deterministic clamp when a receiver's static
+output capacity would overflow. The hardware op only exists on TPU
+(XLA:CPU has no ragged-all-to-all thunk), so non-TPU backends run a
+bit-identical emulation — see ``Communicator.ragged_all_to_all``.
 """
 
 from __future__ import annotations
@@ -25,6 +36,7 @@ from __future__ import annotations
 from typing import Tuple
 
 import jax
+import jax.numpy as jnp
 
 from distributed_join_tpu.ops.partition import PartitionedTable, unpad
 from distributed_join_tpu.parallel.communicator import Communicator
@@ -39,6 +51,67 @@ def shuffle_padded(
     recv_counts = comm.all_to_all(counts)
     recv_cols = {n: comm.all_to_all(c) for n, c in padded_columns.items()}
     return unpad(recv_cols, recv_counts, capacity), recv_counts
+
+
+def ragged_plan(comm: Communicator, counts: jax.Array, out_capacity: int):
+    """Phase 1 of the exact-size shuffle: from each rank's (n,) count
+    vector, build the consistent transfer plan every rank needs.
+
+    Returns ``(send_sizes, recv_sizes, output_offsets, total_recv,
+    overflow)`` where entry i of ``output_offsets`` is where THIS
+    rank's block starts in rank i's output buffer. Sizes are clamped
+    deterministically (identically on every rank, from the shared
+    count matrix) so no write can pass ``out_capacity``; any clamping
+    raises the overflow flag on the affected receiver.
+    """
+    n = comm.n_ranks
+    me = comm.axis_index()
+    # Full count matrix: M[j, i] = rows rank j sends to rank i.
+    M = comm.all_gather(counts).reshape(n, n)
+    # Receiver-side packing: rank i's buffer concatenates blocks from
+    # senders in rank order. start[j, i] = exclusive prefix down col i.
+    start = jnp.cumsum(M, axis=0) - M
+    allowed = jnp.clip(out_capacity - start, 0, M)
+    overflow = jnp.any(allowed[:, me] < M[:, me])
+    send_sizes = comm.pvary(allowed[me, :].astype(jnp.int32))
+    recv_sizes = comm.pvary(allowed[:, me].astype(jnp.int32))
+    output_offsets = comm.pvary(start[me, :].astype(jnp.int32))
+    total_recv = jnp.sum(recv_sizes)
+    return send_sizes, recv_sizes, output_offsets, total_recv, \
+        comm.pvary(overflow)
+
+
+def shuffle_ragged(
+    comm: Communicator,
+    pt: PartitionedTable,
+    out_capacity: int,
+    bucket_start: int = 0,
+) -> Tuple[Table, jax.Array]:
+    """Exact-size shuffle of ``n_ranks`` buckets starting at
+    ``bucket_start``: wire bytes = actual rows, not padded capacity.
+
+    Returns (received table with a valid-prefix mask, overflow flag).
+    The received rows pack contiguously in sender-rank order; rows a
+    clamped transfer dropped are reported via the flag, never silently
+    presented as success.
+    """
+    n = comm.n_ranks
+    counts = pt.counts[bucket_start : bucket_start + n].astype(jnp.int32)
+    offsets = pt.offsets[bucket_start : bucket_start + n].astype(jnp.int32)
+    send_sizes, recv_sizes, output_offsets, total_recv, overflow = (
+        ragged_plan(comm, counts, out_capacity)
+    )
+    # One gather per column materializes the bucket-sorted layout the
+    # input offsets point into (no padding, unlike to_padded).
+    sorted_table = pt.table
+    out_cols = {}
+    for name, col in sorted_table.columns.items():
+        out = jnp.zeros((out_capacity,) + col.shape[1:], col.dtype)
+        out_cols[name] = comm.ragged_all_to_all(
+            col, out, offsets, send_sizes, output_offsets, recv_sizes
+        )
+    valid = jnp.arange(out_capacity, dtype=jnp.int32) < total_recv
+    return Table(out_cols, valid), overflow
 
 
 def shuffle_partitioned(
