@@ -76,7 +76,7 @@ def _merge_rows(rows_f32: jax.Array, k: int):
 
 
 def _expand_kernel(r0b_ref, s_hbm, v_hbm, out_ref, s_vmem, v_vmem, sem_s,
-                   sem_v, *, block: int, chunk: int = 256):
+                   sem_v, *, block: int, chunk: int):
     """Per-output-block body; see module docstring for the scheme.
 
     Mosaic constraints shaping this code:
@@ -141,7 +141,7 @@ def _expand_kernel(r0b_ref, s_hbm, v_hbm, out_ref, s_vmem, v_vmem, sem_s,
 
 
 def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
-                  out_capacity: int, block: int = 1024,
+                  out_capacity: int, block: int | None = None,
                   interpret: bool = False):
     """For each output slot j in [0, out_capacity): find the covering
     record r = max{r : S[r] <= j} and return each column's value at r.
@@ -157,9 +157,13 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
     DMA tiling; the kernel proves window offsets divisible by it);
     interpret mode accepts any block.
     """
+    import os
+
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if block is None:
+        block = int(os.environ.get("DJTPU_PALLAS_BLOCK", "1024"))
     k = len(cols)
     m = S.shape[0]
     rows = _split_rows(cols)                         # 3k rows of (m,)
@@ -203,7 +207,10 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
     # also fails to legalize with this toolchain.
     with jax.enable_x64(False):
         out = pl.pallas_call(
-            functools.partial(_expand_kernel, block=block),
+            functools.partial(
+                _expand_kernel, block=block,
+                chunk=int(os.environ.get("DJTPU_PALLAS_CHUNK", "256")),
+            ),
             grid=(out_pad // block,),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),
