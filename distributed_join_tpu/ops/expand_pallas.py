@@ -1,5 +1,5 @@
-"""Pallas expand-gather: the join's output expansion as one streaming
-kernel.
+"""Pallas expand-gather: the join's output expansion AND build-side
+materialization as one streaming kernel.
 
 The join core (ops/join.py) turns compact run records into output rows
 with scatter + cummax + a packed row-gather — measured at ~300 ms of a
@@ -30,24 +30,126 @@ int64 value columns ride as 22-bit f32 chunks (f32 holds integers
 elementwise ops), so arbitrary 64-bit payloads survive the float
 matmul without loss.
 
-Everything the kernel touches moves sequentially (record windows and
-output blocks); the only random access left in the join would be the
-build-side rank gather. ``expand_gather_reference`` is the XLA
+Build-side materialization (round 2, second pass): the join's last
+random access was the build-rank output gather (~180 ms at 10Mx10M —
+one XLA gather of the key-sorted build pack at
+``rank = lo[rec] + (j - S[rec])``). Those ranks are NOT random either.
+Records tile the output contiguously (``S[r+1] = S[r] + cnt[r]``) and
+``lo`` is non-decreasing over records (it is a prefix count of build
+rows in merged key order), which bounds the ranks any B-row output
+block can touch by TWO windows over the build pack:
+
+- the block's STRADDLING record r0 (the unique record whose run covers
+  the block start) contributes the contiguous range
+  ``[lo[r0] + (i*B - S[r0]), +B)``;
+- every later record r covering the block has ``lo[r] >= lo[r0+1]``,
+  and — WHEN every build key between two in-block records' keys also
+  has probe matches — the middle records' runs lie inside the block so
+  their total length bounds the increase of ``lo`` across them by B,
+  pinning all non-straddler ranks inside ``[lo[r0+1], lo[r0+1] + 2B)``.
+
+The parenthetical is a DATA property, not a theorem: build keys with
+zero probe matches advance ``lo`` without producing records, so a gap
+of unmatched builds between two matched keys whose output rows share a
+block pushes later ranks past window 2. :func:`build_windows_ok`
+checks the exact per-block condition OUTSIDE the kernel — ``lo`` is
+non-decreasing over records, so the largest in-block ``lo`` is just
+``lo[r0[i+1]]`` and the check is O(out/B) gathers — and the caller
+(ops/join.py) `lax.cond`s between this kernel and the XLA gather
+fallback on the result. Wrong-window selections are thereby
+impossible by construction rather than improbable by heuristic.
+
+So the kernel DMAs two build windows (B+256 and 2B+256 wide, offsets
+128-aligned outside) and selects each row's build values with a second
+one-hot matmul against ``rank``, computed in-kernel from two extra f32
+rows (``lo - S`` and ``S``) that ride the record window; rows choose
+window 1 iff their run started at or before the block start
+(``S_j <= i*B``), which makes the two selections disjoint and exact.
+
+Everything the kernel touches moves sequentially (record windows,
+build windows, output blocks); the join's output path has no
+per-element random access left. ``expand_gather_reference`` is the XLA
 formulation used for correctness tests and as a CPU fallback.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+# f32 holds integers up to 2^24 exactly; the rank arithmetic rides f32
+# lanes, so the build path is only taken when every quantity involved
+# (build rows, output capacity) stays below this.
+_F32_EXACT = 1 << 24
+
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
+
+
+def _default_block() -> int:
+    import os
+
+    return int(os.environ.get("DJTPU_PALLAS_BLOCK", "1024"))
+
+
+def _default_chunk(block: int) -> int:
+    """Shared by the kernel, the compaction kernel, and
+    build_windows_ok — window geometry and its validity check MUST
+    parse the same knobs identically or the checker would validate a
+    different geometry than the kernel DMAs."""
+    import os
+
+    chunk = min(int(os.environ.get("DJTPU_PALLAS_CHUNK", "256")), block)
+    assert block % chunk == 0, (block, chunk)
+    return chunk
+
+
+def _window_widths(block: int, chunk: int):
+    """Build-window VMEM widths: wide enough for the proof bounds
+    (straddler: B ranks; rest: 2B) plus 127 of down-alignment slop,
+    rounded so the chunked compare loop and the 128-lane tile divide
+    them exactly."""
+    lane = max(chunk, 128)
+    w1w = _round_up(block + 128, lane)
+    w2w = _round_up(2 * block + 256, lane)
+    return w1w, w2w
+
+
+def build_windows_ok(S: jax.Array, lo: jax.Array, out_capacity: int,
+                     block: int | None = None) -> jax.Array:
+    """Exact per-run-of-blocks validity of the two-window build scheme.
+
+    Window 2 of output block i covers ranks
+    ``[align128(lo[r0[i]+1]), +w2w)``; the largest rank any
+    non-straddler row in the block can need is ``lo[r1] + B - 1`` with
+    ``r1 = r0[i+1]`` (``lo`` is non-decreasing over records, so the
+    last record intersecting the block has the block's largest lo).
+    Build keys with zero probe matches advance ``lo`` without emitting
+    records, so this can exceed the window — a DATA property the
+    kernel cannot bound a priori. Returns a traced bool: True iff
+    every block's needs fit, i.e. the kernel path is exact;
+    ops/join.py conds to the XLA gather otherwise.
+    """
+    if block is None:
+        block = _default_block()
+    _, w2w = _window_widths(block, _default_chunk(block))
+    m = S.shape[0]
+    out_pad = _round_up(out_capacity, block)
+    nblk = out_pad // block
+    starts = jnp.arange(nblk + 1, dtype=jnp.int32) * block
+    r0 = jnp.maximum(
+        jnp.searchsorted(S, starts, side="right").astype(jnp.int32) - 1,
+        0,
+    )
+    lo_i = lo.astype(jnp.int32)
+    w2 = lo_i[jnp.minimum(r0[:-1] + 1, m - 1)]
+    hi = lo_i[r0[1:]] + block  # > any non-straddler in-block rank
+    return ~jnp.any(hi > w2 + (w2w - 128))
 
 
 def _split_rows(cols_u64: Sequence[jax.Array]):
@@ -75,24 +177,36 @@ def _merge_rows(rows_f32: jax.Array, k: int):
     return out
 
 
-def _expand_kernel(r0b_ref, s_hbm, v_hbm, out_ref, s_vmem, v_vmem, sem_s,
-                   sem_v, *, block: int, chunk: int):
+def _expand_kernel(*refs, block: int, chunk: int, ck: int, ckb: int,
+                   crow: int, srow: int, w1w: int, w2w: int):
     """Per-output-block body; see module docstring for the scheme.
 
     Mosaic constraints shaping this code:
     - dynamic DMA offsets must be PROVABLY divisible by the tiling
-      (1024 for 1-D int32, 128 lanes for 2-D f32): the window start is
-      down-aligned to a block multiple and passed pre-divided, so the
-      prover sees ``x * block``;
+      (1024 for 1-D int32, 128 lanes for 2-D f32): window starts are
+      down-aligned and passed pre-divided, so the prover sees
+      ``x * block`` / ``x * 128``;
     - the windowed dimension must be the 128-tiled LANE dimension:
       values arrive transposed as (lane_rows, m);
     - a full (block, 2*block) comparison matrix would blow VMEM at
-      block=1024 (8 MB per temporary), so the window is processed in
-      ``chunk``-wide slices, each one MXU matmul into the accumulator.
+      block=1024 (8 MB per temporary), so windows are processed in
+      ``chunk``-wide slices, each one MXU matmul into the accumulator;
+    - the per-row rank/start scalars needed for the build windows are
+      accumulated as (block, 1) COLUMNS via matvecs against the same
+      one-hot (Mosaic cannot cheaply transpose a lane-oriented row
+      into the sublane dimension).
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    build = ckb > 0
+    if build:
+        (r0b_ref, w1a_ref, w2a_ref, s_hbm, v_hbm, bv_hbm, out_ref,
+         s_vmem, v_vmem, b1_vmem, b2_vmem, sem_s, sem_v, sem_b1,
+         sem_b2) = refs
+    else:
+        (r0b_ref, w1a_ref, w2a_ref, s_hbm, v_hbm, bv_hbm, out_ref,
+         s_vmem, v_vmem, sem_s, sem_v) = refs
     b = block
     i = pl.program_id(0)
     w = r0b_ref[i] * b  # provably block-aligned
@@ -102,6 +216,17 @@ def _expand_kernel(r0b_ref, s_hbm, v_hbm, out_ref, s_vmem, v_vmem, sem_s,
     )
     dma_s.start()
     dma_v.start()
+    if build:
+        o1 = w1a_ref[i] * 128  # provably lane-tile-aligned
+        o2 = w2a_ref[i] * 128
+        dma_b1 = pltpu.make_async_copy(
+            bv_hbm.at[:, pl.ds(o1, w1w)], b1_vmem, sem_b1
+        )
+        dma_b2 = pltpu.make_async_copy(
+            bv_hbm.at[:, pl.ds(o2, w2w)], b2_vmem, sem_b2
+        )
+        dma_b1.start()
+        dma_b2.start()
     dma_s.wait()
     dma_v.wait()
 
@@ -110,7 +235,9 @@ def _expand_kernel(r0b_ref, s_hbm, v_hbm, out_ref, s_vmem, v_vmem, sem_s,
     # 1-D vector into the sublane dimension).
     j = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0) + i * b
     s_win = s_vmem[...]
-    acc = jnp.zeros(out_ref.shape, jnp.float32)
+    acc = jnp.zeros((ck, b), jnp.float32)
+    contrib_col = jnp.zeros((b, 1), jnp.float32)
+    start_col = jnp.zeros((b, 1), jnp.float32)
     for t in range(0, 2 * b, chunk):
         # Record r covers j iff S[r] <= j and S[r+1] > j; the element
         # past the window counts as "not started", which is exact (the
@@ -137,25 +264,86 @@ def _expand_kernel(r0b_ref, s_hbm, v_hbm, out_ref, s_vmem, v_vmem, sem_s,
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
         )
-    out_ref[...] = acc
+        if build:
+            # Row-reductions against the SAME one-hot pick out each
+            # row's (lo - S) and S in column orientation for the rank
+            # math (VPU multiply+reduce; Mosaic rejects an accumulating
+            # MXU matvec here — "only constant accumulators").
+            contrib_col = contrib_col + jnp.sum(
+                onehot * v_vmem[crow : crow + 1, t : t + chunk],
+                axis=1, keepdims=True,
+            )
+            start_col = start_col + jnp.sum(
+                onehot * v_vmem[srow : srow + 1, t : t + chunk],
+                axis=1, keepdims=True,
+            )
+    out_ref[0:ck, :] = acc
+
+    if build:
+        dma_b1.wait()
+        dma_b2.wait()
+        # rank = lo[rec] + (j - S[rec]); straddler rows (run started at
+        # or before the block start) read window 1, the rest window 2.
+        rank = j + contrib_col.astype(jnp.int32)            # (b, 1)
+        is_w1 = start_col.astype(jnp.int32) <= i * b        # (b, 1)
+        local1 = rank - o1
+        local2 = rank - o2
+        accb = jnp.zeros((ckb, b), jnp.float32)
+        iota_ch = jax.lax.broadcasted_iota(jnp.int32, (b, chunk), 1)
+        for t in range(0, w1w, chunk):
+            oh = jnp.where(
+                is_w1 & (local1 == t + iota_ch), 1.0, 0.0
+            )                                               # (b, chunk)
+            accb = accb + jax.lax.dot_general(
+                b1_vmem[:, t : t + chunk], oh,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+        for t in range(0, w2w, chunk):
+            oh = jnp.where(
+                (~is_w1) & (local2 == t + iota_ch), 1.0, 0.0
+            )
+            accb = accb + jax.lax.dot_general(
+                b2_vmem[:, t : t + chunk], oh,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+        out_ref[ck : ck + ckb, :] = accb
 
 
 def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
                   out_capacity: int, block: int | None = None,
-                  interpret: bool = False):
+                  interpret: bool = False,
+                  lo: Optional[jax.Array] = None,
+                  build_cols: Optional[Sequence[jax.Array]] = None):
     """For each output slot j in [0, out_capacity): find the covering
-    record r = max{r : S[r] <= j} and return each column's value at r.
+    record r = max{r : S[r] <= j} and return each column's value at r,
+    plus the run-start slot ``start_b[j] = S[r]``.
 
     S: (m,) int32, sorted ascending, unique among real records, with
        INT32_MAX sentinels after them; S[0] == 0 whenever any real
        record exists (the first record starts at slot 0).
     cols: k 1-D uint64 arrays of length m.
 
-    Returns k 1-D uint64 arrays of length out_capacity.
+    With ``lo`` ((m,) int32, the build rank of each record's run start,
+    non-decreasing over real records) and ``build_cols`` (kb 1-D uint64
+    arrays over the key-sorted build pack), the kernel also
+    materializes each output row's build values at
+    ``rank = lo[r] + (j - S[r])`` via the two-window scheme (module
+    docstring) and returns them plus the rank itself.
+
+    Returns ``(rec_outs, start_b)`` — or, on the build path,
+    ``(rec_outs, start_b, rank, build_outs)`` — where rec_outs /
+    build_outs are lists of uint64 arrays and start_b / rank are int32,
+    all of length out_capacity. Values at slots >= the true total are
+    garbage (masked by the caller).
 
     ``block`` must be a multiple of 1024 on real TPUs (the 1-D int32
     DMA tiling; the kernel proves window offsets divisible by it);
-    interpret mode accepts any block.
+    interpret mode accepts any block with block % chunk == 0 (the
+    chunked loops; _window_widths handles the 128-lane rounding).
     """
     import os
 
@@ -163,10 +351,44 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
     from jax.experimental.pallas import tpu as pltpu
 
     if block is None:
-        block = int(os.environ.get("DJTPU_PALLAS_BLOCK", "1024"))
+        block = _default_block()
+    build = build_cols is not None
+    if build:
+        assert lo is not None and len(build_cols) > 0
+        # The caller guards these (ops/join.py build_ok); the rank math
+        # rides f32 and silently corrupts past 2^24 otherwise.
+        assert out_capacity < _F32_EXACT
+        assert build_cols[0].shape[0] < _F32_EXACT
     k = len(cols)
     m = S.shape[0]
     rows = _split_rows(cols)                         # 3k rows of (m,)
+    crow = srow = 0
+    s_u64_lane = not build and out_capacity >= _F32_EXACT
+    if build:
+        # Two extra f32 rows drive the in-kernel rank math. Sentinel
+        # records carry 0 in both (their rows are garbage-by-contract;
+        # a 2^31-1 sentinel would not round-trip f32 exactly).
+        is_real = S != jnp.int32(2**31 - 1)
+        crow = len(rows)
+        rows.append(jnp.where(is_real, (lo - S).astype(jnp.float32), 0.0))
+        srow = len(rows)
+        rows.append(jnp.where(is_real, S.astype(jnp.float32), 0.0))
+    elif s_u64_lane:
+        # start_b values can exceed f32's exact-integer range; ride S
+        # as a full 22-bit-chunked u64 lane instead of one f32 row.
+        rows.extend(
+            _split_rows([S.astype(jnp.uint32).astype(jnp.uint64)])
+        )
+        srow = len(rows) - 3  # chunk0 row; merged below
+    else:
+        # start_b comes from one f32 S row (replaces the u64 S lane
+        # callers used to append; exact below 2^24).
+        srow = len(rows)
+        rows.append(
+            jnp.where(
+                S != jnp.int32(2**31 - 1), S.astype(jnp.float32), 0.0
+            )
+        )
     ck = _round_up(len(rows), 8)                     # f32 sublane tile
     out_pad = _round_up(out_capacity, block)
     pad_cols = out_pad + 2 * block - m
@@ -192,42 +414,104 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
     )
     r0b = r0 // block
 
+    chunk = _default_chunk(block)
+    w1w, w2w = _window_widths(block, chunk)
+
+    ckb = 0
+    if build:
+        kb = len(build_cols)
+        nb = build_cols[0].shape[0]
+        brows = _split_rows(build_cols)
+        ckb = _round_up(len(brows), 8)
+        nb_pad = _round_up(max(nb, 1), 128) + w2w
+        bpad = nb_pad - nb
+        brows = [
+            jnp.concatenate([r, jnp.zeros((bpad,), jnp.float32)])
+            for r in brows
+        ]
+        bvT = jnp.stack(
+            brows + [jnp.zeros_like(brows[0])] * (ckb - len(brows)),
+            axis=0,
+        )                                            # (ckb, nb_pad)
+        # Window offsets (aligned down to 128, passed pre-divided).
+        # Real offsets never exceed nb (lo <= nb, and the straddler
+        # start lo[r0] + (i*B - S[r0]) <= its run's end rank <= nb), so
+        # the clip only guards sentinel-block garbage.
+        omax = _round_up(max(nb, 1), 128) // 128
+        lo_pad = jnp.concatenate(
+            [lo, jnp.zeros((max(S.shape[0] - lo.shape[0], 0),),
+                           lo.dtype)]
+        )
+        s_r0 = jnp.where(S[r0] == 2**31 - 1, starts, S[r0])
+        w1 = lo_pad[r0] + (starts - s_r0)
+        w1a = jnp.clip(w1, 0, omax * 128) // 128
+        w2 = lo_pad[jnp.minimum(r0 + 1, S.shape[0] - 1)]
+        w2a = jnp.clip(w2, 0, omax * 128) // 128
+    else:
+        bvT = jnp.zeros((8, 512), jnp.float32)       # unused placeholder
+        w1a = jnp.zeros_like(r0b)
+        w2a = jnp.zeros_like(r0b)
+
     # Under shard_map with vma checking, the out_shape must carry how
     # the output varies over mesh axes — same as the inputs.
     vma = getattr(jax.typeof(vT), "vma", None)
     out_shape = (
-        jax.ShapeDtypeStruct((ck, out_pad), jnp.float32, vma=vma)
+        jax.ShapeDtypeStruct((ck + ckb, out_pad), jnp.float32, vma=vma)
         if vma is not None
-        else jax.ShapeDtypeStruct((ck, out_pad), jnp.float32)
+        else jax.ShapeDtypeStruct((ck + ckb, out_pad), jnp.float32)
     )
     # Global x64 breaks Mosaic legalization ("failed to legalize
     # func.return" — i64 index plumbing); every type here is explicit
     # i32/f32, so scope x64 off around the kernel. The offsets ride a
     # plain SMEM input + manual DMA because PrefetchScalarGridSpec
     # also fails to legalize with this toolchain.
+    scratch = [
+        pltpu.VMEM((2 * block,), jnp.int32),
+        pltpu.VMEM((ck, 2 * block), jnp.float32),
+    ]
+    if build:
+        scratch += [
+            pltpu.VMEM((ckb, w1w), jnp.float32),
+            pltpu.VMEM((ckb, w2w), jnp.float32),
+        ]
+    scratch += [pltpu.SemaphoreType.DMA(())] * (4 if build else 2)
     with jax.enable_x64(False):
         out = pl.pallas_call(
             functools.partial(
-                _expand_kernel, block=block,
-                chunk=int(os.environ.get("DJTPU_PALLAS_CHUNK", "256")),
+                _expand_kernel, block=block, chunk=chunk,
+                ck=ck, ckb=ckb, crow=crow, srow=srow, w1w=w1w, w2w=w2w,
             ),
             grid=(out_pad // block,),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pltpu.ANY),
-                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
             ],
-            out_specs=pl.BlockSpec((ck, block), lambda i: (0, i)),
-            scratch_shapes=[
-                pltpu.VMEM((2 * block,), jnp.int32),
-                pltpu.VMEM((ck, 2 * block), jnp.float32),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA(()),
-            ],
+            out_specs=pl.BlockSpec((ck + ckb, block), lambda i: (0, i)),
+            scratch_shapes=scratch,
             out_shape=out_shape,
             interpret=interpret,
-        )(r0b, S, vT)
-    return [c[:out_capacity] for c in _merge_rows(out, k)]
+        )(r0b, w1a, w2a, S, vT, bvT)
+    rec_outs = [c[:out_capacity] for c in _merge_rows(out, k)]
+    if s_u64_lane:
+        start_b = (
+            _merge_rows(out[srow : srow + 3], 1)[0][:out_capacity]
+            .astype(jnp.int32)
+        )
+    else:
+        start_b = out[srow, :out_capacity].astype(jnp.int32)
+    if not build:
+        return rec_outs, start_b
+    rank = (
+        jnp.arange(out_capacity, dtype=jnp.int32)
+        + out[crow, :out_capacity].astype(jnp.int32)
+    )
+    bmerged = _merge_rows(out[ck:], kb)
+    build_outs = [c[:out_capacity] for c in bmerged]
+    return rec_outs, start_b, rank, build_outs
 
 
 def expand_gather_reference(S: jax.Array, cols: Sequence[jax.Array],

@@ -141,11 +141,19 @@ def _from_u64_lane(c64: jax.Array, dt):
     raise TypeError(dt)
 
 
-def _expand_records(S, recs: dict, out_capacity: int, j):
-    """Broadcast each record's values down its output run.
+def _expand_records(S, recs: dict, out_capacity: int, j,
+                    build_pack: Optional[dict] = None, nb: int = 0):
+    """Broadcast each record's values down its output run, and (on the
+    kernel build path) materialize the build-side values too.
 
-    Returns ``(out_vals: name -> (out_capacity,) array, start_b)``
-    where start_b[i] is the first output slot of slot i's run.
+    Returns ``(out_vals, start_b, build_vals)``:
+    - out_vals: name -> (out_capacity,) array of expanded record values
+      (WITHOUT ``__lo`` when the kernel consumed it);
+    - start_b[i]: the first output slot of slot i's run;
+    - build_vals: the gathered ``build_pack`` columns when the kernel
+      build path (or its in-cond exact fallback) ran, else None (the
+      caller then derives the rank from ``__lo`` and gathers the build
+      side itself).
 
     XLA path: one unique-slot int32 scatter + cummax gives each slot
     its record index; packed row-gathers per dtype group pull the
@@ -153,9 +161,9 @@ def _expand_records(S, recs: dict, out_capacity: int, j):
 
     Pallas path (default on TPU; DJTPU_PALLAS_EXPAND=0 disables, =1
     forces it through the interpreter elsewhere; non-f64 columns only):
-    the streaming one-hot-matmul kernel of ops/expand_pallas.py, with
-    start_b riding as one more u64 lane (= S itself). Measured on v5e:
-    27.5 vs 22.0 M rows/s/chip end-to-end on the honest 10Mx10M bench.
+    the streaming one-hot-matmul kernel of ops/expand_pallas.py. The
+    build path additionally needs every rank quantity f32-exact
+    (build rows and out_capacity < 2^24; per-shard blocks in practice).
     """
     import os
 
@@ -175,24 +183,95 @@ def _expand_records(S, recs: dict, out_capacity: int, j):
         if interpret and getattr(jax.typeof(S), "vma", None):
             use_pallas = False
     if use_pallas:
-        lanes = {nm: _to_u64_lane(c) for nm, c in recs.items()}
-        if all(v is not None for v in lanes.values()):
-            from distributed_join_tpu.ops.expand_pallas import (
-                expand_gather,
-            )
+        from distributed_join_tpu.ops.expand_pallas import (
+            _F32_EXACT,
+            expand_gather,
+        )
 
+        build_ok = (
+            build_pack is not None
+            and len(build_pack) > 0
+            and 0 < nb < _F32_EXACT
+            and out_capacity < _F32_EXACT
+        )
+        blanes = {}
+        if build_ok:
+            blanes = {
+                nm: _to_u64_lane(c) for nm, c in build_pack.items()
+            }
+            build_ok = all(v is not None for v in blanes.values())
+        lanes = {
+            nm: _to_u64_lane(c)
+            for nm, c in recs.items()
+            if not (build_ok and nm == "__lo")
+        }
+        if all(v is not None for v in lanes.values()):
             names = list(lanes)
-            cols = [lanes[nm] for nm in names] + [
-                S.astype(jnp.uint32).astype(jnp.uint64)
-            ]
-            gathered = expand_gather(S, cols, out_capacity,
-                                     interpret=interpret)
+            if build_ok:
+                from distributed_join_tpu.ops.expand_pallas import (
+                    build_windows_ok,
+                )
+
+                bnames = list(blanes)
+                lo_i32 = recs["__lo"].astype(jnp.int32)
+                cols_list = [lanes[nm] for nm in names]
+                bl_list = [blanes[nm] for nm in bnames]
+
+                def _kernel(_):
+                    return expand_gather(
+                        S, cols_list, out_capacity, interpret=interpret,
+                        lo=lo_i32, build_cols=bl_list,
+                    )
+
+                def _fallback(_):
+                    # The exact path for data the two-window proof does
+                    # not cover (unmatched-build-key gaps,
+                    # expand_pallas.build_windows_ok): record expansion
+                    # with __lo riding as one more lane, then the XLA
+                    # packed row gather at the derived rank.
+                    outs2, sb2 = expand_gather(
+                        S, cols_list + [_to_u64_lane(recs["__lo"])],
+                        out_capacity, interpret=interpret,
+                    )
+                    lo_b = _from_u64_lane(
+                        outs2[-1], recs["__lo"].dtype
+                    ).astype(jnp.int32)
+                    rank2 = lo_b + (j - sb2)
+                    safe = jnp.clip(rank2, 0, max(nb - 1, 0))
+                    if len(bl_list) == 1:
+                        bouts2 = [bl_list[0][safe]]
+                    else:
+                        pack = jnp.stack(bl_list, axis=1)
+                        rows_g = pack[safe]
+                        bouts2 = [
+                            rows_g[:, t] for t in range(len(bl_list))
+                        ]
+                    return outs2[:-1], sb2, rank2, bouts2
+
+                rec_outs, start_b, _rank, build_outs = lax.cond(
+                    build_windows_ok(S, lo_i32, out_capacity),
+                    _kernel, _fallback, None,
+                )
+                out_vals = {
+                    nm: _from_u64_lane(rec_outs[i], recs[nm].dtype)
+                    for i, nm in enumerate(names)
+                }
+                build_vals = {
+                    nm: _from_u64_lane(
+                        build_outs[i], build_pack[nm].dtype
+                    )
+                    for i, nm in enumerate(bnames)
+                }
+                return out_vals, start_b, build_vals
+            rec_outs, start_b = expand_gather(
+                S, [lanes[nm] for nm in names], out_capacity,
+                interpret=interpret,
+            )
             out_vals = {
-                nm: _from_u64_lane(gathered[i], recs[nm].dtype)
+                nm: _from_u64_lane(rec_outs[i], recs[nm].dtype)
                 for i, nm in enumerate(names)
             }
-            start_b = gathered[-1].astype(jnp.int32)
-            return out_vals, start_b
+            return out_vals, start_b, None
 
     raw = jnp.zeros((out_capacity,), jnp.int32).at[S].set(
         j + 1, mode="drop", unique_indices=True
@@ -202,7 +281,7 @@ def _expand_records(S, recs: dict, out_capacity: int, j):
     # The run's first slot is where its raw mark landed — cheaper as an
     # out-domain cummax than as another ridden sort lane.
     start_b = lax.cummax(jnp.where(raw > 0, j, 0))
-    return out_vals, start_b
+    return out_vals, start_b, None
 
 
 def _grouped_row_gather(cols: dict, idx: jax.Array) -> dict:
@@ -404,25 +483,39 @@ def sort_merge_inner_join(
     # -- 5. expansion: either ONE small scatter + cummax + packed row
     #    gathers (XLA primitives), or the Pallas streaming kernel
     #    (ops/expand_pallas.py) that replaces all three with sequential
-    #    record windows + a one-hot MXU matmul. The kernel path is
-    #    DEFAULT ON TPU (DJTPU_PALLAS_EXPAND=0 disables, =1 forces
-    #    the interpreter elsewhere); falls back for dtypes a u64 lane
-    #    can't carry bit-exactly on TPU (f64: x64 bitcast is not
-    #    implemented there) and inside shard_map.
+    #    record windows + a one-hot MXU matmul — and, on its build
+    #    path, ALSO materializes the build side from two bounded build
+    #    windows, eliminating the join's last random-access gather. The
+    #    kernel path is DEFAULT ON TPU (DJTPU_PALLAS_EXPAND=0 disables,
+    #    =1 forces the interpreter elsewhere); falls back for dtypes a
+    #    u64 lane can't carry bit-exactly on TPU (f64: x64 bitcast is
+    #    not implemented there) and inside shard_map.
     j = jnp.arange(out_capacity, dtype=jnp.int32)
-    out_vals, start_b = _expand_records(S, recs, out_capacity, j)
-    lo_b = out_vals.pop("__lo").astype(jnp.int32)
-    build_rank = lo_b + (j - start_b)
-    safe_rank = jnp.clip(build_rank, 0, max(nb - 1, 0))
+    build_pack = {nm: sb_payload[nm] for nm in b1d}
+    if b2d:
+        # The 2-D string columns' row indices ride the kernel too; the
+        # per-column 2-D gathers below then use the kernel's output.
+        build_pack["__browidx"] = sb_rowidx
+    out_vals, start_b, build_vals = _expand_records(
+        S, recs, out_capacity, j, build_pack=build_pack, nb=nb
+    )
+    if build_vals is None:
+        lo_b = out_vals.pop("__lo").astype(jnp.int32)
+        build_rank = lo_b + (j - start_b)
+        safe_rank = jnp.clip(build_rank, 0, max(nb - 1, 0))
+        build_vals = _grouped_row_gather(sb_payload, safe_rank)
+        if b2d:
+            build_vals["__browidx"] = sb_rowidx[safe_rank]
+    else:
+        out_vals.pop("__lo", None)
 
     out_cols = {}
     for i, k in enumerate(keys):
         out_cols[k] = out_vals.pop(f"__key{i}")
-    bgather = _grouped_row_gather(sb_payload, safe_rank)
     for nm in b1d:
-        out_cols[nm] = bgather[nm]
+        out_cols[nm] = build_vals[nm]
     if b2d:
-        bidx = sb_rowidx[safe_rank]
+        bidx = build_vals["__browidx"]
         for nm in b2d:
             out_cols[nm] = build.columns[nm][bidx]
     for nm in p1d:

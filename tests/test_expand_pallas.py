@@ -45,7 +45,8 @@ def test_chunk_roundtrip():
 def test_expand_matches_reference(n_records, out_cap, k):
     rng = np.random.default_rng(n_records)
     S, cols, total = _make_records(rng, n_records, out_cap, k)
-    got = expand_gather(S, cols, out_cap, block=128, interpret=True)
+    got, start_b = expand_gather(S, cols, out_cap, block=128,
+                                 interpret=True)
     want = expand_gather_reference(S, cols, out_cap)
     # only slots below total are defined (the rest are masked padding
     # downstream); both implementations agree there
@@ -53,12 +54,178 @@ def test_expand_matches_reference(n_records, out_cap, k):
         np.testing.assert_array_equal(
             np.asarray(g)[:total], np.asarray(w)[:total]
         )
+    want_sb = expand_gather_reference(
+        S, [S.astype(jnp.uint32).astype(jnp.uint64)], out_cap
+    )[0]
+    np.testing.assert_array_equal(
+        np.asarray(start_b)[:total], np.asarray(want_sb)[:total]
+    )
+
+
+def _make_join_records(rng, key_specs, out_cap, kb=1):
+    """Records exactly as the join produces them: per key (in sorted
+    order) with c builds and p probes, p records of run length c, all
+    sharing lo = (builds of earlier keys). p == 0 keys advance lo
+    WITHOUT emitting records (unmatched-build gaps — the case the
+    window proof does not cover; build_windows_ok must flag them).
+    Returns (S, lo, rec cols, build cols, expected rank per slot,
+    total)."""
+    S_list, lo_list = [], []
+    lo = 0
+    slot = 0
+    for c, p in key_specs:
+        for _ in range(p):
+            S_list.append(slot)
+            lo_list.append(lo)
+            slot += c
+        lo += c
+    nb = max(lo, 1)
+    total = slot
+    m = len(S_list) + 7
+    S = np.full((m,), 2**31 - 1, np.int32)
+    S[: len(S_list)] = S_list
+    lo_arr = np.zeros((m,), np.int32)
+    lo_arr[: len(lo_list)] = lo_list
+    cols = [
+        jnp.asarray(rng.integers(0, 1 << 63, size=(m,), dtype=np.uint64))
+    ]
+    bcols = [
+        jnp.asarray(rng.integers(0, 1 << 63, size=(nb,), dtype=np.uint64))
+        for _ in range(kb)
+    ]
+    # oracle rank per output slot: each record fills its run
+    rank = np.zeros((total,), np.int64)
+    ends = S_list[1:] + [total]
+    for (s, l), e in zip(zip(S_list, lo_list), ends):
+        rank[s:e] = l + np.arange(e - s)
+    return (
+        jnp.asarray(S),
+        jnp.asarray(lo_arr),
+        cols,
+        bcols,
+        rank,
+        min(total, out_cap),
+    )
+
+
+@pytest.mark.parametrize("key_specs,out_cap,block", [
+    # small uniform runs
+    ([(2, 3)] * 40 + [(1, 1)] * 30, 4096, 256),
+    # one huge build run (c >> block) straddling many blocks
+    ([(3, 2)] * 10, None, 256),
+    # alternating huge/small, multiple records per key
+    ([(700, 2), (1, 5), (300, 3), (2, 2)], None, 256),
+    # run starting exactly at a block boundary
+    ([(256, 1), (256, 2), (1, 7)], None, 256),
+    # single key, single giant record
+    ([(2000, 1)], None, 256),
+    # small unmatched gaps (lo advances without records) that still
+    # fit window 2's slack
+    ([(2, 2), (6, 0), (2, 2)] * 20, None, 256),
+])
+def test_expand_build_windows_match_oracle(key_specs, out_cap, block):
+    import zlib
+
+    from distributed_join_tpu.ops.expand_pallas import build_windows_ok
+
+    rng = np.random.default_rng(zlib.crc32(str(key_specs).encode()))
+    if out_cap is None:
+        out_cap = sum(c * p for c, p in key_specs)
+    S, lo, cols, bcols, rank_want, total = _make_join_records(
+        rng, key_specs, out_cap, kb=2
+    )
+    # the kernel's contract: exact whenever the checker passes
+    assert bool(build_windows_ok(S, lo, out_cap, block=block))
+    rec_outs, start_b, rank, build_outs = expand_gather(
+        S, cols, out_cap, block=block, interpret=True,
+        lo=lo, build_cols=bcols,
+    )
+    want_rec = expand_gather_reference(S, cols, out_cap)
+    np.testing.assert_array_equal(
+        np.asarray(rec_outs[0])[:total], np.asarray(want_rec[0])[:total]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rank)[:total], rank_want[:total]
+    )
+    for bo, bc in zip(build_outs, bcols):
+        np.testing.assert_array_equal(
+            np.asarray(bo)[:total],
+            np.asarray(bc)[rank_want[:total]],
+        )
+
+
+def test_window_checker_flags_gap_data():
+    """The code-review repro: a large unmatched-build key between two
+    matched keys whose output rows share a block. The checker must
+    refuse the kernel path (ops/join.py then conds to the XLA
+    gather)."""
+    from distributed_join_tpu.ops.expand_pallas import build_windows_ok
+
+    rng = np.random.default_rng(42)
+    key_specs = [(1, 1), (1, 1), (5000, 0), (1, 1)]
+    out_cap = 8
+    S, lo, cols, bcols, rank_want, total = _make_join_records(
+        rng, key_specs, out_cap
+    )
+    assert not bool(build_windows_ok(S, lo, out_cap, block=256))
+
+
+def test_join_level_gap_data_falls_back_exact(monkeypatch):
+    """Join-level oracle on data with mostly-unmatched build keys
+    (sparse probe hits over a wide key domain): the cond must route to
+    the exact XLA gather and the result must still match pandas."""
+    monkeypatch.setenv("DJTPU_PALLAS_EXPAND", "1")
+    import pandas as pd
+
+    from distributed_join_tpu.ops.join import sort_merge_inner_join
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+    )
+
+    build, probe = generate_build_probe_tables(
+        seed=13, build_nrows=60_000, probe_nrows=4_000,
+        rand_max=120_000, selectivity=0.2,
+    )
+    res = sort_merge_inner_join(build, probe, "key", 16_384)
+    merged = build.to_pandas().merge(probe.to_pandas(), on="key")
+    assert int(res.total) == len(merged)
+    got = res.table.to_pandas().sort_values(
+        ["key", "build_payload", "probe_payload"]).reset_index(drop=True)
+    want = merged.sort_values(
+        ["key", "build_payload", "probe_payload"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got[want.columns], want)
+
+
+def test_expand_truncated_overflow_build_path():
+    """out_cap smaller than the total: kept records still tile the
+    prefix; every slot below out_cap must be exact."""
+    rng = np.random.default_rng(99)
+    key_specs = [(5, 3)] * 50 + [(900, 1), (2, 4)] * 3
+    total_full = sum(c * p for c, p in key_specs)
+    out_cap = total_full // 2
+    S, lo, cols, bcols, rank_want, total = _make_join_records(
+        rng, key_specs, out_cap
+    )
+    # truncate records to those starting below out_cap (join's _prefix)
+    keep = np.asarray(S) < out_cap
+    m = int(keep.sum())
+    S_t = np.where(np.arange(S.shape[0]) < m, np.asarray(S), 2**31 - 1)
+    lo_t = np.where(np.arange(S.shape[0]) < m, np.asarray(lo), 0)
+    rec_outs, start_b, rank, build_outs = expand_gather(
+        jnp.asarray(S_t), cols, out_cap, block=256, interpret=True,
+        lo=jnp.asarray(lo_t), build_cols=bcols,
+    )
+    np.testing.assert_array_equal(np.asarray(rank), rank_want[:out_cap])
+    np.testing.assert_array_equal(
+        np.asarray(build_outs[0]),
+        np.asarray(bcols[0])[rank_want[:out_cap]],
+    )
 
 
 def test_expand_empty():
     S = jnp.full((16,), 2**31 - 1, jnp.int32)
     cols = [jnp.zeros((16,), jnp.uint64)]
-    out = expand_gather(S, cols, 64, block=64, interpret=True)
+    out, _ = expand_gather(S, cols, 64, block=64, interpret=True)
     assert out[0].shape == (64,)
 
 
