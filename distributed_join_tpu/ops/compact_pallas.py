@@ -31,8 +31,11 @@ streaming merge of matmul-selected blocks:
   counts, divided/remaindered by the 128-lane tile) are tiny host-side
   arrays prefetched through SMEM.
 
-int64 columns ride as 22-bit f32 chunks exactly as in
-ops/expand_pallas.py. Elements whose position reaches ``capacity`` are
+int64 columns ride as 8-bit bfloat16 chunks (expand_pallas._split_rows8
+— bf16 holds 0..255 exactly, and one-pass bf16 matmuls beat ~6-pass
+f32-HIGHEST even with 8/3 more chunk rows; the selected values come
+back exact because every output slot sums exactly one nonzero
+product). Elements whose position reaches ``capacity`` are
 dropped (the caller sized the output; positions are monotone so the
 kept set is a prefix). Output slots at and beyond the survivor count
 are UNDEFINED — callers mask them (the join's validity contract).
@@ -48,9 +51,9 @@ import jax.numpy as jnp
 from distributed_join_tpu.ops.expand_pallas import (
     _default_block,
     _default_chunk,
-    _merge_rows,
+    _merge_rows8,
     _round_up,
-    _split_rows,
+    _split_rows8,
 )
 
 
@@ -70,17 +73,17 @@ def _compact_kernel(base_ref, q_ref, pm_ref, v_ref, out_hbm, stage,
     spos = jnp.where(maskb != 0, posb - base * 128, -1)
     # ONE (w, b) one-hot and ONE matmul per block: a chunked loop of
     # (ck, chunk) matmuls measured 5x slower — 175K tiny MXU
-    # dispatches of per-call overhead, not FLOPs, dominated. One-hot
-    # columns (each input element matches at most one slot) make the
-    # sum per output slot a single nonzero term — bit-exact at HIGHEST
-    # (the default would truncate the 22-bit chunks to bf16).
+    # dispatches of per-call overhead, not FLOPs, dominated.
     iota_w = jax.lax.broadcasted_iota(jnp.int32, (w, b), 0)
-    oh = (spos == iota_w).astype(jnp.float32)            # (w, b)
+    oh = (spos == iota_w).astype(jnp.bfloat16)           # (w, b)
+    # bf16 x bf16 -> f32 accumulate at the MXU's native one-pass rate
+    # (vs ~6 emulation passes for f32 Precision.HIGHEST); exact because
+    # the 8-bit chunk values and the 0/1 one-hot are both
+    # bf16-representable and each output slot sums ONE nonzero term.
     stage[...] = jax.lax.dot_general(
         v_ref[...], oh,
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
     )
     # Reproduce the previous blocks' elements living in this window's
     # partial leading 128-lane chunk (the write below would otherwise
@@ -116,8 +119,6 @@ def stream_compact(mask: jax.Array, pos: jax.Array, cols, capacity: int,
     Returns k uint64 arrays of length ``capacity``; slots >= the
     survivor count are undefined.
     """
-    import os
-
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -134,14 +135,14 @@ def stream_compact(mask: jax.Array, pos: jax.Array, cols, capacity: int,
 
     keep = mask & (pos < capacity)
     keep_i = keep.astype(jnp.int32)
-    rows = _split_rows(cols)
-    ck = _round_up(len(rows), 8)
+    rows = _split_rows8(cols)
+    ck = _round_up(len(rows), 16)   # bf16 sublane tile
     if n_pad > n:
         pad = n_pad - n
         keep_i = jnp.concatenate([keep_i, jnp.zeros((pad,), jnp.int32)])
         pos = jnp.concatenate([pos, jnp.zeros((pad,), pos.dtype)])
         rows = [
-            jnp.concatenate([r, jnp.zeros((pad,), jnp.float32)])
+            jnp.concatenate([r, jnp.zeros((pad,), jnp.bfloat16)])
             for r in rows
         ]
     vT = jnp.stack(
@@ -184,7 +185,7 @@ def stream_compact(mask: jax.Array, pos: jax.Array, cols, capacity: int,
             out_shape=out_shape,
             interpret=interpret,
         )(base, q, pm, vT)
-    return [c[:capacity] for c in _merge_rows(out, k)]
+    return [c[:capacity] for c in _merge_rows8(out, k)]
 
 
 def stream_compact_reference(mask, pos, cols, capacity: int):
@@ -193,9 +194,10 @@ def stream_compact_reference(mask, pos, cols, capacity: int):
     idx = jnp.where(mask, pos, capacity)  # capacity == dropped
     outs = []
     for c in cols:
+        # No unique_indices hint: every dropped element maps to the
+        # same out-of-bounds index `capacity`, so the indices are NOT
+        # unique and claiming so would be undefined behavior.
         outs.append(
-            jnp.zeros((capacity,), c.dtype)
-            .at[idx]
-            .set(c, mode="drop", unique_indices=True)
+            jnp.zeros((capacity,), c.dtype).at[idx].set(c, mode="drop")
         )
     return outs

@@ -51,22 +51,32 @@ block can touch by TWO windows over the build pack:
 The parenthetical is a DATA property, not a theorem: build keys with
 zero probe matches advance ``lo`` without producing records, so a gap
 of unmatched builds between two matched keys whose output rows share a
-block pushes later ranks past window 2. :func:`build_windows_ok`
-checks the exact per-block condition OUTSIDE the kernel — ``lo`` is
-non-decreasing over records, so the largest in-block ``lo`` is just
-``lo[r0[i+1]]`` and the check is O(out/B) gathers — and the caller
-(ops/join.py) `lax.cond`s between this kernel and the XLA gather
-fallback on the result. Wrong-window selections are thereby
-impossible by construction rather than improbable by heuristic.
+block pushes later ranks past window 2. The join's kernel pipeline
+(ops/join.py _join_kernel_path) therefore feeds MATCHED-build ranks
+(``lo_m`` from ops/scan_pallas.py, over the matched-dense pack from
+ops/compact_pallas.py): unmatched keys never enter the pack, ``lo_m``
+advances between records by exactly the previous record's run length,
+and the bound holds by construction. :func:`build_windows_ok` still
+checks the exact per-block condition OUTSIDE the kernel as
+belt-and-braces — ``lo`` is non-decreasing over records, so the
+largest in-block ``lo`` is just ``lo[r0[i+1]]`` and the check is
+O(out/B) gathers — and the caller `lax.cond`s to an exact XLA-gather
+fallback if it ever fails.
 
-So the kernel DMAs two build windows (B+256 and 2B+256 wide, offsets
-128-aligned outside) and selects each row's build values with a second
-one-hot matmul against ``rank``, computed in-kernel from two extra f32
-rows (``lo - S`` and ``S``) that ride the record window; rows choose
-window 1 iff their run started at or before the block start
-(``S_j <= i*B``), which makes the two selections disjoint and exact.
+So the build-mode kernel (_expand_kernel_b8) DMAs two build windows
+(w1w/w2w wide per _window_widths, offsets 128-aligned outside) and
+selects each row's build values with a second one-hot matmul against
+``rank``, computed in-kernel from two f32 aux rows (``lo - S`` and
+``S``) that ride the record window; rows choose window 1 iff their run
+started at or before the block start (``S_j <= i*B``), which makes the
+two selections disjoint and exact. Its value matmuls run on 8-bit
+bfloat16 chunk rows (_split_rows8 — one native MXU pass instead of
+f32-HIGHEST's ~6 emulation passes), and its record window is
+128-aligned and only ``w1w`` wide (the f32 S aux row replaces the
+non-build kernel's 1-D int32 S array, whose DMA tiling forces
+1024-aligned offsets and hence 2B windows).
 
-Everything the kernel touches moves sequentially (record windows,
+Everything the kernels touch moves sequentially (record windows,
 build windows, output blocks); the join's output path has no
 per-element random access left. ``expand_gather_reference`` is the XLA
 formulation used for correctness tests and as a CPU fallback.
@@ -106,6 +116,10 @@ def _default_chunk(block: int) -> int:
 
     chunk = min(int(os.environ.get("DJTPU_PALLAS_CHUNK", "256")), block)
     assert block % chunk == 0, (block, chunk)
+    # 128-compatibility keeps every _window_widths result an exact
+    # multiple of chunk (the widths round to lcm(chunk, 128)); e.g.
+    # chunk=96 would make the window loops slice past the VMEM buffers.
+    assert chunk % 128 == 0 or 128 % chunk == 0, chunk
     return chunk
 
 
@@ -147,9 +161,15 @@ def build_windows_ok(S: jax.Array, lo: jax.Array, out_capacity: int,
         0,
     )
     lo_i = lo.astype(jnp.int32)
-    w2 = lo_i[jnp.minimum(r0[:-1] + 1, m - 1)]
+    nxt = jnp.minimum(r0[:-1] + 1, m - 1)
+    w2 = lo_i[nxt]
     hi = lo_i[r0[1:]] + block  # > any non-straddler in-block rank
-    return ~jnp.any(hi > w2 + (w2w - 128))
+    # Blocks with no real record after their straddler have no
+    # window-2 reads by valid rows: S[r0+1] is a sentinel there and lo
+    # is zeroed padding, which would spuriously compare as a giant gap
+    # (every out_capacity > total run would fall back).
+    has_w2 = S[nxt] != jnp.int32(2**31 - 1)
+    return ~jnp.any(has_w2 & (hi > w2 + (w2w - 128)))
 
 
 def _split_rows(cols_u64: Sequence[jax.Array]):
@@ -177,36 +197,57 @@ def _merge_rows(rows_f32: jax.Array, k: int):
     return out
 
 
-def _expand_kernel(*refs, block: int, chunk: int, ck: int, ckb: int,
-                   crow: int, srow: int, w1w: int, w2w: int):
-    """Per-output-block body; see module docstring for the scheme.
+def _split_rows8(cols_u64):
+    """k 1-D uint64 columns -> 8k 1-D bfloat16 rows of exact 8-bit
+    chunks (byte b of every column grouped together). bf16's 8-bit
+    mantissa holds 0..255 exactly, which lets the one-hot matmuls run
+    at the MXU's native bf16 rate (one pass) instead of
+    Precision.HIGHEST's ~6-pass f32 emulation."""
+    rows = []
+    for shift in range(0, 64, 8):
+        for c in cols_u64:
+            rows.append(
+                ((c >> jnp.uint64(shift)) & jnp.uint64(0xFF)).astype(
+                    jnp.bfloat16
+                )
+            )
+    return rows
+
+
+def _merge_rows8(rows_f32: jax.Array, k: int):
+    """(8k, n) f32 (byte chunks, post-matmul) -> k uint64 columns."""
+    out = []
+    for i in range(k):
+        acc = jnp.zeros(rows_f32.shape[1:], jnp.uint64)
+        for b in range(8):
+            acc = acc | (
+                rows_f32[b * k + i].astype(jnp.uint64)
+                << jnp.uint64(8 * b)
+            )
+        out.append(acc)
+    return out
+
+
+def _expand_kernel(r0b_ref, s_hbm, v_hbm, out_ref, s_vmem, v_vmem,
+                   sem_s, sem_v, *, block: int, chunk: int, ck: int,
+                   srow: int):
+    """Per-output-block body, record expansion only (the build path
+    runs _expand_kernel_b8); see module docstring for the scheme.
 
     Mosaic constraints shaping this code:
     - dynamic DMA offsets must be PROVABLY divisible by the tiling
-      (1024 for 1-D int32, 128 lanes for 2-D f32): window starts are
-      down-aligned and passed pre-divided, so the prover sees
-      ``x * block`` / ``x * 128``;
+      (1024 for 1-D int32): the window start is down-aligned to a
+      block multiple and passed pre-divided, so the prover sees
+      ``x * block``;
     - the windowed dimension must be the 128-tiled LANE dimension:
       values arrive transposed as (lane_rows, m);
     - a full (block, 2*block) comparison matrix would blow VMEM at
-      block=1024 (8 MB per temporary), so windows are processed in
-      ``chunk``-wide slices, each one MXU matmul into the accumulator;
-    - the per-row rank/start scalars needed for the build windows are
-      accumulated as (block, 1) COLUMNS via matvecs against the same
-      one-hot (Mosaic cannot cheaply transpose a lane-oriented row
-      into the sublane dimension).
+      block=1024 (8 MB per temporary), so the window is processed in
+      ``chunk``-wide slices, each one MXU matmul into the accumulator.
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    build = ckb > 0
-    if build:
-        (r0b_ref, w1a_ref, w2a_ref, s_hbm, v_hbm, bv_hbm, out_ref,
-         s_vmem, v_vmem, b1_vmem, b2_vmem, sem_s, sem_v, sem_b1,
-         sem_b2) = refs
-    else:
-        (r0b_ref, w1a_ref, w2a_ref, s_hbm, v_hbm, bv_hbm, out_ref,
-         s_vmem, v_vmem, sem_s, sem_v) = refs
     b = block
     i = pl.program_id(0)
     w = r0b_ref[i] * b  # provably block-aligned
@@ -216,17 +257,6 @@ def _expand_kernel(*refs, block: int, chunk: int, ck: int, ckb: int,
     )
     dma_s.start()
     dma_v.start()
-    if build:
-        o1 = w1a_ref[i] * 128  # provably lane-tile-aligned
-        o2 = w2a_ref[i] * 128
-        dma_b1 = pltpu.make_async_copy(
-            bv_hbm.at[:, pl.ds(o1, w1w)], b1_vmem, sem_b1
-        )
-        dma_b2 = pltpu.make_async_copy(
-            bv_hbm.at[:, pl.ds(o2, w2w)], b2_vmem, sem_b2
-        )
-        dma_b1.start()
-        dma_b2.start()
     dma_s.wait()
     dma_v.wait()
 
@@ -236,8 +266,6 @@ def _expand_kernel(*refs, block: int, chunk: int, ck: int, ckb: int,
     j = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0) + i * b
     s_win = s_vmem[...]
     acc = jnp.zeros((ck, b), jnp.float32)
-    contrib_col = jnp.zeros((b, 1), jnp.float32)
-    start_col = jnp.zeros((b, 1), jnp.float32)
     for t in range(0, 2 * b, chunk):
         # Record r covers j iff S[r] <= j and S[r+1] > j; the element
         # past the window counts as "not started", which is exact (the
@@ -264,53 +292,241 @@ def _expand_kernel(*refs, block: int, chunk: int, ck: int, ckb: int,
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
         )
-        if build:
-            # Row-reductions against the SAME one-hot pick out each
-            # row's (lo - S) and S in column orientation for the rank
-            # math (VPU multiply+reduce; Mosaic rejects an accumulating
-            # MXU matvec here — "only constant accumulators").
-            contrib_col = contrib_col + jnp.sum(
-                onehot * v_vmem[crow : crow + 1, t : t + chunk],
-                axis=1, keepdims=True,
-            )
-            start_col = start_col + jnp.sum(
-                onehot * v_vmem[srow : srow + 1, t : t + chunk],
-                axis=1, keepdims=True,
-            )
-    out_ref[0:ck, :] = acc
+    out_ref[...] = acc
 
-    if build:
-        dma_b1.wait()
-        dma_b2.wait()
-        # rank = lo[rec] + (j - S[rec]); straddler rows (run started at
-        # or before the block start) read window 1, the rest window 2.
-        rank = j + contrib_col.astype(jnp.int32)            # (b, 1)
-        is_w1 = start_col.astype(jnp.int32) <= i * b        # (b, 1)
-        local1 = rank - o1
-        local2 = rank - o2
-        accb = jnp.zeros((ckb, b), jnp.float32)
-        iota_ch = jax.lax.broadcasted_iota(jnp.int32, (b, chunk), 1)
-        for t in range(0, w1w, chunk):
-            oh = jnp.where(
-                is_w1 & (local1 == t + iota_ch), 1.0, 0.0
-            )                                               # (b, chunk)
-            accb = accb + jax.lax.dot_general(
-                b1_vmem[:, t : t + chunk], oh,
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
+
+def _expand_kernel_b8(*refs, block: int, chunk: int, ck8: int,
+                      ckb8: int, wr: int, w1w: int, w2w: int):
+    """Build-mode kernel, v3: 8-bit bf16 chunk rows for every value
+    matmul (one MXU pass instead of ~6 f32-HIGHEST emulation passes),
+    record windows 128-aligned (width b+chunk-slack instead of 2b — the
+    v2 1-D int32 S array forced 1024-aligned offsets; here the record
+    start-slots ride an f32 aux row, exact below 2^24 which the build
+    path already guarantees), and no aux outputs (the caller's cond
+    interface takes placeholders — rank and start_b are only consumed
+    in-kernel)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    (r0a_ref, w1a_ref, w2a_ref, v8_hbm, aux_hbm, bv_hbm, out_ref,
+     v8_vmem, aux_vmem, b1_vmem, b2_vmem, sem_v, sem_a, sem_b1,
+     sem_b2) = refs
+    b = block
+    i = pl.program_id(0)
+    wro = r0a_ref[i] * 128  # 128-aligned record-window offset
+    dma_v = pltpu.make_async_copy(
+        v8_hbm.at[:, pl.ds(wro, wr)], v8_vmem, sem_v
+    )
+    dma_a = pltpu.make_async_copy(
+        aux_hbm.at[:, pl.ds(wro, wr)], aux_vmem, sem_a
+    )
+    o1 = w1a_ref[i] * 128
+    o2 = w2a_ref[i] * 128
+    dma_b1 = pltpu.make_async_copy(
+        bv_hbm.at[:, pl.ds(o1, w1w)], b1_vmem, sem_b1
+    )
+    dma_b2 = pltpu.make_async_copy(
+        bv_hbm.at[:, pl.ds(o2, w2w)], b2_vmem, sem_b2
+    )
+    dma_v.start()
+    dma_a.start()
+    dma_b1.start()
+    dma_b2.start()
+    dma_v.wait()
+    dma_a.wait()
+
+    j = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0) + i * b
+    jf = j.astype(jnp.float32)
+    # 1-D row extractions: Mosaic can sublane-broadcast a slice of a
+    # 1-D vector but rejects the same broadcast from a 2-D row slice
+    # ("Invalid input layout" on vector.broadcast).
+    contrib_row = aux_vmem[0]                # (wr,) f32 lo - S
+    sfix_row = aux_vmem[1]                   # (wr,) f32 record starts
+    acc = jnp.zeros((ck8, b), jnp.float32)
+    contrib_col = jnp.zeros((b, 1), jnp.float32)
+    start_col = jnp.zeros((b, 1), jnp.float32)
+    for t in range(0, wr, chunk):
+        sl = sfix_row[t : t + chunk]
+        cmp_a = (sl[None, :] <= jf).astype(jnp.float32)    # (b, chunk)
+        if t + chunk < wr:
+            sl_b = sfix_row[t + 1 : t + chunk + 1]
+            cmp_b = (sl_b[None, :] <= jf).astype(jnp.float32)
+        else:
+            sl_b = sfix_row[t + 1 : t + chunk]
+            cmp_b = jnp.pad(
+                (sl_b[None, :] <= jf).astype(jnp.float32),
+                ((0, 0), (0, 1)),
             )
-        for t in range(0, w2w, chunk):
-            oh = jnp.where(
-                (~is_w1) & (local2 == t + iota_ch), 1.0, 0.0
-            )
-            accb = accb + jax.lax.dot_general(
-                b2_vmem[:, t : t + chunk], oh,
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            )
-        out_ref[ck : ck + ckb, :] = accb
+        onehot = cmp_a - cmp_b
+        acc = acc + jax.lax.dot_general(
+            v8_vmem[:, t : t + chunk], onehot.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        contrib_col = contrib_col + jnp.sum(
+            onehot * contrib_row[t : t + chunk][None, :],
+            axis=1, keepdims=True,
+        )
+        start_col = start_col + jnp.sum(
+            onehot * sfix_row[t : t + chunk][None, :],
+            axis=1, keepdims=True,
+        )
+    out_ref[0:ck8, :] = acc
+
+    dma_b1.wait()
+    dma_b2.wait()
+    rank = j + contrib_col.astype(jnp.int32)
+    is_w1 = start_col.astype(jnp.int32) <= i * b
+    local1 = rank - o1
+    local2 = rank - o2
+    accb = jnp.zeros((ckb8, b), jnp.float32)
+    iota_ch = jax.lax.broadcasted_iota(jnp.int32, (b, chunk), 1)
+    # f32 where + cast: producing bf16 straight from the i1 mask needs
+    # an unsupported (8,128)->(16,128) replicating relayout in Mosaic.
+    for t in range(0, w1w, chunk):
+        oh = jnp.where(
+            is_w1 & (local1 == t + iota_ch), 1.0, 0.0
+        ).astype(jnp.bfloat16)
+        accb = accb + jax.lax.dot_general(
+            b1_vmem[:, t : t + chunk], oh,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    for t in range(0, w2w, chunk):
+        oh = jnp.where(
+            (~is_w1) & (local2 == t + iota_ch), 1.0, 0.0
+        ).astype(jnp.bfloat16)
+        accb = accb + jax.lax.dot_general(
+            b2_vmem[:, t : t + chunk], oh,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    out_ref[ck8 : ck8 + ckb8, :] = accb
+
+
+def _expand_gather_b8(S, cols, out_capacity, block, interpret, lo,
+                      build_cols):
+    """v3 build-mode wrapper; see _expand_kernel_b8."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    chunk = _default_chunk(block)
+    w1w, w2w = _window_widths(block, chunk)
+    wr = w1w  # record window: b+128 coverage, 128-aligned, chunk-mult
+    k = len(cols)
+    kb = len(build_cols)
+    m = S.shape[0]
+
+    rows8 = _split_rows8(cols)
+    ck8 = _round_up(len(rows8), 16)
+    is_real = S != jnp.int32(2**31 - 1)
+    # aux f32 rows: [0] lo - S (the rank contribution), [1] S with
+    # sentinels mapped to 2^30 — NOT zero (a zero would make sentinel
+    # records "cover" every slot) and f32-exact (> any out_pad).
+    aux = [
+        jnp.where(is_real, (lo - S).astype(jnp.float32), 0.0),
+        jnp.where(is_real, S.astype(jnp.float32), jnp.float32(2**30)),
+    ]
+    out_pad = _round_up(out_capacity, block)
+    pad_cols = out_pad + wr + 128 - m
+    if pad_cols > 0:
+        S = jnp.concatenate(
+            [S, jnp.full((pad_cols,), 2**31 - 1, jnp.int32)]
+        )
+        rows8 = [
+            jnp.concatenate([r, jnp.zeros((pad_cols,), jnp.bfloat16)])
+            for r in rows8
+        ]
+        aux = [
+            jnp.concatenate(
+                [aux[0], jnp.zeros((pad_cols,), jnp.float32)]
+            ),
+            jnp.concatenate(
+                [aux[1], jnp.full((pad_cols,), 2**30, jnp.float32)]
+            ),
+        ]
+    v8T = jnp.stack(
+        rows8 + [jnp.zeros_like(rows8[0])] * (ck8 - len(rows8)), axis=0
+    )
+    auxT = jnp.stack(
+        aux + [jnp.zeros_like(aux[0])] * 6, axis=0
+    )                                            # (8, m_pad) f32
+
+    starts = jnp.arange(out_pad // block, dtype=jnp.int32) * block
+    r0 = jnp.maximum(
+        jnp.searchsorted(S, starts, side="right").astype(jnp.int32) - 1,
+        0,
+    )
+    r0a = r0 // 128
+
+    brows8 = _split_rows8(build_cols)
+    ckb8 = _round_up(len(brows8), 16)
+    nb = build_cols[0].shape[0]
+    nb_pad = _round_up(max(nb, 1), 128) + w2w
+    bpad = nb_pad - nb
+    brows8 = [
+        jnp.concatenate([r, jnp.zeros((bpad,), jnp.bfloat16)])
+        for r in brows8
+    ]
+    bv8T = jnp.stack(
+        brows8 + [jnp.zeros_like(brows8[0])] * (ckb8 - len(brows8)),
+        axis=0,
+    )
+    omax = _round_up(max(nb, 1), 128) // 128
+    lo_pad = jnp.concatenate(
+        [lo, jnp.zeros((max(S.shape[0] - lo.shape[0], 0),), lo.dtype)]
+    )
+    s_r0 = jnp.where(S[r0] == 2**31 - 1, starts, S[r0])
+    w1 = lo_pad[r0] + (starts - s_r0)
+    w1a = jnp.clip(w1, 0, omax * 128) // 128
+    w2 = lo_pad[jnp.minimum(r0 + 1, S.shape[0] - 1)]
+    w2a = jnp.clip(w2, 0, omax * 128) // 128
+
+    vma = getattr(jax.typeof(v8T), "vma", None)
+    out_shape = (
+        jax.ShapeDtypeStruct((ck8 + ckb8, out_pad), jnp.float32,
+                             vma=vma)
+        if vma is not None
+        else jax.ShapeDtypeStruct((ck8 + ckb8, out_pad), jnp.float32)
+    )
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(
+                _expand_kernel_b8, block=block, chunk=chunk, ck8=ck8,
+                ckb8=ckb8, wr=wr, w1w=w1w, w2w=w2w,
+            ),
+            grid=(out_pad // block,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((ck8 + ckb8, block), lambda i: (0, i)),
+            scratch_shapes=[
+                pltpu.VMEM((ck8, wr), jnp.bfloat16),
+                pltpu.VMEM((8, wr), jnp.float32),
+                pltpu.VMEM((ckb8, w1w), jnp.bfloat16),
+                pltpu.VMEM((ckb8, w2w), jnp.bfloat16),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+            out_shape=out_shape,
+            interpret=interpret,
+        )(r0a, w1a, w2a, v8T, auxT, bv8T)
+    rec_outs = [c[:out_capacity] for c in _merge_rows8(out, k)]
+    build_outs = [
+        c[:out_capacity] for c in _merge_rows8(out[ck8:], kb)
+    ]
+    # start_b/rank placeholders (consumed in-kernel only); derived from
+    # S so they carry the same vma as the cond's other branch under
+    # shard_map.
+    zero = S[:out_capacity] * 0
+    return rec_outs, zero, zero, build_outs
 
 
 def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
@@ -332,12 +548,16 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
     arrays over the key-sorted build pack), the kernel also
     materializes each output row's build values at
     ``rank = lo[r] + (j - S[r])`` via the two-window scheme (module
-    docstring) and returns them plus the rank itself.
+    docstring).
 
     Returns ``(rec_outs, start_b)`` — or, on the build path,
     ``(rec_outs, start_b, rank, build_outs)`` — where rec_outs /
-    build_outs are lists of uint64 arrays and start_b / rank are int32,
-    all of length out_capacity. Values at slots >= the true total are
+    build_outs are lists of uint64 arrays of length out_capacity.
+    start_b is the run's first output slot per row (int32). On the
+    BUILD path start_b and rank are ZERO PLACEHOLDERS: both quantities
+    are consumed inside the kernel and exist in the return value only
+    so the caller's lax.cond branches (kernel vs XLA-gather fallback)
+    have matching pytrees. Values at slots >= the true total are
     garbage (masked by the caller).
 
     ``block`` must be a multiple of 1024 on real TPUs (the 1-D int32
@@ -345,8 +565,6 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
     interpret mode accepts any block with block % chunk == 0 (the
     chunked loops; _window_widths handles the 128-lane rounding).
     """
-    import os
-
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -359,21 +577,17 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
         # rides f32 and silently corrupts past 2^24 otherwise.
         assert out_capacity < _F32_EXACT
         assert build_cols[0].shape[0] < _F32_EXACT
+        # v3 path: bf16 8-bit chunk matmuls, 128-aligned record
+        # windows, placeholder start_b/rank (consumed in-kernel only —
+        # callers on the build path never read them).
+        return _expand_gather_b8(
+            S, cols, out_capacity, block, interpret, lo, build_cols
+        )
     k = len(cols)
     m = S.shape[0]
     rows = _split_rows(cols)                         # 3k rows of (m,)
-    crow = srow = 0
-    s_u64_lane = not build and out_capacity >= _F32_EXACT
-    if build:
-        # Two extra f32 rows drive the in-kernel rank math. Sentinel
-        # records carry 0 in both (their rows are garbage-by-contract;
-        # a 2^31-1 sentinel would not round-trip f32 exactly).
-        is_real = S != jnp.int32(2**31 - 1)
-        crow = len(rows)
-        rows.append(jnp.where(is_real, (lo - S).astype(jnp.float32), 0.0))
-        srow = len(rows)
-        rows.append(jnp.where(is_real, S.astype(jnp.float32), 0.0))
-    elif s_u64_lane:
+    s_u64_lane = out_capacity >= _F32_EXACT
+    if s_u64_lane:
         # start_b values can exceed f32's exact-integer range; ride S
         # as a full 22-bit-chunked u64 lane instead of one f32 row.
         rows.extend(
@@ -414,87 +628,42 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
     )
     r0b = r0 // block
 
-    chunk = _default_chunk(block)
-    w1w, w2w = _window_widths(block, chunk)
-
-    ckb = 0
-    if build:
-        kb = len(build_cols)
-        nb = build_cols[0].shape[0]
-        brows = _split_rows(build_cols)
-        ckb = _round_up(len(brows), 8)
-        nb_pad = _round_up(max(nb, 1), 128) + w2w
-        bpad = nb_pad - nb
-        brows = [
-            jnp.concatenate([r, jnp.zeros((bpad,), jnp.float32)])
-            for r in brows
-        ]
-        bvT = jnp.stack(
-            brows + [jnp.zeros_like(brows[0])] * (ckb - len(brows)),
-            axis=0,
-        )                                            # (ckb, nb_pad)
-        # Window offsets (aligned down to 128, passed pre-divided).
-        # Real offsets never exceed nb (lo <= nb, and the straddler
-        # start lo[r0] + (i*B - S[r0]) <= its run's end rank <= nb), so
-        # the clip only guards sentinel-block garbage.
-        omax = _round_up(max(nb, 1), 128) // 128
-        lo_pad = jnp.concatenate(
-            [lo, jnp.zeros((max(S.shape[0] - lo.shape[0], 0),),
-                           lo.dtype)]
-        )
-        s_r0 = jnp.where(S[r0] == 2**31 - 1, starts, S[r0])
-        w1 = lo_pad[r0] + (starts - s_r0)
-        w1a = jnp.clip(w1, 0, omax * 128) // 128
-        w2 = lo_pad[jnp.minimum(r0 + 1, S.shape[0] - 1)]
-        w2a = jnp.clip(w2, 0, omax * 128) // 128
-    else:
-        bvT = jnp.zeros((8, 512), jnp.float32)       # unused placeholder
-        w1a = jnp.zeros_like(r0b)
-        w2a = jnp.zeros_like(r0b)
-
     # Under shard_map with vma checking, the out_shape must carry how
     # the output varies over mesh axes — same as the inputs.
     vma = getattr(jax.typeof(vT), "vma", None)
     out_shape = (
-        jax.ShapeDtypeStruct((ck + ckb, out_pad), jnp.float32, vma=vma)
+        jax.ShapeDtypeStruct((ck, out_pad), jnp.float32, vma=vma)
         if vma is not None
-        else jax.ShapeDtypeStruct((ck + ckb, out_pad), jnp.float32)
+        else jax.ShapeDtypeStruct((ck, out_pad), jnp.float32)
     )
     # Global x64 breaks Mosaic legalization ("failed to legalize
     # func.return" — i64 index plumbing); every type here is explicit
     # i32/f32, so scope x64 off around the kernel. The offsets ride a
     # plain SMEM input + manual DMA because PrefetchScalarGridSpec
     # also fails to legalize with this toolchain.
-    scratch = [
-        pltpu.VMEM((2 * block,), jnp.int32),
-        pltpu.VMEM((ck, 2 * block), jnp.float32),
-    ]
-    if build:
-        scratch += [
-            pltpu.VMEM((ckb, w1w), jnp.float32),
-            pltpu.VMEM((ckb, w2w), jnp.float32),
-        ]
-    scratch += [pltpu.SemaphoreType.DMA(())] * (4 if build else 2)
+    chunk = _default_chunk(block)
     with jax.enable_x64(False):
         out = pl.pallas_call(
             functools.partial(
                 _expand_kernel, block=block, chunk=chunk,
-                ck=ck, ckb=ckb, crow=crow, srow=srow, w1w=w1w, w2w=w2w,
+                ck=ck, srow=srow,
             ),
             grid=(out_pad // block,),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
-            out_specs=pl.BlockSpec((ck + ckb, block), lambda i: (0, i)),
-            scratch_shapes=scratch,
+            out_specs=pl.BlockSpec((ck, block), lambda i: (0, i)),
+            scratch_shapes=[
+                pltpu.VMEM((2 * block,), jnp.int32),
+                pltpu.VMEM((ck, 2 * block), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA(()),
+            ],
             out_shape=out_shape,
             interpret=interpret,
-        )(r0b, w1a, w2a, S, vT, bvT)
+        )(r0b, S, vT)
     rec_outs = [c[:out_capacity] for c in _merge_rows(out, k)]
     if s_u64_lane:
         start_b = (
@@ -503,15 +672,7 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
         )
     else:
         start_b = out[srow, :out_capacity].astype(jnp.int32)
-    if not build:
-        return rec_outs, start_b
-    rank = (
-        jnp.arange(out_capacity, dtype=jnp.int32)
-        + out[crow, :out_capacity].astype(jnp.int32)
-    )
-    bmerged = _merge_rows(out[ck:], kb)
-    build_outs = [c[:out_capacity] for c in bmerged]
-    return rec_outs, start_b, rank, build_outs
+    return rec_outs, start_b
 
 
 def expand_gather_reference(S: jax.Array, cols: Sequence[jax.Array],

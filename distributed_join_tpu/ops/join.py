@@ -141,29 +141,25 @@ def _from_u64_lane(c64: jax.Array, dt):
     raise TypeError(dt)
 
 
-def _expand_records(S, recs: dict, out_capacity: int, j,
-                    build_pack: Optional[dict] = None, nb: int = 0):
-    """Broadcast each record's values down its output run, and (on the
-    kernel build path) materialize the build-side values too.
+def _expand_records(S, recs: dict, out_capacity: int, j):
+    """Broadcast each record's values down its output run (the XLA
+    join path's expansion; the kernel pipeline's lives in
+    _join_kernel_path with the fused build-side materialization).
 
-    Returns ``(out_vals, start_b, build_vals)``:
-    - out_vals: name -> (out_capacity,) array of expanded record values
-      (WITHOUT ``__lo`` when the kernel consumed it);
-    - start_b[i]: the first output slot of slot i's run;
-    - build_vals: the gathered ``build_pack`` columns when the kernel
-      build path (or its in-cond exact fallback) ran, else None (the
-      caller then derives the rank from ``__lo`` and gathers the build
-      side itself).
+    Returns ``(out_vals, start_b)``: the expanded record values and
+    each slot's run-start output slot (the caller derives the build
+    rank from the expanded ``__lo`` and start_b, then gathers).
 
-    XLA path: one unique-slot int32 scatter + cummax gives each slot
-    its record index; packed row-gathers per dtype group pull the
+    XLA formulation: one unique-slot int32 scatter + cummax gives each
+    slot its record index; packed row-gathers per dtype group pull the
     values; start_b is a second cummax over the raw marks.
 
-    Pallas path (default on TPU; DJTPU_PALLAS_EXPAND=0 disables, =1
-    forces it through the interpreter elsewhere; non-f64 columns only):
-    the streaming one-hot-matmul kernel of ops/expand_pallas.py. The
-    build path additionally needs every rank quantity f32-exact
-    (build rows and out_capacity < 2^24; per-shard blocks in practice).
+    Pallas record-expand (TPU; DJTPU_PALLAS_EXPAND=0 disables, =1
+    forces it through the interpreter elsewhere; non-f64 columns only)
+    replaces all three with the streaming one-hot-matmul kernel of
+    ops/expand_pallas.py. This path is reached on TPU only when
+    _kernel_path_ok rejected the full pipeline (f64 columns route to
+    the scatter below instead; oversized blocks still benefit here).
     """
     import os
 
@@ -176,93 +172,18 @@ def _expand_records(S, recs: dict, out_capacity: int, j,
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
         # The Mosaic lowering works under shard_map on real TPU
-        # (compile-checked for v5e:2x4: tpu_custom_call in the 8-device
-        # module); only the INTERPRETER trips shard_map's vma checks,
-        # so the CPU test mesh falls back to the XLA path.
+        # (compile-checked: tpu_custom_call in the mesh module); only
+        # the INTERPRETER trips shard_map's vma checks, so the CPU
+        # test mesh falls back to the XLA path.
         interpret = jax.default_backend() != "tpu"
         if interpret and getattr(jax.typeof(S), "vma", None):
             use_pallas = False
     if use_pallas:
-        from distributed_join_tpu.ops.expand_pallas import (
-            _F32_EXACT,
-            expand_gather,
-        )
+        from distributed_join_tpu.ops.expand_pallas import expand_gather
 
-        build_ok = (
-            build_pack is not None
-            and len(build_pack) > 0
-            and 0 < nb < _F32_EXACT
-            and out_capacity < _F32_EXACT
-        )
-        blanes = {}
-        if build_ok:
-            blanes = {
-                nm: _to_u64_lane(c) for nm, c in build_pack.items()
-            }
-            build_ok = all(v is not None for v in blanes.values())
-        lanes = {
-            nm: _to_u64_lane(c)
-            for nm, c in recs.items()
-            if not (build_ok and nm == "__lo")
-        }
+        lanes = {nm: _to_u64_lane(c) for nm, c in recs.items()}
         if all(v is not None for v in lanes.values()):
             names = list(lanes)
-            if build_ok:
-                from distributed_join_tpu.ops.expand_pallas import (
-                    build_windows_ok,
-                )
-
-                bnames = list(blanes)
-                lo_i32 = recs["__lo"].astype(jnp.int32)
-                cols_list = [lanes[nm] for nm in names]
-                bl_list = [blanes[nm] for nm in bnames]
-
-                def _kernel(_):
-                    return expand_gather(
-                        S, cols_list, out_capacity, interpret=interpret,
-                        lo=lo_i32, build_cols=bl_list,
-                    )
-
-                def _fallback(_):
-                    # The exact path for data the two-window proof does
-                    # not cover (unmatched-build-key gaps,
-                    # expand_pallas.build_windows_ok): record expansion
-                    # with __lo riding as one more lane, then the XLA
-                    # packed row gather at the derived rank.
-                    outs2, sb2 = expand_gather(
-                        S, cols_list + [_to_u64_lane(recs["__lo"])],
-                        out_capacity, interpret=interpret,
-                    )
-                    lo_b = _from_u64_lane(
-                        outs2[-1], recs["__lo"].dtype
-                    ).astype(jnp.int32)
-                    rank2 = lo_b + (j - sb2)
-                    safe = jnp.clip(rank2, 0, max(nb - 1, 0))
-                    if len(bl_list) == 1:
-                        bouts2 = [bl_list[0][safe]]
-                    else:
-                        pack = jnp.stack(bl_list, axis=1)
-                        rows_g = pack[safe]
-                        bouts2 = [
-                            rows_g[:, t] for t in range(len(bl_list))
-                        ]
-                    return outs2[:-1], sb2, rank2, bouts2
-
-                rec_outs, start_b, _rank, build_outs = lax.cond(
-                    build_windows_ok(S, lo_i32, out_capacity),
-                    _kernel, _fallback, None,
-                )
-                out_vals = {
-                    nm: _from_u64_lane(rec_outs[i], recs[nm].dtype)
-                    for i, nm in enumerate(names)
-                }
-                build_vals = {
-                    nm: _from_u64_lane(
-                        build_outs[i], build_pack[nm].dtype
-                    )
-                    for i, nm in enumerate(bnames)
-                }
-                return out_vals, start_b, build_vals
             rec_outs, start_b = expand_gather(
                 S, [lanes[nm] for nm in names], out_capacity,
                 interpret=interpret,
@@ -271,7 +192,7 @@ def _expand_records(S, recs: dict, out_capacity: int, j,
                 nm: _from_u64_lane(rec_outs[i], recs[nm].dtype)
                 for i, nm in enumerate(names)
             }
-            return out_vals, start_b, None
+            return out_vals, start_b
 
     raw = jnp.zeros((out_capacity,), jnp.int32).at[S].set(
         j + 1, mode="drop", unique_indices=True
@@ -281,7 +202,7 @@ def _expand_records(S, recs: dict, out_capacity: int, j,
     # The run's first slot is where its raw mark landed — cheaper as an
     # out-domain cummax than as another ridden sort lane.
     start_b = lax.cummax(jnp.where(raw > 0, j, 0))
-    return out_vals, start_b, None
+    return out_vals, start_b
 
 
 def _grouped_row_gather(cols: dict, idx: jax.Array) -> dict:
@@ -301,6 +222,245 @@ def _grouped_row_gather(cols: dict, idx: jax.Array) -> dict:
             for j, n in enumerate(names):
                 out[n] = rows[:, j]
     return out
+
+
+def _u64_lane_ok(dt) -> bool:
+    """Static form of _to_u64_lane's dtype dispatch (no tracing)."""
+    if dt in (jnp.int64, jnp.uint64) or dt == jnp.float32:
+        return True
+    return jnp.issubdtype(dt, jnp.integer) and jnp.iinfo(dt).bits <= 32
+
+
+def _kernel_path_ok(build, probe, keys, b1d, p1d, nb, npr,
+                    out_capacity):
+    """Choose between the fused-kernel pipeline (merged sort -> fused
+    scans -> stream compactions -> expand kernel; TPU) and the XLA
+    pipeline (everything below; CPU tests, f64 columns, empty sides,
+    blocks past the f32-exact rank range). Returns (use, interpret)."""
+    import os
+
+    from distributed_join_tpu.ops.expand_pallas import _F32_EXACT
+
+    env = os.environ.get("DJTPU_PALLAS_EXPAND")
+    if env == "0":
+        return False, False
+    interpret = jax.default_backend() != "tpu"
+    if interpret and env != "1":
+        return False, False
+    if interpret and getattr(
+        jax.typeof(build.columns[keys[0]]), "vma", None
+    ):
+        # shard_map's interpreter trips on pallas_call vma checks; the
+        # CPU test mesh runs the XLA pipeline instead (real-TPU
+        # shard_map compiles the kernels fine).
+        return False, False
+    if not (0 < nb < _F32_EXACT and npr > 0
+            and out_capacity < _F32_EXACT and nb + npr < 2**31 - 2):
+        return False, False
+    dts = (
+        [build.columns[k].dtype for k in keys]
+        + [build.columns[nm].dtype for nm in b1d]
+        + [probe.columns[nm].dtype for nm in p1d]
+    )
+    return all(_u64_lane_ok(dt) for dt in dts), interpret
+
+
+def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
+                      build_payload, probe_payload, out_capacity,
+                      interpret) -> JoinResult:
+    """The TPU pipeline: ONE value-carrying merged sort, the fused
+    scan kernel (ops/scan_pallas.py — including the MATCHED-build
+    machinery), two streaming compactions (ops/compact_pallas.py: the
+    run-record block and the matched-dense build pack), and the expand
+    kernel with its two-window build materialization
+    (ops/expand_pallas.py). Ranks are matched-build ranks (lo_m), so
+    the window bound holds by construction — unmatched build keys
+    never enter the pack; build_windows_ok + lax.cond stay as
+    belt-and-braces (the fallback is also exact over the pack)."""
+    from distributed_join_tpu.ops.compact_pallas import stream_compact
+    from distributed_join_tpu.ops.expand_pallas import (
+        build_windows_ok,
+        expand_gather,
+    )
+    from distributed_join_tpu.ops.scan_pallas import join_scans
+
+    nb, npr = build.capacity, probe.capacity
+    n = nb + npr
+    bvalid, pvalid = build.valid, probe.valid
+
+    # merged sort: keys + tag as sort keys; BOTH sides' 1-D payloads
+    # (and 2-D columns' row indices) ride as values — value operands
+    # are nearly free, and this subsumes the XLA path's separate
+    # build-side sort.
+    m_ops = []
+    for k in keys:
+        b, p = build.columns[k], probe.columns[k]
+        sentinel = _dtype_sentinel_max(b.dtype)
+        m_ops.append(jnp.concatenate([
+            jnp.where(bvalid, b, sentinel),
+            jnp.where(pvalid, p, sentinel),
+        ]))
+    tag = jnp.concatenate([
+        jnp.where(bvalid, jnp.int8(0), jnp.int8(2)),
+        jnp.where(pvalid, jnp.int8(1), jnp.int8(2)),
+    ])
+    m_vals = []
+    mv_names = []
+    for nm in p1d:
+        c = probe.columns[nm]
+        m_vals.append(
+            jnp.concatenate([jnp.zeros((nb,), dtype=c.dtype), c])
+        )
+        mv_names.append(("p", nm))
+    for nm in b1d:
+        c = build.columns[nm]
+        m_vals.append(
+            jnp.concatenate([c, jnp.zeros((npr,), dtype=c.dtype)])
+        )
+        mv_names.append(("b", nm))
+    if p2d:
+        m_vals.append(jnp.arange(n, dtype=jnp.int32))
+        mv_names.append(("p", "__prow"))
+    if b2d:
+        m_vals.append(jnp.concatenate([
+            jnp.arange(nb, dtype=jnp.int32),
+            jnp.zeros((npr,), jnp.int32),
+        ]))
+        mv_names.append(("b", "__browidx"))
+    sorted_m = lax.sort(
+        (*m_ops, tag, *m_vals), num_keys=len(keys) + 1
+    )
+    skeys = sorted_m[:len(keys)]
+    stag = sorted_m[len(keys)]
+    svals = dict(zip(mv_names, sorted_m[len(keys) + 1:]))
+
+    iota = jnp.arange(n, dtype=jnp.int32)
+    changed = jnp.zeros((n,), dtype=bool)
+    for sk in skeys:
+        prev = jnp.concatenate([sk[:1], sk[:-1]])
+        changed = changed | (sk != prev)
+    first = changed | (iota == 0)
+
+    sc = join_scans(stag, first, interpret=interpret)
+    cnt = sc["cnt"]
+    total = jnp.sum(cnt.astype(jnp.int64))
+    rec_total = sc["rec_pos"][-1] + 1
+    is_probe = stag == jnp.int8(1)
+    is_rec = is_probe & (cnt > 0)
+
+    # record compaction: one record per matching probe, in start_out
+    # order (rec_pos is monotone over merged order, which IS start_out
+    # order), carrying S, the probe-side output values, and lo_m.
+    rec_lanes = {"__S": _to_u64_lane(sc["start_out"])}
+    for i, sk in enumerate(skeys):
+        rec_lanes[f"__key{i}"] = _to_u64_lane(sk)
+    for nm in p1d:
+        rec_lanes[nm] = _to_u64_lane(svals[("p", nm)])
+    rec_lanes["__lo"] = _to_u64_lane(sc["lo_m"])
+    if p2d:
+        rec_lanes["__prow"] = _to_u64_lane(svals[("p", "__prow")])
+    rec_names = list(rec_lanes)
+    compacted = dict(zip(rec_names, stream_compact(
+        is_rec, sc["rec_pos"], [rec_lanes[nm] for nm in rec_names],
+        out_capacity, interpret=interpret,
+    )))
+    kept = jnp.minimum(rec_total, jnp.int32(out_capacity))
+    j = jnp.arange(out_capacity, dtype=jnp.int32)
+    S = jnp.where(j < kept, compacted["__S"].astype(jnp.int32),
+                  jnp.int32(_I32_MAX))
+    # Slots past the survivor count are UNDEFINED in stream_compact's
+    # output; the window checker and the kernel's w2 lookups read
+    # lo[r0+1] across that boundary, so zero them like the sort-based
+    # path's _prefix padding did (garbage there would spuriously fail
+    # build_windows_ok and force the slow fallback).
+    lo_rec = jnp.where(
+        j < kept, compacted["__lo"].astype(jnp.int32), 0
+    )
+    compacted["__lo"] = _to_u64_lane(lo_rec)
+
+    # matched-build pack: dense, key-ordered, gap-free by construction.
+    pack_names = list(b1d) + (["__browidx"] if b2d else [])
+    pack_lanes = [
+        _to_u64_lane(svals[("b", nm)]) for nm in pack_names
+    ]
+    matched = sc["matched"] != 0
+    pack = stream_compact(
+        matched, sc["mb_pos"], pack_lanes, nb, interpret=interpret,
+    ) if pack_names else []
+
+    rec_value_names = [
+        nm for nm in rec_names if nm not in ("__S", "__lo")
+    ]
+    cols_list = [compacted[nm] for nm in rec_value_names]
+
+    if pack_names:
+        def _kernel(_):
+            return expand_gather(
+                S, cols_list, out_capacity, interpret=interpret,
+                lo=lo_rec, build_cols=pack,
+            )
+
+        def _fallback(_):
+            outs2, sb2 = expand_gather(
+                S, cols_list + [compacted["__lo"]], out_capacity,
+                interpret=interpret,
+            )
+            rank2 = outs2[-1].astype(jnp.int32) + (j - sb2)
+            safe = jnp.clip(rank2, 0, max(nb - 1, 0))
+            if len(pack) == 1:
+                bouts2 = [pack[0][safe]]
+            else:
+                packed = jnp.stack(pack, axis=1)
+                rows_g = packed[safe]
+                bouts2 = [rows_g[:, t] for t in range(len(pack))]
+            return outs2[:-1], sb2, rank2, bouts2
+
+        rec_outs, start_b, _rank, build_outs = lax.cond(
+            build_windows_ok(S, lo_rec, out_capacity),
+            _kernel, _fallback, None,
+        )
+        build_vals_u64 = dict(zip(pack_names, build_outs))
+    else:
+        rec_outs, start_b = expand_gather(
+            S, cols_list, out_capacity, interpret=interpret,
+        )
+        build_vals_u64 = {}
+    rec_vals_u64 = dict(zip(rec_value_names, rec_outs))
+
+    out_cols = {}
+    for i, k in enumerate(keys):
+        out_cols[k] = _from_u64_lane(
+            rec_vals_u64[f"__key{i}"], build.columns[k].dtype
+        )
+    for nm in b1d:
+        out_cols[nm] = _from_u64_lane(
+            build_vals_u64[nm], build.columns[nm].dtype
+        )
+    if b2d:
+        bidx = _from_u64_lane(
+            build_vals_u64["__browidx"], jnp.int32
+        )
+        bidx = jnp.clip(bidx, 0, max(nb - 1, 0))
+        for nm in b2d:
+            out_cols[nm] = build.columns[nm][bidx]
+    for nm in p1d:
+        out_cols[nm] = _from_u64_lane(
+            rec_vals_u64[nm], probe.columns[nm].dtype
+        )
+    if p2d:
+        prow = _from_u64_lane(rec_vals_u64["__prow"], jnp.int32)
+        p = jnp.clip(prow - nb, 0, max(npr - 1, 0))
+        for nm in p2d:
+            out_cols[nm] = probe.columns[nm][p]
+    out_cols = {
+        nm: out_cols[nm]
+        for nm in [*keys, *build_payload, *probe_payload]
+    }
+    return JoinResult(
+        Table(out_cols, j < total),
+        total=total,
+        overflow=total > out_capacity,
+    )
 
 
 def sort_merge_inner_join(
@@ -345,6 +505,22 @@ def sort_merge_inner_join(
     npr = probe.capacity
     n = nb + npr
     bvalid, pvalid = build.valid, probe.valid
+
+    if not jax.config.x64_enabled:
+        warnings.warn(
+            "JAX x64 is disabled: join match totals are int32 and the "
+            "overflow flag is unreliable past 2**31 matches per shard",
+            stacklevel=2,
+        )
+
+    use_kernel, interpret = _kernel_path_ok(
+        build, probe, keys, b1d, p1d, nb, npr, out_capacity
+    )
+    if use_kernel:
+        return _join_kernel_path(
+            build, probe, keys, b1d, b2d, p1d, p2d, build_payload,
+            probe_payload, out_capacity, interpret,
+        )
 
     # -- 1. build-side sort: keys + tag + 1-D payloads (+ row index for
     #    2-D columns). Valid rows compact to a key-sorted prefix whose
@@ -430,13 +606,8 @@ def sort_merge_inner_join(
     #    emulated-u32-pair reduce-window that blows TPU scoped VMEM at
     #    10M+ rows (verified on v5e). If csum wraps, total >= 2^31 >>
     #    out_capacity, so overflow fires and the (garbage) payload rows
-    #    are already flagged untrustworthy.
-    if not jax.config.x64_enabled:
-        warnings.warn(
-            "JAX x64 is disabled: join match totals are int32 and the "
-            "overflow flag is unreliable past 2**31 matches per shard",
-            stacklevel=2,
-        )
+    #    are already flagged untrustworthy. (The x64 warning for this
+    #    contract is issued once by sort_merge_inner_join.)
     csum = jnp.cumsum(cnt)
     total = jnp.sum(cnt.astype(jnp.int64))
     start_out = csum - cnt            # first output slot of each run
@@ -480,34 +651,21 @@ def sort_merge_inner_join(
         for nm, c in zip(rec_names, sorted_r[1:])
     }
 
-    # -- 5. expansion: either ONE small scatter + cummax + packed row
-    #    gathers (XLA primitives), or the Pallas streaming kernel
-    #    (ops/expand_pallas.py) that replaces all three with sequential
-    #    record windows + a one-hot MXU matmul — and, on its build
-    #    path, ALSO materializes the build side from two bounded build
-    #    windows, eliminating the join's last random-access gather. The
-    #    kernel path is DEFAULT ON TPU (DJTPU_PALLAS_EXPAND=0 disables,
-    #    =1 forces the interpreter elsewhere); falls back for dtypes a
-    #    u64 lane can't carry bit-exactly on TPU (f64: x64 bitcast is
-    #    not implemented there) and inside shard_map.
+    # -- 5. expansion: ONE small scatter + cummax + packed row gathers
+    #    (XLA primitives), or the Pallas record-expand kernel where it
+    #    applies (see _expand_records); the build side is an XLA packed
+    #    row gather at the derived rank. The fused kernel pipeline with
+    #    its in-kernel build materialization lives in
+    #    _join_kernel_path; this path serves CPU, f64 columns, and
+    #    blocks past the f32-exact rank range.
     j = jnp.arange(out_capacity, dtype=jnp.int32)
-    build_pack = {nm: sb_payload[nm] for nm in b1d}
+    out_vals, start_b = _expand_records(S, recs, out_capacity, j)
+    lo_b = out_vals.pop("__lo").astype(jnp.int32)
+    build_rank = lo_b + (j - start_b)
+    safe_rank = jnp.clip(build_rank, 0, max(nb - 1, 0))
+    build_vals = _grouped_row_gather(sb_payload, safe_rank)
     if b2d:
-        # The 2-D string columns' row indices ride the kernel too; the
-        # per-column 2-D gathers below then use the kernel's output.
-        build_pack["__browidx"] = sb_rowidx
-    out_vals, start_b, build_vals = _expand_records(
-        S, recs, out_capacity, j, build_pack=build_pack, nb=nb
-    )
-    if build_vals is None:
-        lo_b = out_vals.pop("__lo").astype(jnp.int32)
-        build_rank = lo_b + (j - start_b)
-        safe_rank = jnp.clip(build_rank, 0, max(nb - 1, 0))
-        build_vals = _grouped_row_gather(sb_payload, safe_rank)
-        if b2d:
-            build_vals["__browidx"] = sb_rowidx[safe_rank]
-    else:
-        out_vals.pop("__lo", None)
+        build_vals["__browidx"] = sb_rowidx[safe_rank]
 
     out_cols = {}
     for i, k in enumerate(keys):
