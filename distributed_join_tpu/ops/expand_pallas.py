@@ -124,14 +124,19 @@ def _default_chunk(block: int) -> int:
 
 
 def _window_widths(block: int, chunk: int):
-    """Build-window VMEM widths: wide enough for the proof bounds
-    (straddler: B ranks; rest: 2B) plus 127 of down-alignment slop,
-    rounded so the chunked compare loop and the 128-lane tile divide
-    them exactly."""
+    """Build-window VMEM widths, both B + alignment slop, rounded so
+    the chunked compare loop and the 128-lane tile divide them exactly.
+
+    Window 2's bound is B (not the naive 2B): middle records' runs
+    tile the block, so ``lo[r] - lo[r0+1]`` across them is at most the
+    coverage consumed before the last record r1 starts, and r1's own
+    in-block rank extent is at most the coverage that remains —
+    ``(lo[r1] - lo[r0+1]) + extent(r1) <= (S[r1] - blockstart) +
+    (blockend - S[r1]) = B``. build_windows_ok checks exactly this
+    quantity per block."""
     lane = max(chunk, 128)
     w1w = _round_up(block + 128, lane)
-    w2w = _round_up(2 * block + 256, lane)
-    return w1w, w2w
+    return w1w, w1w
 
 
 def build_windows_ok(S: jax.Array, lo: jax.Array, out_capacity: int,
@@ -139,15 +144,18 @@ def build_windows_ok(S: jax.Array, lo: jax.Array, out_capacity: int,
     """Exact per-run-of-blocks validity of the two-window build scheme.
 
     Window 2 of output block i covers ranks
-    ``[align128(lo[r0[i]+1]), +w2w)``; the largest rank any
-    non-straddler row in the block can need is ``lo[r1] + B - 1`` with
-    ``r1 = r0[i+1]`` (``lo`` is non-decreasing over records, so the
-    last record intersecting the block has the block's largest lo).
-    Build keys with zero probe matches advance ``lo`` without emitting
-    records, so this can exceed the window — a DATA property the
-    kernel cannot bound a priori. Returns a traced bool: True iff
-    every block's needs fit, i.e. the kernel path is exact;
-    ops/join.py conds to the XLA gather otherwise.
+    ``[align128(lo[r0[i]+1]), +w2w)``. The largest rank any
+    non-straddler row in the block can need is EXACTLY
+    ``lo[r1] + (blockend - S[r1]) - 1`` with ``r1 = r0[i+1]``: ``lo``
+    is non-decreasing over records, middle records' maxima
+    ``lo[r] + cnt[r] - 1 = lo[r+1] - 1 < lo[r1]``, and r1's in-block
+    extent is capped by the block end. On matched-rank data this is
+    always <= ``lo[r0+1] + B - 1`` (_window_widths); build keys with
+    zero probe matches advance ``lo`` without emitting records and
+    break it — a DATA property the kernel cannot bound a priori.
+    Returns a traced bool: True iff every block's needs fit, i.e. the
+    kernel path is exact; ops/join.py conds to the XLA gather
+    otherwise.
     """
     if block is None:
         block = _default_block()
@@ -163,12 +171,15 @@ def build_windows_ok(S: jax.Array, lo: jax.Array, out_capacity: int,
     lo_i = lo.astype(jnp.int32)
     nxt = jnp.minimum(r0[:-1] + 1, m - 1)
     w2 = lo_i[nxt]
-    hi = lo_i[r0[1:]] + block  # > any non-straddler in-block rank
-    # Blocks with no real record after their straddler have no
-    # window-2 reads by valid rows: S[r0+1] is a sentinel there and lo
-    # is zeroed padding, which would spuriously compare as a giant gap
-    # (every out_capacity > total run would fall back).
-    has_w2 = S[nxt] != jnp.int32(2**31 - 1)
+    r1 = r0[1:]
+    hi = lo_i[r1] + (starts[1:] - S[r1])  # > any non-straddler rank
+    # Two masks against spurious flags on blocks without window-2
+    # reads: (a) no real record after the straddler (S[r0+1] is a
+    # sentinel and lo is zeroed padding there — every
+    # out_capacity > total run would otherwise fall back); (b) the
+    # straddler covers the whole block (r1 == r0, and a giant run's
+    # blockend - S[r1] would read as a huge gap).
+    has_w2 = (S[nxt] != jnp.int32(2**31 - 1)) & (S[r1] > starts[:-1])
     return ~jnp.any(has_w2 & (hi > w2 + (w2w - 128)))
 
 

@@ -115,8 +115,8 @@ def main():
         seed=42, build_nrows=N, probe_nrows=N, selectivity=0.3,
     )
     jax.block_until_ready((build, probe))
-    names = {1: "merged sort", 2: "+ fused scans", 3: "+ rec compact",
-             4: "+ pack compact", 5: "+ expand/windows"}
+    names = {2: "sort + fused scans", 4: "+ both compacts",
+             5: "+ expand/windows"}
     prevt = 0.0
     for k in sorted(names):
         t = timed(build, probe, k)
