@@ -172,7 +172,12 @@ def build_windows_ok(S: jax.Array, lo: jax.Array, out_capacity: int,
     nxt = jnp.minimum(r0[:-1] + 1, m - 1)
     w2 = lo_i[nxt]
     r1 = r0[1:]
-    hi = lo_i[r1] + (starts[1:] - S[r1])  # > any non-straddler rank
+    # The final block's real slots end at out_capacity, not at its
+    # padded end starts[nblk]; the padded tail holds no records, so
+    # using the raw padded end would count phantom ranks into hi and
+    # spuriously force the exact-but-slower XLA fallback.
+    ends = jnp.minimum(starts[1:], jnp.int32(out_capacity))
+    hi = lo_i[r1] + (ends - S[r1])  # > any non-straddler rank
     # Two masks against spurious flags on blocks without window-2
     # reads: (a) no real record after the straddler (S[r0+1] is a
     # sentinel and lo is zeroed padding there — every

@@ -359,6 +359,14 @@ def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
 
     sc = join_scans(stag, first, interpret=interpret)
     cnt = sc["cnt"]
+    # start_out is int32: past 2**31 total matches it wraps, S is no
+    # longer sorted, and searchsorted/build_windows_ok below operate on
+    # garbage. Under x64 that run is covered by the overflow contract —
+    # the int64 `total` still fires `overflow`, flagging every payload
+    # row untrustworthy (with x64 disabled the sum itself wraps; the
+    # documented caveat warned about in sort_merge_inner_join) — and
+    # cannot read out of bounds either way: the expand kernel's window
+    # offsets are clipped before every DMA.
     total = jnp.sum(cnt.astype(jnp.int64))
     rec_total = sc["rec_pos"][-1] + 1
     is_probe = stag == jnp.int8(1)
@@ -503,6 +511,19 @@ def sort_merge_inner_join(
     clash = set(build_payload) & set(probe_payload)
     if clash:
         raise ValueError(f"payload name collision: {sorted(clash)}")
+    # Internal record lanes (__S, __key{i}, __lo, __prow, __browidx)
+    # share one dict namespace with user column names; a payload named
+    # '__S' would silently overwrite a geometry lane and corrupt the
+    # join output.
+    reserved = [
+        nm for nm in (*keys, *build_payload, *probe_payload)
+        if nm.startswith("__")
+    ]
+    if reserved:
+        raise ValueError(
+            "column names starting with '__' are reserved for "
+            f"internal join lanes: {sorted(set(reserved))}"
+        )
 
     for k in keys:
         bdt = build.columns[k].dtype

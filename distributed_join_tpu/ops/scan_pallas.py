@@ -49,8 +49,6 @@ or their prefix counts, so int32 is exact up to 2^31 rows per shard.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
