@@ -1,0 +1,103 @@
+"""Throwaway probe: which vector primitives does Mosaic support on
+v5e for the merge-sort kernel? (dynamic roll, flips, XOR-partner CE
+via roll, reverse via flip both axes, dynamic flat shift)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def probe(name, kernel, out_shape, *args):
+    import jax.experimental.pallas as pl
+
+    try:
+        got = pl.pallas_call(
+            kernel, out_shape=out_shape, interpret=False
+        )(*args)
+        return name, np.asarray(got)
+    except Exception as e:
+        print(f"{name:40s} FAIL: {type(e).__name__}: {str(e)[:200]}")
+        return name, None
+
+
+def main():
+    R, L = 16, 128
+    x = jnp.arange(R * L, dtype=jnp.int32).reshape(R, L)
+    s = jnp.asarray([5], dtype=jnp.int32)
+
+    def k_flip_rows(x_ref, o_ref):
+        o_ref[...] = jnp.flip(x_ref[...], axis=0)
+
+    def k_flip_lanes(x_ref, o_ref):
+        o_ref[...] = jnp.flip(x_ref[...], axis=1)
+
+    def k_roll_static_lane(x_ref, o_ref):
+        from jax.experimental.pallas import tpu as pltpu
+        o_ref[...] = pltpu.roll(x_ref[...], 5, 1)
+
+    def k_roll_static_row(x_ref, o_ref):
+        from jax.experimental.pallas import tpu as pltpu
+        o_ref[...] = pltpu.roll(x_ref[...], 3, 0)
+
+    def k_roll_dyn(s_ref, x_ref, o_ref):
+        from jax.experimental.pallas import tpu as pltpu
+        o_ref[...] = pltpu.roll(x_ref[...], s_ref[0], 1)
+
+    def k_reshape_ce(x_ref, o_ref):
+        v = x_ref[...]
+        a = v.reshape(R // 2, 2, L)
+        lo = jnp.minimum(a[:, 0, :], a[:, 1, :])
+        hi = jnp.maximum(a[:, 0, :], a[:, 1, :])
+        o_ref[...] = jnp.stack([lo, hi], axis=1).reshape(R, L)
+
+    def k_iota_sel(x_ref, o_ref):
+        lane = jax.lax.broadcasted_iota(jnp.int32, (R, L), 1)
+        o_ref[...] = jnp.where(lane < 64, x_ref[...], -x_ref[...])
+
+    sds = jax.ShapeDtypeStruct((R, L), jnp.int32)
+    for name, k, args in [
+        ("flip rows (sublane)", k_flip_rows, (x,)),
+        ("flip lanes", k_flip_lanes, (x,)),
+        ("roll static lanes", k_roll_static_lane, (x,)),
+        ("roll static rows", k_roll_static_row, (x,)),
+        ("reshape-CE (R,2,L)", k_reshape_ce, (x,)),
+        ("iota select", k_iota_sel, (x,)),
+    ]:
+        nm, got = probe(name, k, sds, *args)
+        if got is not None:
+            print(f"{nm:40s} ok")
+
+    # dynamic roll: shift from SMEM scalar
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    try:
+        got = pl.pallas_call(
+            k_roll_dyn,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec((R, L), lambda: (0, 0))],
+            out_shape=sds,
+        )(s, x)
+        want = np.roll(np.asarray(x), 5, axis=1)  # sign check below
+        print(f"{'roll dynamic lanes':40s} ok "
+              f"(matches np.roll(+5): {np.array_equal(got, want)}, "
+              f"np.roll(-5): "
+              f"{np.array_equal(got, np.roll(np.asarray(x), -5, 1))})")
+    except Exception as e:
+        print(f"{'roll dynamic lanes':40s} FAIL: {str(e)[:200]}")
+
+    # semantics of static roll too
+    got = pl.pallas_call(
+        k_roll_static_lane, out_shape=sds)(x)
+    print("static roll(+5,axis=1) == np.roll(x,+5,1):",
+          np.array_equal(np.asarray(got), np.roll(np.asarray(x), 5, 1)),
+          "== np.roll(x,-5,1):",
+          np.array_equal(np.asarray(got), np.roll(np.asarray(x), -5, 1)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""How much cheaper is lax.sort on (g, m) — g independent runs of m —
+than one 20M sort, for the bench merged-sort operand set? The hybrid
+merge-sort design (XLA run sort + Pallas merge passes) rides on this.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_r3_batched_sort.py
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import distributed_join_tpu  # noqa: F401
+from distributed_join_tpu.utils.benchmarking import measure_chained
+
+N = 20_971_520  # 20M rounded to nice powers: 2**21 * 10? -> use 2**24*1.25
+# use exactly 2**24 = 16.7M plus... keep it simple: 2**24
+N = 2 ** 24
+
+
+def main():
+    key = jax.random.key(0)
+    k64 = jax.random.randint(key, (N,), 0, 2**62, dtype=jnp.int64)
+    tag = (k64 & 1).astype(jnp.int8)
+    v64 = k64 + 1
+    jax.block_until_ready((k64, tag, v64))
+
+    def batched(g):
+        def body(i, a, t, v):
+            srt = lax.sort(
+                ((a + i.astype(a.dtype)).reshape(g, N // g),
+                 t.reshape(g, N // g), v.reshape(g, N // g)),
+                num_keys=2, dimension=1,
+            )
+            return sum(
+                jnp.sum(c[:, ::1024].astype(jnp.int64)) for c in srt
+            )
+        return body
+
+    measure_chained(f"sort {N} flat (i64,i8,i64)", batched(1),
+                    k64, tag, v64)
+    for g in (8, 32, 128, 512, 2048, 8192):
+        measure_chained(
+            f"sort ({g}, {N // g}) (i64,i8,i64)", batched(g),
+            k64, tag, v64,
+        )
+
+    # u32-plane representation: same data as 5 u32/i8 planes, 3 keys
+    khi = (k64 >> 32).astype(jnp.uint32)
+    klo = k64.astype(jnp.uint32)
+    vhi = (v64 >> 32).astype(jnp.uint32)
+    vlo = v64.astype(jnp.uint32)
+    jax.block_until_ready((khi, klo, vhi, vlo))
+
+    def planes(g):
+        def body(i, a, b, t, c, d):
+            srt = lax.sort(
+                ((a + i.astype(a.dtype)).reshape(g, N // g),
+                 b.reshape(g, N // g), t.reshape(g, N // g),
+                 c.reshape(g, N // g), d.reshape(g, N // g)),
+                num_keys=3, dimension=1,
+            )
+            return sum(
+                jnp.sum(c[:, ::1024].astype(jnp.int64)) for c in srt
+            )
+        return body
+
+    measure_chained(f"sort {N} flat u32-planes", planes(1),
+                    khi, klo, tag, vhi, vlo)
+    for g in (128, 2048):
+        measure_chained(
+            f"sort ({g}, {N // g}) u32-planes", planes(g),
+            khi, klo, tag, vhi, vlo,
+        )
+
+
+if __name__ == "__main__":
+    main()

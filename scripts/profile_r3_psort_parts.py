@@ -1,0 +1,111 @@
+"""Decompose pallas merge-sort cost: run sort | diagonal searches |
+merge kernel per level. Run on the real chip.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_r3_psort_parts.py [tile]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+import distributed_join_tpu  # noqa: F401
+import distributed_join_tpu.ops.sort_pallas as SP
+from distributed_join_tpu.utils.benchmarking import measure_chained
+
+N = 20_000_000
+P = 5
+NK = 3
+
+
+def main():
+    tile = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    rng = np.random.default_rng(0)
+    planes = [
+        jnp.asarray(rng.integers(0, 2**32, size=N, dtype=np.uint32))
+        for _ in range(P)
+    ]
+    jax.block_until_ready(planes)
+
+    n_pad = SP._round_up(N, tile)
+    nruns = n_pad // tile
+
+    def runsort(i, *ps):
+        rs = [
+            jnp.concatenate(
+                [x + i.astype(x.dtype) * 0 + i.astype(x.dtype)
+                 if j == 0 else x,
+                 jnp.full((n_pad - N,), 0xFFFFFFFF, jnp.uint32)]
+            ).reshape(nruns, tile)
+            for j, x in enumerate(ps)
+        ]
+        srt = lax.sort(tuple(rs), dimension=1, num_keys=NK,
+                       is_stable=False)
+        return sum(jnp.sum(c[:, ::1024].astype(jnp.int64)) for c in srt)
+
+    measure_chained(f"run sort ({nruns},{tile}) {P}planes nk{NK}",
+                    runsort, *planes)
+
+    # one merge level at full scale: segments of length L merging
+    # pairwise; splits via the real search; kernel timed separately
+    size = n_pad + 2 * tile
+    full = [
+        jnp.concatenate(
+            [x, jnp.full((size - N,), 0xFFFFFFFF, jnp.uint32)]
+        )
+        for x in planes
+    ]
+    jax.block_until_ready(full)
+
+    L = n_pad // 2  # final-level shape: one giant pair
+    for npair, lenseg in [(n_pad // (2 * tile), tile),
+                          (8, n_pad // 16 // 128 * 128),
+                          (1, L // 128 * 128)]:
+        pa_s = np.arange(npair) * 2 * lenseg
+        ntile_p = 2 * lenseg // tile
+        tpair = np.repeat(np.arange(npair), ntile_p)
+        tloc = np.concatenate([np.arange(ntile_p)] * npair)
+        qd = np.minimum(tloc * tile, 2 * lenseg)
+
+        def search(i, *kps):
+            return jnp.sum(SP._diag_search(
+                [k + i.astype(jnp.uint32) * 0 for k in kps],
+                jnp.asarray(pa_s[tpair] + 0, jnp.int32),
+                jnp.full(len(tpair), lenseg, jnp.int32),
+                jnp.asarray(pa_s[tpair] + lenseg, jnp.int32),
+                jnp.full(len(tpair), lenseg, jnp.int32),
+                jnp.asarray(qd, jnp.int32) + i,
+            ).astype(jnp.int64))
+
+        measure_chained(
+            f"diag search {len(tpair)} queries (m={lenseg})",
+            search, *full[:NK])
+
+    # kernel-only: fixed split arrays (p = tile//2 everywhere — shape
+    # costs are data-independent)
+    ntiles = size // tile
+    a0 = jnp.asarray(
+        np.minimum(np.arange(ntiles) * tile, n_pad), jnp.int32)
+    b0 = jnp.asarray(
+        np.minimum(np.arange(ntiles) * tile + tile // 2, n_pad),
+        jnp.int32)
+    pT = jnp.full((ntiles,), tile // 2, jnp.int32)
+    dirs = jnp.zeros((ntiles,), jnp.int32)
+
+    def level(i, *ps):
+        outs = SP._merge_level(
+            [x + (i.astype(jnp.uint32) if j == 0 else jnp.uint32(0))
+             for j, x in enumerate(ps)],
+            a0, b0, pT, dirs, tile, NK, False)
+        return sum(jnp.sum(c[::1024].astype(jnp.int64)) for c in outs)
+
+    measure_chained(f"merge kernel 1 level ({ntiles} tiles)", level,
+                    *full)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Round-3 sort landscape: what does lax.sort cost as a function of
+key width and value lanes at the bench shape, and how does the full
+kernel-path join decompose today?
+
+The radix-sort decision (VERDICT r2 #1) hinges on these numbers:
+ROOFLINE.md's ~60 ms/sort estimate assumes the sort is ~139 ms of the
+391 ms join. Measure before building.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_r3_sort.py
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import distributed_join_tpu  # noqa: F401
+from distributed_join_tpu.utils.benchmarking import measure_chained
+
+N = 20_000_000
+
+
+def main():
+    key = jax.random.key(0)
+    k64 = jax.random.randint(key, (N,), 0, 2**62, dtype=jnp.int64)
+    k32 = (k64 & 0x7FFFFFFF).astype(jnp.int32)
+    k16 = (k64 & 0x7FFF).astype(jnp.int16)
+    k8 = (k64 & 0x7F).astype(jnp.int8)
+    tag = (k64 & 1).astype(jnp.int8)
+    v64 = k64 + 1
+    jax.block_until_ready((k64, k32, k16, k8, tag, v64))
+
+    def s(ops, nk):
+        def body(i, *a):
+            srt = lax.sort(tuple(c + c.dtype.type(1) * i.astype(c.dtype)
+                                 for c in a), num_keys=nk)
+            return sum(jnp.sum(c[::1024].astype(jnp.int64)) for c in srt)
+        return body
+
+    # The bench merged sort: i64 key + i8 tag + one shared i64 lane
+    measure_chained("sort20M i64key+i8tag+i64val (bench merged sort)",
+                    s(None, 2), k64, tag, v64)
+    measure_chained("sort20M i64 key alone", s(None, 1), k64)
+    measure_chained("sort20M i32 key alone", s(None, 1), k32)
+    measure_chained("sort20M i16 key alone", s(None, 1), k16)
+    measure_chained("sort20M i8 key alone", s(None, 1), k8)
+    measure_chained("sort20M i32key + i64val", s(None, 1), k32, v64)
+    measure_chained("sort20M i16key + i64+i64+i8 vals", s(None, 1),
+                    k16, v64, k64, tag)
+    measure_chained("sort20M i8key + i64+i64+i8 vals", s(None, 1),
+                    k8, v64, k64, tag)
+    # is lax.sort stable-by-construction cost different? (sort is
+    # documented stable when is_stable=True; lax.sort default True)
+
+    # full join at bench shape for the baseline number
+    from distributed_join_tpu.ops.join import sort_merge_inner_join
+    from distributed_join_tpu.utils.benchmarking import consume_all_columns
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+    )
+
+    build, probe = generate_build_probe_tables(
+        seed=42, build_nrows=N // 2, probe_nrows=N // 2, selectivity=0.3)
+    jax.block_until_ready((build.columns, probe.columns))
+
+    def jbody(i, b, p):
+        bt = type(b)(
+            {nm: (c + i.astype(c.dtype) - i.astype(c.dtype)
+                  if nm == "key" else c)
+             for nm, c in b.columns.items()}, b.valid)
+        res = sort_merge_inner_join(bt, p, "key", 7_500_000)
+        return consume_all_columns(res.table) + res.total
+
+    measure_chained("full join 10Mx10M (kernel path)", jbody, build, probe)
+
+
+if __name__ == "__main__":
+    main()

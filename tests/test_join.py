@@ -120,3 +120,13 @@ def test_payload_name_collision_rejected():
     probe = _mk([1], "x")
     with pytest.raises(ValueError, match="collision"):
         sort_merge_inner_join(build, probe, "key", 4)
+
+
+def test_reserved_dunder_names_rejected():
+    # '__'-prefixed user columns would alias the join's internal
+    # record lanes (__S, __key{i}, __lo, ...) and silently corrupt
+    # the output — must raise instead.
+    build = _mk([1], "__lo")
+    probe = _mk([1], "y")
+    with pytest.raises(ValueError, match="reserved"):
+        sort_merge_inner_join(build, probe, "key", 4)

@@ -1,0 +1,167 @@
+"""Unit tests for the Pallas alternating-orientation merge sort
+(ops/sort_pallas.py), run in interpreter mode on the CPU test mesh
+with a small tile so every structural case is cheap: multiple levels,
+ceil (non-power-of-two) merge trees with pass-through segments,
+unequal-length merges, duplicate keys, all-equal keys, sentinel-heavy
+tails, and every dtype codec."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_join_tpu.ops.sort_pallas import (
+    key_to_planes,
+    merge_sort_planes,
+    pallas_merged_sort,
+    planes_to_key,
+    planes_to_val,
+    val_to_planes,
+)
+
+TILE = 1024
+
+
+def ref_sort_planes(planes, nk):
+    srt = lax.sort(tuple(planes), num_keys=nk, is_stable=False)
+    return [np.asarray(x) for x in srt]
+
+
+def sorted_records(planes, nk):
+    """Row multiset as a sorted structured array (order-insensitive
+    compare: ties may be permuted differently than lax.sort)."""
+    arr = np.stack([np.asarray(p) for p in planes], axis=1)
+    idx = np.lexsort([arr[:, j] for j in range(arr.shape[1] - 1, -1, -1)])
+    return arr[idx]
+
+
+@pytest.mark.parametrize("n,rm", [(0, 1), (1, 1), (100, 1), (TILE, 1),
+                                  (TILE + 1, 1), (3 * TILE, 1),
+                                  (4 * TILE, 1), (5 * TILE + 77, 1),
+                                  (8 * TILE - 1, 1),
+                                  (13 * TILE + 1000, 1),
+                                  (9 * TILE + 11, 2),
+                                  (17 * TILE + 3, 4)])
+@pytest.mark.parametrize("nk", [1, 2])
+def test_merge_sort_planes_matches_lax(n, rm, nk):
+    rng = np.random.default_rng(n * 7 + nk)
+    nv = 2
+    planes = [
+        jnp.asarray(
+            rng.integers(0, 50, size=n, dtype=np.uint32)
+            if i < nk else
+            rng.integers(0, 2**32, size=n, dtype=np.uint32)
+        )
+        for i in range(nk + nv)
+    ]
+    got = merge_sort_planes(planes, nk, tile=TILE, run_mult=rm,
+                            interpret=True)
+    # key planes must match the reference sort exactly
+    want = ref_sort_planes(planes, nk)
+    for i in range(nk):
+        np.testing.assert_array_equal(np.asarray(got[i]), want[i])
+    # full records must match as a multiset (ties arbitrary)
+    np.testing.assert_array_equal(
+        sorted_records(got, nk), sorted_records(planes, nk)
+    )
+
+
+def test_wide_key_range():
+    rng = np.random.default_rng(0)
+    n = 6 * TILE + 123
+    planes = [
+        jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32)),
+        jnp.asarray(rng.integers(0, 2**32, size=n, dtype=np.uint32)),
+    ]
+    got = merge_sort_planes(planes, 1, tile=TILE, interpret=True)
+    want = ref_sort_planes(planes, 1)
+    np.testing.assert_array_equal(np.asarray(got[0]), want[0])
+    np.testing.assert_array_equal(
+        sorted_records(got, 1), sorted_records(planes, 1)
+    )
+
+
+def test_all_equal_keys():
+    n = 3 * TILE + 5
+    planes = [
+        jnp.full((n,), 7, jnp.uint32),
+        jnp.asarray(np.arange(n, dtype=np.uint32)),
+    ]
+    got = merge_sort_planes(planes, 1, tile=TILE, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(got[0]), np.full((n,), 7, np.uint32)
+    )
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(got[1])), np.arange(n, dtype=np.uint32)
+    )
+
+
+@pytest.mark.parametrize("dt", [jnp.int64, jnp.uint64, jnp.int32,
+                                jnp.uint32, jnp.int16, jnp.uint16,
+                                jnp.int8, jnp.float32])
+def test_key_codec_roundtrip_and_order(dt):
+    rng = np.random.default_rng(3)
+    if jnp.issubdtype(dt, jnp.integer):
+        info = jnp.iinfo(dt)
+        npdt = np.dtype(info.dtype.name)
+        vals = rng.integers(int(info.min), int(info.max), size=500,
+                            dtype=npdt, endpoint=True)
+        c = jnp.asarray(vals, dt)
+    else:
+        c = jnp.asarray(
+            rng.normal(size=500).astype(np.float32) * 1e3, dt
+        )
+    planes = key_to_planes(c)
+    back = planes_to_key(planes, dt)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(c))
+    # unsigned-lex plane order == dtype order
+    rec = np.stack([np.asarray(p) for p in planes], axis=1)
+    order = np.lexsort(
+        [rec[:, j] for j in range(rec.shape[1] - 1, -1, -1)]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(c)[order], np.sort(np.asarray(c), kind="stable")
+    )
+
+
+@pytest.mark.parametrize("dt", [jnp.int64, jnp.uint64, jnp.int32,
+                                jnp.int8, jnp.float32])
+def test_val_codec_roundtrip(dt):
+    rng = np.random.default_rng(4)
+    if jnp.issubdtype(dt, jnp.integer):
+        info = jnp.iinfo(dt)
+        c = jnp.asarray(
+            rng.integers(int(info.min), int(info.max), size=300,
+                         dtype=np.dtype(info.dtype.name),
+                         endpoint=True), dt)
+    else:
+        c = jnp.asarray(rng.normal(size=300).astype(np.float32), dt)
+    back = planes_to_val(val_to_planes(c), dt)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(c))
+
+
+def test_pallas_merged_sort_drop_in():
+    rng = np.random.default_rng(9)
+    n = 4 * TILE + 321
+    key = jnp.asarray(
+        rng.integers(-1000, 1000, size=n, dtype=np.int64))
+    tag = jnp.asarray(rng.integers(0, 3, size=n, dtype=np.int8))
+    val = jnp.asarray(
+        rng.integers(-2**60, 2**60, size=n, dtype=np.int64))
+    got = pallas_merged_sort((key, tag, val), 2, tile=TILE,
+                             interpret=True)
+    want = lax.sort((key, tag, val), num_keys=2)
+    np.testing.assert_array_equal(np.asarray(got[0]),
+                                  np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]),
+                                  np.asarray(want[1]))
+    # values: multiset equality of whole records
+    gr = np.stack([np.asarray(g) for g in got], 1)
+    wr = np.stack([np.asarray(w) for w in want], 1)
+    gi = np.lexsort([gr[:, 2], gr[:, 1], gr[:, 0]])
+    wi = np.lexsort([wr[:, 2], wr[:, 1], wr[:, 0]])
+    np.testing.assert_array_equal(gr[gi], wr[wi])
