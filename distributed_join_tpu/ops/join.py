@@ -277,12 +277,26 @@ def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
     the window bound holds by construction — unmatched build keys
     never enter the pack; build_windows_ok + lax.cond stay as
     belt-and-braces (the fallback is also exact over the pack)."""
+    import os
+
     from distributed_join_tpu.ops.compact_pallas import stream_compact
+    from distributed_join_tpu.ops.compact_planes import (
+        plane_stream_compact,
+    )
     from distributed_join_tpu.ops.expand_pallas import (
         build_windows_ok,
         expand_gather,
     )
     from distributed_join_tpu.ops.scan_pallas import join_scans
+
+    # log-shift plane compaction (default): measured 54 vs 101 ms for
+    # the 20M->7.5M 4-lane record block on v5e (scripts/
+    # profile_r3_compact.py). DJTPU_COMPACT=mxu restores the one-hot
+    # matmul kernel. The interpreter path keeps the mxu kernel (the
+    # plane kernel's carry chain is exercised by its own test file).
+    if os.environ.get("DJTPU_COMPACT", "plane") == "plane" \
+            and not interpret:
+        stream_compact = plane_stream_compact  # noqa: F811
 
     nb, npr = build.capacity, probe.capacity
     n = nb + npr

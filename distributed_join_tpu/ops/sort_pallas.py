@@ -252,6 +252,18 @@ def _flat_shift(x, delta, rows):
     from jax.experimental.pallas import tpu as pltpu
 
     nr = x.shape[0]
+    if isinstance(delta, int):
+        # static path: multiples of 128 are a single row roll; other
+        # static shifts still save the dynamic-mod arithmetic
+        dl = delta % 128
+        dr = (delta - dl) // 128
+        x2 = pltpu.roll(x, (-dr) % nr, 0) if dr % nr else x
+        if dl == 0:
+            return x2[:rows]
+        rl = pltpu.roll(x2, (-dl) % 128, 1)
+        rup = pltpu.roll(rl, nr - 1, 0)
+        lane = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        return jnp.where(lane + dl >= 128, rup, rl)[:rows]
     dl = jnp.mod(delta, 128)           # in [0, 128)
     dr = (delta - dl) // 128           # signed row part
     # row part: x2[r] = x[r + dr]

@@ -1,0 +1,72 @@
+"""plane_stream_compact (log-shift) vs stream_compact (one-hot MXU)
+at the bench join's two compaction shapes.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_r3_compact.py [block]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import distributed_join_tpu  # noqa: F401
+from distributed_join_tpu.ops.compact_pallas import stream_compact
+from distributed_join_tpu.ops.compact_planes import plane_stream_compact
+from distributed_join_tpu.utils.benchmarking import measure_chained
+
+N = 20_000_000
+
+
+def bench(name, fn, k, capacity, density):
+    rng = np.random.default_rng(1)
+    mask = jnp.asarray(rng.random(N) < density)
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    cols = [
+        jnp.asarray(rng.integers(0, 1 << 63, size=(N,),
+                                 dtype=np.uint64))
+        for _ in range(k)
+    ]
+    jax.block_until_ready((mask, pos, cols))
+
+    def body(i, m, p, *cs):
+        outs = fn(m, p,
+                  [c + i.astype(jnp.uint64) for c in cs], capacity)
+        return sum(jnp.sum(c[::1024].astype(jnp.int64)) for c in outs)
+
+    return measure_chained(name, body, mask, pos, *cols)
+
+
+def main():
+    block = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+
+    def planecp(m, p, cs, cap):
+        return plane_stream_compact(m, p, cs, cap, block=block)
+
+    # correctness spot check at scale on TPU
+    rng = np.random.default_rng(7)
+    n = 3_000_000
+    mask = jnp.asarray(rng.random(n) < 0.4)
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    c = jnp.asarray(rng.integers(0, 1 << 63, size=(n,), dtype=np.uint64))
+    cap = int(jnp.sum(mask.astype(jnp.int32)))
+    got = jax.jit(lambda m, p, c: plane_stream_compact(
+        m, p, [c], cap, block=block))(mask, pos, c)[0]
+    want = np.asarray(c)[np.asarray(mask)][:cap]
+    assert np.array_equal(np.asarray(got)[:cap], want), "mismatch"
+    print(f"correctness ok (block={block})")
+
+    bench(f"plane compact 20M->7.5M k=4 (block={block})", planecp,
+          4, 7_500_000, 0.35)
+    bench("mxu   compact 20M->7.5M k=4", stream_compact,
+          4, 7_500_000, 0.35)
+    bench(f"plane compact 20M->10M k=1 (block={block})", planecp,
+          1, 10_000_000, 0.5)
+    bench("mxu   compact 20M->10M k=1", stream_compact,
+          1, 10_000_000, 0.5)
+
+
+if __name__ == "__main__":
+    main()
