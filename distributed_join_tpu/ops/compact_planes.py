@@ -40,7 +40,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from distributed_join_tpu.ops.sort_pallas import _flat_shift, _round_up
+from distributed_join_tpu.ops.sort_pallas import (
+    _flat_shift,
+    _round_up,
+    merge_u64,
+    split_u64,
+)
 
 
 def _compact_kernel(offs_ref, *refs, block: int, nplanes: int):
@@ -56,7 +61,6 @@ def _compact_kernel(offs_ref, *refs, block: int, nplanes: int):
     nt = pl.num_programs(0)
     slot = t % 2
     off = offs_ref[t]
-    off_next = offs_ref[t + 1]
     base8 = (off // 1024) * 8
     q = off - base8 * 128
 
@@ -118,13 +122,17 @@ def _compact_kernel(offs_ref, *refs, block: int, nplanes: int):
         # the previous step's out-DMA (lagged one step for overlap)
         # must land before this step's overlapping window starts
         pltpu.make_async_copy(
-            stage.at[1 - slot],
+            stage.at[1 - slot, pl.ds(2, nplanes)],
             out_ref.at[:, pl.ds(prev_base8, RS), :],
             sem.at[1 - slot],
         ).wait()
 
+    # only the value planes go to HBM: the alive/d planes (0-1) exist
+    # for the shift network and the carry chain, and writing them
+    # would be 2/(P+2) dead output bandwidth
     cp = pltpu.make_async_copy(
-        stage.at[slot], out_ref.at[:, pl.ds(base8, RS), :],
+        stage.at[slot, pl.ds(2, nplanes)],
+        out_ref.at[:, pl.ds(base8, RS), :],
         sem.at[slot],
     )
     cp.start()
@@ -132,8 +140,6 @@ def _compact_kernel(offs_ref, *refs, block: int, nplanes: int):
     @pl.when(t == nt - 1)
     def _():
         cp.wait()
-    # silence unused warning
-    del off_next
 
 
 def plane_compact_stacked(stacked: jax.Array, mask: jax.Array,
@@ -200,9 +206,9 @@ def plane_compact_stacked(stacked: jax.Array, mask: jax.Array,
     out_rows = _round_up(capacity, 1024) // 128 + RS + 8
     vma = getattr(jax.typeof(ins3d), "vma", None)
     out_sds = (
-        jax.ShapeDtypeStruct((P2, out_rows, 128), jnp.uint32, vma=vma)
+        jax.ShapeDtypeStruct((P, out_rows, 128), jnp.uint32, vma=vma)
         if vma is not None else
-        jax.ShapeDtypeStruct((P2, out_rows, 128), jnp.uint32)
+        jax.ShapeDtypeStruct((P, out_rows, 128), jnp.uint32)
     )
     with jax.enable_x64(False):
         out = pl.pallas_call(
@@ -222,7 +228,7 @@ def plane_compact_stacked(stacked: jax.Array, mask: jax.Array,
             ],
             interpret=interpret,
         )(offs, ins3d)
-    return out.reshape(P2, -1)[2:, :capacity]
+    return out.reshape(P, -1)[:, :capacity]
 
 
 def plane_stream_compact(mask, pos, cols, capacity: int,
@@ -231,17 +237,13 @@ def plane_stream_compact(mask, pos, cols, capacity: int,
     in, uint64 columns (length ``capacity``) out."""
     planes = []
     for c in cols:
-        u = c.astype(jnp.uint64)
-        planes.append((u >> jnp.uint64(32)).astype(jnp.uint32))
-        planes.append(u.astype(jnp.uint32))
+        planes.extend(split_u64(c))
     stacked = jnp.stack(planes)
     outp = plane_compact_stacked(
         stacked, mask, pos.astype(jnp.int32), capacity,
         block=block, interpret=interpret,
     )
-    outs = []
-    for i in range(len(cols)):
-        hi = outp[2 * i].astype(jnp.uint64)
-        lo = outp[2 * i + 1].astype(jnp.uint64)
-        outs.append((hi << jnp.uint64(32)) | lo)
-    return outs
+    return [
+        merge_u64(outp[2 * i], outp[2 * i + 1])
+        for i in range(len(cols))
+    ]

@@ -292,10 +292,19 @@ def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
     # log-shift plane compaction (default): measured 54 vs 101 ms for
     # the 20M->7.5M 4-lane record block on v5e (scripts/
     # profile_r3_compact.py). DJTPU_COMPACT=mxu restores the one-hot
-    # matmul kernel. The interpreter path keeps the mxu kernel (the
-    # plane kernel's carry chain is exercised by its own test file).
-    if os.environ.get("DJTPU_COMPACT", "plane") == "plane" \
-            and not interpret:
+    # matmul kernel. Read at TRACE time (like DJTPU_PALLAS_EXPAND):
+    # flipping it after a shape is jit-cached has no effect on that
+    # shape. Default under the interpreter stays mxu; an explicit
+    # DJTPU_COMPACT=plane forces the plane kernel there too so the
+    # join<->plane contract is CPU-testable.
+    compact_env = os.environ.get("DJTPU_COMPACT", "plane")
+    if compact_env not in ("plane", "mxu"):
+        raise ValueError(
+            f"DJTPU_COMPACT={compact_env!r}: expected 'plane' or 'mxu'"
+        )
+    if compact_env == "plane" and (
+        not interpret or "DJTPU_COMPACT" in os.environ
+    ):
         stream_compact = plane_stream_compact  # noqa: F811
 
     nb, npr = build.capacity, probe.capacity

@@ -67,6 +67,17 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def split_u64(c: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(hi, lo) u32 planes of a uint64-convertible column."""
+    u = c.astype(jnp.uint64)
+    return (u >> jnp.uint64(32)).astype(jnp.uint32), u.astype(jnp.uint32)
+
+
+def merge_u64(hi: jax.Array, lo: jax.Array) -> jax.Array:
+    return (hi.astype(jnp.uint64) << jnp.uint64(32)) | \
+        lo.astype(jnp.uint64)
+
+
 # ---------------------------------------------------------------------------
 # dtype <-> u32 plane codecs
 
@@ -236,8 +247,13 @@ def _diag_search(stacked, nk, qa0, qla, qb0, qlb, qd,
         hi2 = jnp.where(active & ~P, mid, hi)
         return lo2, hi2
 
-    lo, hi = lax.fori_loop(0, iters, body, (lo, hi))
-    return lo
+    # Unrolled on purpose: a lax.fori_loop pays ~100s of us of
+    # per-iteration device-loop overhead on this toolchain, which at
+    # 32 iterations x O(10) levels dwarfed the actual gather work.
+    lohi = (lo, hi)
+    for _ in range(iters):
+        lohi = body(None, lohi)
+    return lohi[0]
 
 
 # ---------------------------------------------------------------------------
@@ -295,9 +311,9 @@ def _merge_tile_kernel(abase_ref, aoff_ref, bbase_ref, boff_ref,
     in_ref, out_ref, scrA, scrB, sem = refs
 
     t = pl.program_id(0)
-    abase = abase_ref[t]          # 8-aligned row base (clamped)
+    nt = pl.num_programs(0)
+    slot = t % 2
     aoff = aoff_ref[t]            # a0 - abase*128
-    bbase = bbase_ref[t]
     boff = boff_ref[t]
     p = p_ref[t]
     dirb = dir_ref[t] != 0
@@ -306,17 +322,35 @@ def _merge_tile_kernel(abase_ref, aoff_ref, bbase_ref, boff_ref,
     # (unaligned ones fault); the residue rides the in-VMEM flat
     # shift, whose row roll wraps modulo the window so any in-window
     # distance is reachable. The planes travel as ONE stacked
-    # (P, rows, 128) array: per-tile DMA count is 2, not 2P (DMA
-    # issue overhead dominated the per-plane layout — measured
-    # 10.7 ms/level for bare copies at P=5).
-    ca = pltpu.make_async_copy(
-        in_ref.at[:, pl.ds(abase, RA), :], scrA, sem.at[0]
-    )
-    cb = pltpu.make_async_copy(
-        in_ref.at[:, pl.ds(bbase, RA), :], scrB, sem.at[1]
-    )
-    ca.start()
-    cb.start()
+    # (P, rows, 128) array (2 DMAs per tile, not 2P), and the windows
+    # are DOUBLE-BUFFERED: tile t+1's copies are issued before tile
+    # t's compute so the per-tile DMA wait overlaps (the synchronous
+    # wait was most of the ~20 us/tile overhead, as in
+    # ops/compact_planes.py).
+    def copies(tt, sl):
+        ca = pltpu.make_async_copy(
+            in_ref.at[:, pl.ds(abase_ref[tt], RA), :], scrA.at[sl],
+            sem.at[sl, 0],
+        )
+        cb = pltpu.make_async_copy(
+            in_ref.at[:, pl.ds(bbase_ref[tt], RA), :], scrB.at[sl],
+            sem.at[sl, 1],
+        )
+        return ca, cb
+
+    @pl.when(t == 0)
+    def _():
+        ca, cb = copies(0, 0)
+        ca.start()
+        cb.start()
+
+    @pl.when(t + 1 < nt)
+    def _():
+        ca, cb = copies(t + 1, (t + 1) % 2)
+        ca.start()
+        cb.start()
+
+    ca, cb = copies(t, slot)
     ca.wait()
     cb.wait()
 
@@ -328,8 +362,8 @@ def _merge_tile_kernel(abase_ref, aoff_ref, bbase_ref, boff_ref,
     from_a = flat < p
     planes = []
     for i in range(P):
-        ya = _flat_shift(scrA[i], aoff, R)
-        yb = _flat_shift(scrB[i], delta_b, R)
+        ya = _flat_shift(scrA[slot, i], aoff, R)
+        yb = _flat_shift(scrB[slot, i], delta_b, R)
         planes.append(jnp.where(from_a, ya, yb))
 
     # XOR-partner compare-exchange network, log2(tile) stages
@@ -427,9 +461,9 @@ def _merge_level(stacked, a0, b0, p, dirs,
             out_specs=out_specs,
             out_shape=sds((P, ntiles * R, 128), jnp.uint32),
             scratch_shapes=[
-                pltpu.VMEM((P, RA, 128), jnp.uint32),
-                pltpu.VMEM((P, RA, 128), jnp.uint32),
-                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.VMEM((2, P, RA, 128), jnp.uint32),
+                pltpu.VMEM((2, P, RA, 128), jnp.uint32),
+                pltpu.SemaphoreType.DMA((2, 2)),
             ],
             interpret=interpret,
         )(abase, aoff, bbase, boff, p, dirs, ins3d)

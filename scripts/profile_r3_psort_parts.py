@@ -73,7 +73,8 @@ def main():
 
         def search(i, *kps):
             return jnp.sum(SP._diag_search(
-                [k + i.astype(jnp.uint32) * 0 for k in kps],
+                jnp.stack([k + i.astype(jnp.uint32) * 0 for k in kps]),
+                NK,
                 jnp.asarray(pa_s[tpair] + 0, jnp.int32),
                 jnp.full(len(tpair), lenseg, jnp.int32),
                 jnp.asarray(pa_s[tpair] + lenseg, jnp.int32),
@@ -98,10 +99,11 @@ def main():
 
     def level(i, *ps):
         outs = SP._merge_level(
-            [x + (i.astype(jnp.uint32) if j == 0 else jnp.uint32(0))
-             for j, x in enumerate(ps)],
+            jnp.stack([
+                x + (i.astype(jnp.uint32) if j == 0 else jnp.uint32(0))
+                for j, x in enumerate(ps)]),
             a0, b0, pT, dirs, tile, NK, False)
-        return sum(jnp.sum(c[::1024].astype(jnp.int64)) for c in outs)
+        return jnp.sum(outs[:, ::1024].astype(jnp.int64))
 
     measure_chained(f"merge kernel 1 level ({ntiles} tiles)", level,
                     *full)

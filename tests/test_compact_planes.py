@@ -49,6 +49,36 @@ def test_plane_compact_matches_reference(n, density, capacity):
         )
 
 
+def test_join_kernel_path_with_plane_compact(monkeypatch):
+    """CPU-runnable integration of the join's kernel path with the
+    plane compaction (the production default on TPU): interpret mode,
+    forced via DJTPU_COMPACT=plane + DJTPU_PALLAS_EXPAND=1."""
+    import pandas as pd
+
+    from distributed_join_tpu.ops.join import sort_merge_inner_join
+    from distributed_join_tpu.table import Table
+
+    monkeypatch.setenv("DJTPU_PALLAS_EXPAND", "1")
+    monkeypatch.setenv("DJTPU_COMPACT", "plane")
+    rng = np.random.default_rng(17)
+    n = 6000
+    b = Table({"key": jnp.asarray(rng.integers(0, 800, n)),
+               "bv": jnp.asarray(rng.integers(0, 1 << 40, n))},
+              jnp.ones(n, bool))
+    p = Table({"key": jnp.asarray(rng.integers(0, 800, n)),
+               "pv": jnp.asarray(rng.integers(0, 1 << 40, n))},
+              jnp.ones(n, bool))
+    want = b.to_pandas().merge(p.to_pandas(), on="key")
+    res = sort_merge_inner_join(b, p, "key", 2 * len(want))
+    assert int(res.total) == len(want)
+    gt = res.table.to_pandas()
+    cols = list(gt.columns)
+    pd.testing.assert_frame_equal(
+        gt.sort_values(cols).reset_index(drop=True),
+        want[cols].sort_values(cols).reset_index(drop=True),
+    )
+
+
 def test_plane_compact_carry_alignments():
     """Survivor counts crafted so block output offsets hit q = 0,
     1023, 1024 transitions around the 1024-element aligned windows."""
