@@ -65,7 +65,7 @@ def parse_args(argv=None):
     p.add_argument("--duplicate-build-keys", action="store_true",
                    help="draw build keys with replacement (default: unique)")
     p.add_argument("--over-decomposition-factor", type=int, default=1)
-    p.add_argument("--shuffle", choices=["padded", "ragged"],
+    p.add_argument("--shuffle", choices=["padded", "ragged", "ppermute"],
                    default="padded",
                    help="ragged = exact-size lax.ragged_all_to_all "
                         "exchange (no pad bytes on the wire)")

@@ -48,7 +48,7 @@ from distributed_join_tpu.ops.sort_pallas import (
 )
 
 
-def _compact_kernel(offs_ref, *refs, block: int, nplanes: int):
+def _compact_kernel(base8_ref, q_ref, *refs, block: int, nplanes: int):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -60,9 +60,11 @@ def _compact_kernel(offs_ref, *refs, block: int, nplanes: int):
     t = pl.program_id(0)
     nt = pl.num_programs(0)
     slot = t % 2
-    off = offs_ref[t]
-    base8 = (off // 1024) * 8
-    q = off - base8 * 128
+    # base8/q are precomputed OUTSIDE: floor-divides on SMEM-read
+    # scalars insert `pvary` under shard_map tracing, which Mosaic
+    # cannot lower.
+    base8 = base8_ref[t]
+    q = q_ref[t]
 
     data = in_ref[...]             # (P2, RB, 128) auto-pipelined block
     alive = data[0]
@@ -102,7 +104,7 @@ def _compact_kernel(offs_ref, *refs, block: int, nplanes: int):
     slane_i = lax.broadcasted_iota(jnp.int32, (RS, 128), 1)
     sflat = srow_i * 128 + slane_i
 
-    prev_base8 = (offs_ref[jnp.maximum(t - 1, 0)] // 1024) * 8
+    prev_base8 = base8_ref[jnp.maximum(t - 1, 0)]
     carry_row = base8 - prev_base8       # within prev stage (RS rows)
 
     for i in range(P2):
@@ -179,6 +181,8 @@ def plane_compact_stacked(stacked: jax.Array, mask: jax.Array,
         [jnp.zeros((1,), jnp.int32),
          jnp.cumsum(counts, dtype=jnp.int32)]
     )                                               # (nblocks+1,)
+    base8s = (offs[:-1] // 1024) * 8
+    qs = offs[:-1] - base8s * 128
     # broadcast+reshape, NOT jnp.repeat: repeat of a traced vector can
     # lower to a TPU gather (~21 ns/element — catastrophic at 20M)
     offs_bcast = jnp.broadcast_to(
@@ -218,6 +222,7 @@ def plane_compact_stacked(stacked: jax.Array, mask: jax.Array,
             grid=(nblocks,),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec((P2, RB, 128), lambda t: (0, t, 0)),
             ],
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
@@ -227,7 +232,7 @@ def plane_compact_stacked(stacked: jax.Array, mask: jax.Array,
                 pltpu.SemaphoreType.DMA((2,)),
             ],
             interpret=interpret,
-        )(offs, ins3d)
+        )(base8s, qs, ins3d)
     return out.reshape(P, -1)[:, :capacity]
 
 

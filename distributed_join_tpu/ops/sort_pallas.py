@@ -280,12 +280,18 @@ def _flat_shift(x, delta, rows):
         rup = pltpu.roll(rl, nr - 1, 0)
         lane = lax.broadcasted_iota(jnp.int32, x.shape, 1)
         return jnp.where(lane + dl >= 128, rup, rl)[:rows]
-    dl = jnp.mod(delta, 128)           # in [0, 128)
-    dr = (delta - dl) // 128           # signed row part
-    # row part: x2[r] = x[r + dr]
-    x2 = pltpu.roll(x, jnp.mod(-dr, nr), 0)
+    # Bitwise/single-primitive arithmetic only: composite jnp ops
+    # (floor_divide, mod) on scalars derived from SMEM reads insert
+    # `pvary` under shard_map tracing, which Mosaic cannot lower
+    # (found by the chipless v5e:2x4 AOT compile). x & 127 == x mod
+    # 128 for any two's-complement int; >> is an arithmetic shift.
+    dl = delta & 127                   # in [0, 128)
+    dr = (delta - dl) >> 7             # signed row part
+    # row part: x2[r] = x[r + dr]; (-dr) mod nr via one lax.rem on a
+    # non-negative operand (dr in (-nr, nr))
+    x2 = pltpu.roll(x, lax.rem(2 * nr - dr, nr), 0)
     # lane part: y[f] = x2[f + dl], dl in [0, 128)
-    rl = pltpu.roll(x2, jnp.mod(-dl, 128), 1)   # rl[r,c]=x2[r,(c+dl)%128]
+    rl = pltpu.roll(x2, (128 - dl) & 127, 1)    # rl[r,c]=x2[r,(c+dl)%128]
     rup = pltpu.roll(rl, nr - 1, 0)             # rl[r+1, .]
     lane = lax.broadcasted_iota(jnp.int32, x.shape, 1)
     y = jnp.where(lane + dl >= 128, rup, rl)

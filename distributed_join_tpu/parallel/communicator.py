@@ -62,6 +62,13 @@ class Communicator(abc.ABC):
         concatenates the blocks received from every rank in rank order.
         Must be called inside :meth:`spmd`. Shape-preserving."""
 
+    def ppermute_all_to_all(self, x: jax.Array) -> jax.Array:
+        """:meth:`all_to_all` semantics with an async-schedulable
+        lowering where the backend has one (TpuCommunicator chains
+        collective-permutes, docs/OVERLAP.md); the default — plain
+        all_to_all — is always semantically correct."""
+        return self.all_to_all(x)
+
     @abc.abstractmethod
     def all_gather(self, x: jax.Array) -> jax.Array:
         """Concatenate every rank's ``x`` along axis 0 (rank order),
@@ -162,6 +169,41 @@ class TpuCommunicator(Communicator):
 
     def all_gather(self, x: jax.Array) -> jax.Array:
         return lax.all_gather(x, self.axis_name, axis=0, tiled=True)
+
+    def ppermute_all_to_all(self, x: jax.Array) -> jax.Array:
+        """``all_to_all`` semantics via a chain of n-1
+        ``collective-permute`` steps (plus the local block).
+
+        Same result as :meth:`all_to_all` for x of shape (n, ...);
+        the point is the LOWERING: round 2 measured that grouped
+        ``all-to-all`` HLO is emitted synchronously on this toolchain
+        (zero async pairs, docs/OVERLAP.md), while collective-permute
+        lowers as start/done pairs that XLA's latency-hiding
+        scheduler can interleave with unrelated compute — the
+        reference's stream-pipelined shuffle (SURVEY.md §2
+        "Over-decomposition") expressed in XLA terms.
+
+        Step d: every rank s sends block x[(s+d) % n] to rank
+        (s+d) % n, so this rank (r) receives sender (r-d) % n's
+        block. The d-ordered stack is sender-rotated; one reversed
+        dynamic roll restores sender order.
+        """
+        n = self.n_ranks
+        r = self.axis_index()
+        parts = []
+        for d in range(n):
+            piece = lax.dynamic_index_in_dim(
+                x, (r + jnp.int32(d)) % n, axis=0, keepdims=False
+            )
+            if d:
+                piece = lax.ppermute(
+                    piece, self.axis_name,
+                    perm=[(s, (s + d) % n) for s in range(n)],
+                )
+            parts.append(piece)
+        stacked = jnp.stack(parts)      # index d = sender (r-d) % n
+        # out[s] = stacked[(r-s) % n]: reverse then roll by r+1
+        return jnp.roll(stacked[::-1], r + 1, axis=0)
 
     def axis_index(self):
         return lax.axis_index(self.axis_name)

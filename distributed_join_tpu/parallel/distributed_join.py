@@ -67,7 +67,10 @@ def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
     padded, counts, overflow, _ = pt.to_padded(
         capacity, bucket_start=batch * n_ranks, n_buckets=n_ranks
     )
-    table, _ = shuffle_padded(comm, padded, counts, capacity)
+    table, _ = shuffle_padded(
+        comm, padded, counts, capacity,
+        via="ppermute" if mode == "ppermute" else "all_to_all",
+    )
     return table, overflow
 
 
@@ -132,7 +135,7 @@ def make_join_step(
     k = over_decomposition
     if k < 1:
         raise ValueError("over_decomposition must be >= 1")
-    if shuffle not in ("padded", "ragged"):
+    if shuffle not in ("padded", "ragged", "ppermute"):
         # Validate for EVERY config — the single-rank path never
         # reaches the shuffle, and a typo'd mode must not silently
         # report success.

@@ -44,12 +44,21 @@ from distributed_join_tpu.table import Table
 
 
 def shuffle_padded(
-    comm: Communicator, padded_columns, counts: jax.Array, capacity: int
+    comm: Communicator, padded_columns, counts: jax.Array, capacity: int,
+    via: str = "all_to_all",
 ) -> Tuple[Table, jax.Array]:
     """Shuffle a pre-padded (n_ranks, capacity) block; returns the
-    received rows as a masked Table plus the received counts."""
+    received rows as a masked Table plus the received counts.
+
+    ``via='ppermute'`` moves the data blocks over a chain of
+    collective-permutes instead of one grouped all-to-all — same
+    bytes and result, but an async-schedulable lowering (see
+    Communicator.ppermute_all_to_all / docs/OVERLAP.md)."""
+    a2a = (
+        comm.ppermute_all_to_all if via == "ppermute" else comm.all_to_all
+    )
     recv_counts = comm.all_to_all(counts)
-    recv_cols = {n: comm.all_to_all(c) for n, c in padded_columns.items()}
+    recv_cols = {n: a2a(c) for n, c in padded_columns.items()}
     return unpad(recv_cols, recv_counts, capacity), recv_counts
 
 

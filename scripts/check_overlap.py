@@ -36,13 +36,22 @@ def analyze_schedule(hlo: str) -> dict:
     starts: dict[str, int] = {}
     gaps = []
     n_async = 0
+    n_sync_a2a = 0
     for i, ln in enumerate(lines):
-        m = re.match(r"%?([\w.-]+) = .*(all-to-all|all-gather)-start", ln)
+        # require "-start(" so a done line's operand name (which
+        # contains "...-start.N") is not misread as a start op
+        m = re.match(
+            r"%?([\w.-]+) = .*"
+            r"(all-to-all|all-gather|collective-permute)-start\(", ln)
         if m:
             starts[m.group(1)] = i
             n_async += 1
             continue
-        m = re.search(r"(all-to-all|all-gather)-done\(%?([\w.-]+)\)", ln)
+        if re.search(r"= \S* all-to-all\(", ln):
+            n_sync_a2a += 1
+        m = re.search(
+            r"(all-to-all|all-gather|collective-permute)-done"
+            r"\(%?([\w.-]+)\)", ln)
         if m and m.group(2) in starts:
             # real ops between start and done, excluding trivial ones
             between = [
@@ -53,9 +62,64 @@ def analyze_schedule(hlo: str) -> dict:
             gaps.append(len(between))
     return {
         "async_collective_pairs": n_async,
+        "sync_all_to_all_ops": n_sync_a2a,
         "ops_between_start_done": gaps,
         "overlapped": bool(gaps) and max(gaps) > 0,
     }
+
+
+def aot_tpu_main(args):
+    """AOT-compile the full 8-rank join for a chipless v5e:2x4
+    topology and compare the padded (grouped all-to-all) vs ppermute
+    (collective-permute chain) shuffle schedules. Writes
+    results/overlap_hlo_tpu_ppermute.json."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distributed_join_tpu.parallel.communicator import TpuCommunicator
+    from distributed_join_tpu.parallel.distributed_join import (
+        make_distributed_join,
+    )
+    from distributed_join_tpu.table import Table
+
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name="v5e:2x4"
+    )
+    mesh = Mesh(np.array(topo.devices).reshape(8), ("ranks",))
+    comm = TpuCommunicator(mesh=mesh)
+    rows = args.rows_per_rank * 8
+    sh = NamedSharding(mesh, P("ranks"))
+
+    def tbl(payload):
+        return Table(
+            {"key": jax.ShapeDtypeStruct((rows,), jnp.int64, sharding=sh),
+             payload: jax.ShapeDtypeStruct((rows,), jnp.int64,
+                                           sharding=sh)},
+            jax.ShapeDtypeStruct((rows,), jnp.bool_, sharding=sh),
+        )
+
+    report = {
+        "topology": "v5e:2x4 (8 devices), chipless AOT",
+        "over_decomposition": 2,
+        "modes": {},
+    }
+    for mode in ("padded", "ppermute"):
+        fn = make_distributed_join(
+            comm, key="key", over_decomposition=2,
+            out_capacity_factor=3.0, shuffle=mode,
+        )
+        hlo = fn.lower(tbl("build_payload"), tbl("probe_payload")).compile().as_text()
+        sched = analyze_schedule(hlo)
+        sched["total_hlo_lines"] = len(hlo.splitlines())
+        report["modes"][mode] = sched
+        print(mode, json.dumps(sched))
+    with open("results/overlap_hlo_tpu_ppermute.json", "w") as f:
+        json.dump(report, f, indent=2)
+    return report
 
 
 def main():
@@ -63,8 +127,13 @@ def main():
     p.add_argument("--n-ranks", type=int, default=8)
     p.add_argument("--rows-per-rank", type=int, default=65536)
     p.add_argument("--skip-timed", action="store_true")
+    p.add_argument("--aot-tpu", action="store_true",
+                   help="chipless v5e:2x4 AOT schedule comparison")
     add_platform_arg(p)
     args = p.parse_args()
+    if args.aot_tpu:
+        aot_tpu_main(args)
+        return
     apply_platform(args.platform, args.n_ranks)
 
     import jax

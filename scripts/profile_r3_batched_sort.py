@@ -14,8 +14,6 @@ from jax import lax
 import distributed_join_tpu  # noqa: F401
 from distributed_join_tpu.utils.benchmarking import measure_chained
 
-N = 20_971_520  # 20M rounded to nice powers: 2**21 * 10? -> use 2**24*1.25
-# use exactly 2**24 = 16.7M plus... keep it simple: 2**24
 N = 2 ** 24
 
 

@@ -114,6 +114,29 @@ def test_distributed_join_over_decomposition(comm8):
     )
 
 
+def test_distributed_join_ppermute_shuffle(comm8):
+    # the collective-permute-chained shuffle must be bit-equivalent to
+    # the grouped all-to-all (same blocks, async-schedulable lowering)
+    build, probe = generate_build_probe_tables(
+        seed=21, build_nrows=4096, probe_nrows=8192, rand_max=2048,
+        selectivity=0.5,
+    )
+    _run_and_check(
+        build, probe, comm8, shuffle="ppermute", out_capacity_factor=3.0
+    )
+
+
+def test_distributed_join_ppermute_over_decomposition(comm8):
+    build, probe = generate_build_probe_tables(
+        seed=22, build_nrows=4096, probe_nrows=4096, rand_max=4096,
+        selectivity=0.7,
+    )
+    _run_and_check(
+        build, probe, comm8, shuffle="ppermute", over_decomposition=2,
+        out_capacity_factor=3.0,
+    )
+
+
 def test_distributed_join_uneven_input_padding(comm8):
     # capacity not divisible by 8 exercises the pad_div path
     build, probe = generate_build_probe_tables(

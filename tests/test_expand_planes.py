@@ -11,7 +11,7 @@ I32_MAX = 2**31 - 1
 BLOCK = 2048
 
 
-def make_runs(rng, n_real, out_cap, max_run, dup_lo_every=0):
+def make_runs(rng, n_real, max_run, dup_lo_every=0):
     """Random run structure: records with strictly increasing starts
     S (first at 0), matched-rank lo with delta-rank <= 1/slot."""
     cnts = rng.integers(1, max_run + 1, size=n_real)
@@ -59,7 +59,7 @@ def reference(S, lo, cols, out_cap, build_cols=None):
 ])
 def test_expand_pull_with_build(n_real, max_run, dup):
     rng = np.random.default_rng(n_real + max_run)
-    S, lo, cnts, nb = make_runs(rng, n_real, 0, max_run, dup)
+    S, lo, cnts, nb = make_runs(rng, n_real, max_run, dup)
     out_cap = int(S[-1] + cnts[-1])
     m_pad = n_real + 37
     S_p = np.concatenate([S, np.full(37, I32_MAX, np.int32)])
@@ -80,7 +80,7 @@ def test_expand_pull_with_build(n_real, max_run, dup):
 
 def test_expand_pull_no_build():
     rng = np.random.default_rng(0)
-    S, lo, cnts, nb = make_runs(rng, 900, 0, 11)
+    S, lo, cnts, nb = make_runs(rng, 900, 11)
     out_cap = int(S[-1] + cnts[-1]) + 100   # tail beyond last run
     S_p = np.concatenate([S, np.full(11, I32_MAX, np.int32)])
     cols = [
