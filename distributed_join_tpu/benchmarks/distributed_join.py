@@ -84,6 +84,14 @@ def parse_args(argv=None):
     p.add_argument("--iterations", type=int, default=4,
                    help="timed join steps chained in one compiled loop")
     p.add_argument("--shuffle-capacity-factor", type=float, default=1.6)
+    p.add_argument("--expand-kernel", choices=["auto", "pallas", "xla"],
+                   default=None,
+                   help="join expand kernel path (default: env/auto)")
+    p.add_argument("--compact-kernel", choices=["plane", "mxu"],
+                   default=None,
+                   help="join compaction kernel (default: env/plane)")
+    p.add_argument("--kernel-block", type=int, default=None,
+                   help="Pallas expand block size override")
     p.add_argument("--out-capacity-factor", type=float, default=1.2)
     p.add_argument("--zipf-alpha", type=float, default=None,
                    help="draw probe keys Zipf(alpha) instead of the "
@@ -175,6 +183,7 @@ def run(args) -> dict:
         comm,
         key=join_key,
         shuffle=args.shuffle,
+        kernel_config=_kernel_config_from_args(args),
         over_decomposition=args.over_decomposition_factor,
         shuffle_capacity_factor=args.shuffle_capacity_factor,
         out_capacity_factor=args.out_capacity_factor,
@@ -201,6 +210,9 @@ def run(args) -> dict:
         "selectivity": args.selectivity,
         "over_decomposition_factor": args.over_decomposition_factor,
         "shuffle": args.shuffle,
+        "expand_kernel": args.expand_kernel,
+        "compact_kernel": args.compact_kernel,
+        "kernel_block": args.kernel_block,
         "zipf_alpha": args.zipf_alpha,
         "skew_threshold": args.skew_threshold,
         "key_columns": args.key_columns,
@@ -219,6 +231,25 @@ def run(args) -> dict:
         record, args.json_output,
     )
     return record
+
+
+def _kernel_config_from_args(args):
+    """None unless a kernel flag was given (env fallbacks then apply)."""
+    if not (args.expand_kernel or args.compact_kernel
+            or args.kernel_block):
+        return None
+    import dataclasses
+
+    from distributed_join_tpu.ops.kernel_config import KernelConfig
+
+    overrides = {
+        k: v for k, v in (
+            ("expand", args.expand_kernel),
+            ("compact", args.compact_kernel),
+            ("block", args.kernel_block),
+        ) if v
+    }
+    return dataclasses.replace(KernelConfig.from_env(), **overrides)
 
 
 def main(argv=None):

@@ -74,6 +74,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from distributed_join_tpu.ops.kernel_config import (
+    KernelConfig,
+    resolve as resolve_kernel_config,
+)
 from distributed_join_tpu.table import Table
 
 
@@ -141,7 +145,7 @@ def _from_u64_lane(c64: jax.Array, dt):
     raise TypeError(dt)
 
 
-def _expand_records(S, recs: dict, out_capacity: int, j):
+def _expand_records(S, recs: dict, out_capacity: int, j, cfg):
     """Broadcast each record's values down its output run (the XLA
     join path's expansion; the kernel pipeline's lives in
     _join_kernel_path with the fused build-side materialization).
@@ -154,30 +158,21 @@ def _expand_records(S, recs: dict, out_capacity: int, j):
     slot its record index; packed row-gathers per dtype group pull the
     values; start_b is a second cummax over the raw marks.
 
-    Pallas record-expand (TPU; DJTPU_PALLAS_EXPAND=0 disables, =1
-    forces it through the interpreter elsewhere; non-f64 columns only)
+    The Pallas record-expand (``cfg.expand``; non-f64 columns only)
     replaces all three with the streaming one-hot-matmul kernel of
     ops/expand_pallas.py. This path is reached on TPU only when
     _kernel_path_ok rejected the full pipeline (f64 columns route to
     the scatter below instead; oversized blocks still benefit here).
     """
-    import os
-
-    env = os.environ.get("DJTPU_PALLAS_EXPAND")
-    if env == "0":
-        use_pallas = False
-    elif env == "1":
-        use_pallas = True
-    else:
-        use_pallas = jax.default_backend() == "tpu"
-    if use_pallas:
+    use_pallas, interpret = cfg.expand_enabled()
+    if use_pallas and interpret and getattr(
+        jax.typeof(S), "vma", None
+    ):
         # The Mosaic lowering works under shard_map on real TPU
         # (compile-checked: tpu_custom_call in the mesh module); only
         # the INTERPRETER trips shard_map's vma checks, so the CPU
         # test mesh falls back to the XLA path.
-        interpret = jax.default_backend() != "tpu"
-        if interpret and getattr(jax.typeof(S), "vma", None):
-            use_pallas = False
+        use_pallas = False
     if use_pallas:
         from distributed_join_tpu.ops.expand_pallas import expand_gather
 
@@ -186,7 +181,7 @@ def _expand_records(S, recs: dict, out_capacity: int, j):
             names = list(lanes)
             rec_outs, start_b = expand_gather(
                 S, [lanes[nm] for nm in names], out_capacity,
-                interpret=interpret,
+                block=cfg.block, interpret=interpret,
             )
             out_vals = {
                 nm: _from_u64_lane(rec_outs[i], recs[nm].dtype)
@@ -232,20 +227,15 @@ def _u64_lane_ok(dt) -> bool:
 
 
 def _kernel_path_ok(build, probe, keys, b1d, p1d, nb, npr,
-                    out_capacity):
+                    out_capacity, cfg):
     """Choose between the fused-kernel pipeline (merged sort -> fused
     scans -> stream compactions -> expand kernel; TPU) and the XLA
     pipeline (everything below; CPU tests, f64 columns, empty sides,
     blocks past the f32-exact rank range). Returns (use, interpret)."""
-    import os
-
     from distributed_join_tpu.ops.expand_pallas import _F32_EXACT
 
-    env = os.environ.get("DJTPU_PALLAS_EXPAND")
-    if env == "0":
-        return False, False
-    interpret = jax.default_backend() != "tpu"
-    if interpret and env != "1":
+    use, interpret = cfg.expand_enabled()
+    if not use:
         return False, False
     if interpret and getattr(
         jax.typeof(build.columns[keys[0]]), "vma", None
@@ -267,7 +257,7 @@ def _kernel_path_ok(build, probe, keys, b1d, p1d, nb, npr,
 
 def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
                       build_payload, probe_payload, out_capacity,
-                      interpret) -> JoinResult:
+                      interpret, cfg) -> JoinResult:
     """The TPU pipeline: ONE value-carrying merged sort, the fused
     scan kernel (ops/scan_pallas.py — including the MATCHED-build
     machinery), two streaming compactions (ops/compact_pallas.py: the
@@ -277,8 +267,6 @@ def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
     the window bound holds by construction — unmatched build keys
     never enter the pack; build_windows_ok + lax.cond stay as
     belt-and-braces (the fallback is also exact over the pack)."""
-    import os
-
     from distributed_join_tpu.ops.compact_pallas import stream_compact
     from distributed_join_tpu.ops.compact_planes import (
         plane_stream_compact,
@@ -290,21 +278,10 @@ def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
     from distributed_join_tpu.ops.scan_pallas import join_scans
 
     # log-shift plane compaction (default): measured 54 vs 101 ms for
-    # the 20M->7.5M 4-lane record block on v5e (scripts/
-    # profile_r3_compact.py). DJTPU_COMPACT=mxu restores the one-hot
-    # matmul kernel. Read at TRACE time (like DJTPU_PALLAS_EXPAND):
-    # flipping it after a shape is jit-cached has no effect on that
-    # shape. Default under the interpreter stays mxu; an explicit
-    # DJTPU_COMPACT=plane forces the plane kernel there too so the
-    # join<->plane contract is CPU-testable.
-    compact_env = os.environ.get("DJTPU_COMPACT", "plane")
-    if compact_env not in ("plane", "mxu"):
-        raise ValueError(
-            f"DJTPU_COMPACT={compact_env!r}: expected 'plane' or 'mxu'"
-        )
-    if compact_env == "plane" and (
-        not interpret or "DJTPU_COMPACT" in os.environ
-    ):
+    # the 20M->7.5M 4-lane record block on v5e
+    # (scripts/profile_r3_compact.py); cfg.compact='mxu' restores the
+    # one-hot matmul kernel. Config is resolved at TRACE time.
+    if cfg.use_plane_compact(interpret):
         stream_compact = plane_stream_compact  # noqa: F811
 
     nb, npr = build.capacity, probe.capacity
@@ -443,14 +420,14 @@ def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
     if pack_names:
         def _kernel(_):
             return expand_gather(
-                S, cols_list, out_capacity, interpret=interpret,
-                lo=lo_rec, build_cols=pack,
+                S, cols_list, out_capacity, block=cfg.block,
+                interpret=interpret, lo=lo_rec, build_cols=pack,
             )
 
         def _fallback(_):
             outs2, sb2 = expand_gather(
                 S, cols_list + [compacted["__lo"]], out_capacity,
-                interpret=interpret,
+                block=cfg.block, interpret=interpret,
             )
             rank2 = outs2[-1].astype(jnp.int32) + (j - sb2)
             safe = jnp.clip(rank2, 0, max(nb - 1, 0))
@@ -463,13 +440,14 @@ def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
             return outs2[:-1], sb2, rank2, bouts2
 
         rec_outs, start_b, _rank, build_outs = lax.cond(
-            build_windows_ok(S, lo_rec, out_capacity),
+            build_windows_ok(S, lo_rec, out_capacity, block=cfg.block),
             _kernel, _fallback, None,
         )
         build_vals_u64 = dict(zip(pack_names, build_outs))
     else:
         rec_outs, start_b = expand_gather(
-            S, cols_list, out_capacity, interpret=interpret,
+            S, cols_list, out_capacity, block=cfg.block,
+            interpret=interpret,
         )
         build_vals_u64 = {}
     rec_vals_u64 = dict(zip(rec_value_names, rec_outs))
@@ -519,13 +497,18 @@ def sort_merge_inner_join(
     out_capacity: int,
     build_payload: Optional[Sequence[str]] = None,
     probe_payload: Optional[Sequence[str]] = None,
+    kernel_config: Optional["KernelConfig"] = None,
 ) -> JoinResult:
     """Inner-join ``build`` and ``probe`` on equality of ``key`` — a
     column name or a sequence of names (composite key).
 
     Output columns: the key column(s) (probe's copy), then build
     payloads, then probe payloads. Payload names must not collide.
+
+    ``kernel_config`` (ops/kernel_config.KernelConfig) selects the
+    Pallas kernel paths; None reads the DJTPU_* env fallbacks.
     """
+    cfg = resolve_kernel_config(kernel_config)
     keys = [key] if isinstance(key, str) else list(key)
     if build_payload is None:
         build_payload = [n for n in build.column_names if n not in keys]
@@ -576,12 +559,12 @@ def sort_merge_inner_join(
         )
 
     use_kernel, interpret = _kernel_path_ok(
-        build, probe, keys, b1d, p1d, nb, npr, out_capacity
+        build, probe, keys, b1d, p1d, nb, npr, out_capacity, cfg
     )
     if use_kernel:
         return _join_kernel_path(
             build, probe, keys, b1d, b2d, p1d, p2d, build_payload,
-            probe_payload, out_capacity, interpret,
+            probe_payload, out_capacity, interpret, cfg,
         )
 
     # -- 1. build-side sort: keys + tag + 1-D payloads (+ row index for
@@ -721,7 +704,7 @@ def sort_merge_inner_join(
     #    _join_kernel_path; this path serves CPU, f64 columns, and
     #    blocks past the f32-exact rank range.
     j = jnp.arange(out_capacity, dtype=jnp.int32)
-    out_vals, start_b = _expand_records(S, recs, out_capacity, j)
+    out_vals, start_b = _expand_records(S, recs, out_capacity, j, cfg)
     lo_b = out_vals.pop("__lo").astype(jnp.int32)
     build_rank = lo_b + (j - start_b)
     safe_rank = jnp.clip(build_rank, 0, max(nb - 1, 0))

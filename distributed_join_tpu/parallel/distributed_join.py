@@ -37,6 +37,7 @@ from typing import Optional, Sequence
 import jax.numpy as jnp
 
 from distributed_join_tpu.ops.join import JoinResult, sort_merge_inner_join
+from distributed_join_tpu.ops.kernel_config import KernelConfig  # noqa: F401  (re-export)
 from distributed_join_tpu.ops.partition import radix_hash_partition
 from distributed_join_tpu.parallel.communicator import Communicator
 from distributed_join_tpu.parallel.shuffle import (
@@ -88,6 +89,7 @@ def make_join_step(
     hh_build_capacity: Optional[int] = None,
     hh_out_capacity: Optional[int] = None,
     shuffle: str = "padded",
+    kernel_config=None,
 ):
     """The raw per-rank join step (partition -> shuffle -> local join).
 
@@ -199,6 +201,7 @@ def make_join_step(
                 hh_build, hh_probe, keys,
                 hh_out_capacity or max(p_rows // 2, 1024),
                 build_payload=build_payload, probe_payload=probe_payload,
+                kernel_config=kernel_config,
             )
             parts.append(hh_res.table)
             total = total + hh_res.total.astype(jnp.int64)
@@ -218,6 +221,7 @@ def make_join_step(
             res = sort_merge_inner_join(
                 build_local, probe_local, keys, out_cap,
                 build_payload=build_payload, probe_payload=probe_payload,
+                kernel_config=kernel_config,
             )
             parts.append(res.table)
             total = total + res.total.astype(jnp.int64)
@@ -233,6 +237,7 @@ def make_join_step(
                 res = sort_merge_inner_join(
                     recv_build, recv_probe, keys, out_cap,
                     build_payload=build_payload, probe_payload=probe_payload,
+                    kernel_config=kernel_config,
                 )
                 parts.append(res.table)
                 total = total + res.total.astype(jnp.int64)

@@ -49,17 +49,17 @@ def test_plane_compact_matches_reference(n, density, capacity):
         )
 
 
-def test_join_kernel_path_with_plane_compact(monkeypatch):
+def test_join_kernel_path_with_plane_compact():
     """CPU-runnable integration of the join's kernel path with the
     plane compaction (the production default on TPU): interpret mode,
-    forced via DJTPU_COMPACT=plane + DJTPU_PALLAS_EXPAND=1."""
+    forced via the kernel_config API."""
     import pandas as pd
 
     from distributed_join_tpu.ops.join import sort_merge_inner_join
+    from distributed_join_tpu.ops.kernel_config import KernelConfig
     from distributed_join_tpu.table import Table
 
-    monkeypatch.setenv("DJTPU_PALLAS_EXPAND", "1")
-    monkeypatch.setenv("DJTPU_COMPACT", "plane")
+    cfg = KernelConfig(expand="pallas", compact="plane")
     rng = np.random.default_rng(17)
     n = 6000
     b = Table({"key": jnp.asarray(rng.integers(0, 800, n)),
@@ -69,7 +69,8 @@ def test_join_kernel_path_with_plane_compact(monkeypatch):
                "pv": jnp.asarray(rng.integers(0, 1 << 40, n))},
               jnp.ones(n, bool))
     want = b.to_pandas().merge(p.to_pandas(), on="key")
-    res = sort_merge_inner_join(b, p, "key", 2 * len(want))
+    res = sort_merge_inner_join(b, p, "key", 2 * len(want),
+                                kernel_config=cfg)
     assert int(res.total) == len(want)
     gt = res.table.to_pandas()
     cols = list(gt.columns)
