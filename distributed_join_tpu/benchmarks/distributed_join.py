@@ -76,8 +76,11 @@ def parse_args(argv=None):
                    help="accepted for reference CLI parity; ignored — XLA "
                         "owns TPU memory, there is no RDMA registration")
     p.add_argument("--compression", action="store_true",
-                   help="accepted for reference CLI parity; on-the-wire "
-                        "compression is a documented v1 gap (SURVEY.md §2)")
+                   help="accepted for reference CLI parity; MEASURED and "
+                        "dropped on this hardware: the FoR+bitpack codec "
+                        "(ops/compression.py) breaks even only below "
+                        "~7 GB/s of wire bandwidth "
+                        "(results/compression_for_bitpack.json)")
     # -- framework flags ------------------------------------------------
     p.add_argument("--n-ranks", type=int, default=None,
                    help="mesh size; default all visible devices")
@@ -91,7 +94,7 @@ def parse_args(argv=None):
                    default=None,
                    help="join compaction kernel (default: env/plane)")
     p.add_argument("--kernel-block", type=int, default=None,
-                   help="Pallas expand block size override")
+                   help="Pallas EXPAND kernel block size override")
     p.add_argument("--out-capacity-factor", type=float, default=1.2)
     p.add_argument("--zipf-alpha", type=float, default=None,
                    help="draw probe keys Zipf(alpha) instead of the "
@@ -123,8 +126,11 @@ def run(args) -> dict:
         print(f"note: --registration-method={args.registration_method} "
               "ignored (no RDMA registration on TPU)", file=sys.stderr)
     if args.compression:
-        print("note: --compression ignored (v1 gap; SURVEY.md §2)",
-              file=sys.stderr)
+        print("note: --compression ignored: measured break-even wire "
+              "bandwidth is ~5-7 GB/s (results/"
+              "compression_for_bitpack.json), below both ICI and "
+              "typical DCN; the codec (ops/compression.py) is wired "
+              "for sub-breakeven links only", file=sys.stderr)
 
     comm = make_communicator(args.communicator, n_ranks=args.n_ranks)
     n = comm.n_ranks
@@ -235,8 +241,8 @@ def run(args) -> dict:
 
 def _kernel_config_from_args(args):
     """None unless a kernel flag was given (env fallbacks then apply)."""
-    if not (args.expand_kernel or args.compact_kernel
-            or args.kernel_block):
+    if (args.expand_kernel is None and args.compact_kernel is None
+            and args.kernel_block is None):
         return None
     import dataclasses
 
@@ -247,7 +253,7 @@ def _kernel_config_from_args(args):
             ("expand", args.expand_kernel),
             ("compact", args.compact_kernel),
             ("block", args.kernel_block),
-        ) if v
+        ) if v is not None
     }
     return dataclasses.replace(KernelConfig.from_env(), **overrides)
 

@@ -1,0 +1,103 @@
+"""Frame-of-reference + bit-pack codec for shuffle buckets (pure lax).
+
+The reference optionally compresses each partition buffer with
+nvcomp's cascaded codec before the all-to-all and decompresses after
+(SURVEY.md §2 "nvcomp compression", ``--compression``). The cascaded
+codec is delta + run-length + bit-packing; the TPU-native analog that
+vectorizes cleanly is FRAME-OF-REFERENCE: subtract each block's
+minimum and store the residuals in ``bits`` bits.
+
+XLA's static shapes force one deliberate departure from nvcomp: the
+packed width is a COMPILE-TIME parameter, not per-block metadata. A
+block whose residual range exceeds ``1 << bits`` cannot be packed
+losslessly, so the encoder also returns a per-block overflow flag and
+``required_bits`` — the caller either re-encodes wider (the same
+recompile-on-overflow contract as the join's static capacities) or
+sends that column uncompressed. ``scripts/experiment_compression.py``
+measures what widths real workloads need and what the codec costs;
+``results/compression_for_bitpack.json`` + BASELINE.md record the
+keep/drop decision the flag documentation cites.
+
+Layout: values (n,) int64/int32, n padded to a multiple of
+``block``; per block of ``block`` values: one int64 frame (min) and
+``block*bits/32`` packed u32 words. bits in {2,4,8,16,32} keeps the
+pack/unpack a static reshape+shift fold (32/bits lanes per word).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+_ALLOWED_BITS = (2, 4, 8, 16, 32)
+
+
+class Packed(NamedTuple):
+    words: jax.Array        # (n*bits/32,) uint32
+    frames: jax.Array       # (n/block,) int64 block minima
+    overflow: jax.Array     # bool: some residual needed > bits
+    required_bits: jax.Array  # int32: max bits any block needed
+    n: int                  # logical length (static)
+    bits: int
+    block: int
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def for_bitpack_encode(x: jax.Array, bits: int,
+                       block: int = 1024) -> Packed:
+    if bits not in _ALLOWED_BITS:
+        raise ValueError(f"bits={bits}: expected one of {_ALLOWED_BITS}")
+    assert block % 32 == 0
+    n = x.shape[0]
+    n_pad = _round_up(max(n, 1), block)
+    xi = x.astype(jnp.int64)
+    if n_pad > n:
+        # pad with the last value (residual 0 against a real frame)
+        fill = xi[-1] if n else jnp.int64(0)
+        xi = jnp.concatenate(
+            [xi, jnp.full((n_pad - n,), fill, jnp.int64)]
+        )
+    blocks = xi.reshape(-1, block)
+    frames = jnp.min(blocks, axis=1)
+    resid = (blocks - frames[:, None]).astype(jnp.uint64)
+    span = jnp.max(resid, axis=1)
+    # bits needed per block via integer compares (no f64 log on TPU)
+    required = jnp.zeros(span.shape, jnp.int32)
+    for b in range(64):
+        required = required + (
+            span >= (jnp.uint64(1) << jnp.uint64(b))
+        ).astype(jnp.int32)
+    overflow = jnp.any(span >= (jnp.uint64(1) << jnp.uint64(bits))) \
+        if bits < 64 else jnp.bool_(False)
+    lanes = 32 // bits
+    r32 = (
+        resid & jnp.uint64((1 << bits) - 1 if bits < 64 else ~0)
+    ).astype(jnp.uint32).reshape(-1, lanes)
+    word = jnp.zeros((r32.shape[0],), jnp.uint32)
+    for j in range(lanes):
+        word = word | (r32[:, j] << jnp.uint32(j * bits))
+    return Packed(
+        words=word, frames=frames, overflow=overflow,
+        required_bits=jnp.max(required), n=n, bits=bits, block=block,
+    )
+
+
+def for_bitpack_decode(p: Packed, dtype=jnp.int64) -> jax.Array:
+    lanes = 32 // p.bits
+    mask = jnp.uint32((1 << p.bits) - 1 if p.bits < 32 else 0xFFFFFFFF)
+    parts = [
+        ((p.words >> jnp.uint32(j * p.bits)) & mask) for j in range(lanes)
+    ]
+    resid = jnp.stack(parts, axis=1).reshape(-1, p.block)
+    out = resid.astype(jnp.int64) + p.frames[:, None]
+    return out.reshape(-1)[:p.n].astype(dtype)
+
+
+def wire_bytes(p: Packed) -> int:
+    """Static wire footprint of the packed form."""
+    return int(p.words.shape[0] * 4 + p.frames.shape[0] * 8)

@@ -8,7 +8,8 @@ time:
 
 - ``DJTPU_PALLAS_EXPAND`` = 0 | 1 (unset = auto: on for TPU)
 - ``DJTPU_COMPACT``       = plane | mxu (unset = auto)
-- ``DJTPU_PALLAS_BLOCK``  = expand/compact block size
+- ``DJTPU_PALLAS_BLOCK``  = EXPAND kernel block size (the
+  compact/sort kernels own their block defaults)
 
 (The expand window chunk is deliberately NOT a config field: it is an
 internal tuning constant of ops/expand_pallas.py, overridable only by

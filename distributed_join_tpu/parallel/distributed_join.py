@@ -37,7 +37,6 @@ from typing import Optional, Sequence
 import jax.numpy as jnp
 
 from distributed_join_tpu.ops.join import JoinResult, sort_merge_inner_join
-from distributed_join_tpu.ops.kernel_config import KernelConfig  # noqa: F401  (re-export)
 from distributed_join_tpu.ops.partition import radix_hash_partition
 from distributed_join_tpu.parallel.communicator import Communicator
 from distributed_join_tpu.parallel.shuffle import (
@@ -62,8 +61,11 @@ def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
     if mode == "ragged":
         # Exact-size exchange: receive buffer = the same total rows the
         # padded layout would flatten to, but wire bytes = actual rows.
+        # capacity_per_bucket aligns the overflow contract with padded
+        # mode: auto_retry fires under identical conditions.
         return shuffle_ragged(
-            comm, pt, n_ranks * capacity, bucket_start=batch * n_ranks
+            comm, pt, n_ranks * capacity, bucket_start=batch * n_ranks,
+            capacity_per_bucket=capacity,
         )
     padded, counts, overflow, _ = pt.to_padded(
         capacity, bucket_start=batch * n_ranks, n_buckets=n_ranks
@@ -93,17 +95,24 @@ def make_join_step(
 ):
     """The raw per-rank join step (partition -> shuffle -> local join).
 
-    ``shuffle``: "padded" (capacity-padded all_to_all, the default) or
+    ``shuffle``: "padded" (capacity-padded all_to_all, the default),
     "ragged" (exact-size ``lax.ragged_all_to_all`` — wire bytes equal
-    actual rows). Capacity semantics DIFFER between the modes: padded
-    enforces a per-(sender, destination) bucket capacity, checked
-    sender-side, while ragged pools the receiver's whole buffer
-    (n_ranks x the per-bucket capacity) and clamps receiver-side — a
-    single hot bucket that overflows padded mode can fit in ragged
-    mode, so auto_retry may fire under one mode and not the other.
-    The ragged hardware op exists only on TPU; other backends
-    transparently run the bit-identical emulation
-    (Communicator.ragged_all_to_all).
+    actual rows), or "ppermute" (padded blocks over a
+    collective-permute chain whose lowering the scheduler can overlap
+    with compute; docs/OVERLAP.md).
+
+    ONE capacity contract across all modes: the unit of capacity is
+    the per-(sender, destination) bucket,
+    ``ceil(rows/(k*n)) * shuffle_capacity_factor``, and the overflow
+    flag fires whenever any bucket exceeds it — so ``auto_retry``
+    fires under identical conditions whichever mode is selected.
+    Ragged mode's receive buffer additionally pools to
+    ``n_ranks x capacity`` and clamps deterministically at the pooled
+    bound (rows a clamp drops are always flagged); its flag is
+    CONSERVATIVE relative to what its pooling could physically hold —
+    the price of mode-independent retry semantics. The ragged hardware
+    op exists only on TPU; other backends transparently run the
+    bit-identical emulation (Communicator.ragged_all_to_all).
 
     Returns ``step(build_local, probe_local) -> JoinResult`` meant to run
     inside ``comm.spmd`` (collectives are unresolved outside it). Exposed
