@@ -114,6 +114,10 @@ def parse_args(argv=None):
     p.add_argument("--string-payload-bytes", type=int, default=0,
                    help="attach a fixed-width string payload of this "
                         "many bytes to the build side (config 5)")
+    p.add_argument("--string-key-bytes", type=int, default=0,
+                   help="join on a fixed-width STRING key of this many "
+                        "bytes (derived from the int key; packed-word "
+                        "composite-key machinery)")
     p.add_argument("--json-output", default=None,
                    help="also write the result record to this file")
     add_platform_arg(p)
@@ -182,6 +186,9 @@ def run(args) -> dict:
             payload_dtype=payload_dtype,
             unique_build_keys=not args.duplicate_build_keys,
         )
+    if args.string_key_bytes > 0:
+        build, probe, join_key = _stringify_key(
+            build, probe, join_key, args.string_key_bytes)
     build, probe = comm.device_put_sharded((build, probe))
     jax.block_until_ready((build, probe))
 
@@ -223,6 +230,7 @@ def run(args) -> dict:
         "skew_threshold": args.skew_threshold,
         "key_columns": args.key_columns,
         "string_payload_bytes": args.string_payload_bytes,
+        "string_key_bytes": args.string_key_bytes,
         "matches_per_join": matches,
         "overflow": overflow,
         "elapsed_per_join_s": sec_per_join,
@@ -237,6 +245,30 @@ def run(args) -> dict:
         record, args.json_output,
     )
     return record
+
+
+def _stringify_key(build, probe, join_key, nbytes):
+    """Replace the (single, int) join key with a fixed-width string
+    rendering of it — the reference's string-key join surface."""
+    import numpy as np
+
+    from distributed_join_tpu.table import Table
+    from distributed_join_tpu.utils.strings import encode_int_strings
+
+    if not isinstance(join_key, str):
+        raise SystemExit("--string-key-bytes needs a single key column")
+    digits = nbytes - 4
+    if digits < 1:
+        raise SystemExit("--string-key-bytes must be >= 5 ('itm-' + d)")
+    out = []
+    for t in (build, probe):
+        ids = np.asarray(t.columns[join_key])
+        b, l = encode_int_strings(ids, prefix="itm-", digits=digits)
+        cols = {k: v for k, v in t.columns.items() if k != join_key}
+        cols["skey"] = b
+        cols["skey#len"] = l
+        out.append(Table(cols, t.valid))
+    return out[0], out[1], "skey"
 
 
 def _kernel_config_from_args(args):

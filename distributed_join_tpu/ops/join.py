@@ -67,6 +67,7 @@ shape as round 1 for exactly the columns that need it.
 from __future__ import annotations
 
 import dataclasses
+import re
 import warnings
 from typing import Optional, Sequence
 
@@ -95,6 +96,10 @@ def _dtype_sentinel_max(dt):
 # initialize the XLA backend at import time, which breaks the multi-host
 # bootstrap contract (jax.distributed.initialize must run first).
 _I32_MAX = 2**31 - 1
+
+# the packed string-key word columns this module injects for itself
+# (utils/strings.string_key_word_names)
+_SK_RE = re.compile(r"__sk\d+w\d+")
 
 
 def _holds_i32_exactly(dt) -> bool:
@@ -498,9 +503,14 @@ def sort_merge_inner_join(
     build_payload: Optional[Sequence[str]] = None,
     probe_payload: Optional[Sequence[str]] = None,
     kernel_config: Optional["KernelConfig"] = None,
+    _internal: bool = False,
 ) -> JoinResult:
     """Inner-join ``build`` and ``probe`` on equality of ``key`` — a
-    column name or a sequence of names (composite key).
+    column name or a sequence of names (composite key). A key column
+    may be a fixed-width 2-D uint8 byte column (utils/strings.py):
+    it joins on lexicographic equality of the zero-padded bytes via
+    packed big-endian uint64 words, the same composite-key machinery
+    as scalar keys (SURVEY.md §2 string children; §7 step 7).
 
     Output columns: the key column(s) (probe's copy), then build
     payloads, then probe payloads. Payload names must not collide.
@@ -510,6 +520,30 @@ def sort_merge_inner_join(
     """
     cfg = resolve_kernel_config(kernel_config)
     keys = [key] if isinstance(key, str) else list(key)
+    # String keys: pack 2-D byte key columns into uint64 word columns
+    # and recurse with the scalar composite key; the byte column is
+    # reconstructed exactly from the output words. This runs BEFORE
+    # payload defaulting: the companion "<key>#len" columns exist on
+    # both sides and the probe's copy wins (keys-from-probe).
+    if any(build.columns[k].ndim == 2 for k in keys):
+        from distributed_join_tpu.utils.strings import (
+            prepare_string_key_join,
+            rebuild_string_keys,
+        )
+
+        b2, p2, keys2, bp, pp, spec = prepare_string_key_join(
+            build, probe, keys, build_payload, probe_payload
+        )
+        res = sort_merge_inner_join(
+            b2, p2, keys2, out_capacity,
+            build_payload=bp, probe_payload=pp,
+            kernel_config=kernel_config, _internal=True,
+        )
+        return JoinResult(
+            rebuild_string_keys(res.table, spec, keys),
+            total=res.total, overflow=res.overflow,
+        )
+
     if build_payload is None:
         build_payload = [n for n in build.column_names if n not in keys]
     if probe_payload is None:
@@ -520,10 +554,14 @@ def sort_merge_inner_join(
     # Internal record lanes (__S, __key{i}, __lo, __prow, __browidx)
     # share one dict namespace with user column names; a payload named
     # '__S' would silently overwrite a geometry lane and corrupt the
-    # join output.
+    # join output. The packed string-key word columns (__sk{i}w{w})
+    # are exempt ONLY on the internal recursion from the string-key
+    # branch above — a user-supplied __sk name is rejected like any
+    # other dunder (split_string_keys also refuses to overwrite one).
     reserved = [
         nm for nm in (*keys, *build_payload, *probe_payload)
         if nm.startswith("__")
+        and not (_internal and _SK_RE.fullmatch(nm))
     ]
     if reserved:
         raise ValueError(

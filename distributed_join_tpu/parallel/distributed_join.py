@@ -165,6 +165,22 @@ def make_join_step(
                 raise TypeError(
                     f"key {kname!r} dtype mismatch: build {bdt} vs probe {pdt}"
                 )
+
+        # String keys: pack 2-D byte key columns into uint64 words ONCE,
+        # before hashing/partitioning — every stage downstream (hash,
+        # partition sort, shuffle, local join) then sees an ordinary
+        # composite scalar key, and the key bytes ride the wire packed
+        # (not duplicated; the build side's dead '#len' companion is
+        # dropped before the shuffle). Reconstructed exactly on exit.
+        from distributed_join_tpu.utils.strings import (
+            prepare_string_key_join,
+        )
+
+        (build_local, probe_local, keys_eff, bpay, ppay,
+         str_spec) = prepare_string_key_join(
+            build_local, probe_local, keys, build_payload,
+            probe_payload,
+        )
         b_rows, p_rows = build_local.capacity, probe_local.capacity
         b_cap = _round_up(int(math.ceil(b_rows / nb * shuffle_capacity_factor)), 8)
         p_cap = _round_up(int(math.ceil(p_rows / nb * shuffle_capacity_factor)), 8)
@@ -189,8 +205,8 @@ def make_join_step(
             # and ranks (hash collisions merely over-classify a key as
             # heavy, which stays correct — the HH join matches on the
             # real composite key).
-            bh = hash_columns([build_local.columns[k] for k in keys])
-            ph = hash_columns([probe_local.columns[k] for k in keys])
+            bh = hash_columns([build_local.columns[k] for k in keys_eff])
+            ph = hash_columns([probe_local.columns[k] for k in keys_eff])
             hh = skew.global_heavy_hitters(
                 comm,
                 ph,
@@ -207,10 +223,11 @@ def make_join_step(
             # HH probe rows stay local: same arrays, narrowed validity.
             hh_probe = Table(probe_local.columns, probe_local.valid & is_hh_p)
             hh_res = sort_merge_inner_join(
-                hh_build, hh_probe, keys,
+                hh_build, hh_probe, keys_eff,
                 hh_out_capacity or max(p_rows // 2, 1024),
-                build_payload=build_payload, probe_payload=probe_payload,
+                build_payload=bpay, probe_payload=ppay,
                 kernel_config=kernel_config,
+                _internal=bool(str_spec),
             )
             parts.append(hh_res.table)
             total = total + hh_res.total.astype(jnp.int64)
@@ -228,25 +245,27 @@ def make_join_step(
             # validity natively); this is the reference's 1-rank path,
             # which also partitions into nranks=1 buckets and joins.
             res = sort_merge_inner_join(
-                build_local, probe_local, keys, out_cap,
-                build_payload=build_payload, probe_payload=probe_payload,
+                build_local, probe_local, keys_eff, out_cap,
+                build_payload=bpay, probe_payload=ppay,
                 kernel_config=kernel_config,
+                _internal=bool(str_spec),
             )
             parts.append(res.table)
             total = total + res.total.astype(jnp.int64)
             overflow = overflow | res.overflow
         else:
-            ptb = radix_hash_partition(build_local, keys, nb)
-            ptp = radix_hash_partition(probe_local, keys, nb)
+            ptb = radix_hash_partition(build_local, keys_eff, nb)
+            ptp = radix_hash_partition(probe_local, keys_eff, nb)
             for b in range(k):
                 recv_build, ovf_b = _batch_shuffle(
                     comm, ptb, b, n, b_cap, mode=shuffle)
                 recv_probe, ovf_p = _batch_shuffle(
                     comm, ptp, b, n, p_cap, mode=shuffle)
                 res = sort_merge_inner_join(
-                    recv_build, recv_probe, keys, out_cap,
-                    build_payload=build_payload, probe_payload=probe_payload,
+                    recv_build, recv_probe, keys_eff, out_cap,
+                    build_payload=bpay, probe_payload=ppay,
                     kernel_config=kernel_config,
+                    _internal=bool(str_spec),
                 )
                 parts.append(res.table)
                 total = total + res.total.astype(jnp.int64)
@@ -258,6 +277,12 @@ def make_join_step(
             },
             jnp.concatenate([t.valid for t in parts]),
         )
+        if str_spec:
+            from distributed_join_tpu.utils.strings import (
+                rebuild_string_keys,
+            )
+
+            out = rebuild_string_keys(out, str_spec, keys)
         total = comm.psum(total)
         overflow = comm.psum(overflow.astype(jnp.int32)) > 0
         return JoinResult(out, total=total, overflow=overflow)

@@ -15,8 +15,11 @@ the wire (~1/utilization), the classic TPU trade of padding for static
 shapes; a ragged two-phase byte shuffle is a possible later
 optimization, mirroring the reference's offsets-then-chars exchange.
 
-Strings are payload-only for now: join keys must be fixed-width
-scalars (hash/sort of 2-D byte rows is not wired into the kernels).
+String JOIN KEYS are supported via the packed-word machinery at the
+bottom of this module: a 2-D byte key column becomes big-endian
+uint64 word columns (unsigned word order == lexicographic byte
+order), which every kernel handles as an ordinary composite scalar
+key; the byte column is reconstructed exactly on output.
 """
 
 from __future__ import annotations
@@ -99,3 +102,158 @@ def add_string_column(columns: dict, name: str, values: Sequence[str],
     columns[name] = b
     columns[name + LEN_SUFFIX] = l
     return columns
+
+
+# -- string JOIN KEYS: packed-word representation ----------------------
+#
+# A fixed-width byte column packs into ceil(max_len/8) uint64 "word"
+# columns, BIG-ENDIAN within each word, so unsigned lexicographic
+# comparison of the word tuple IS lexicographic comparison of the
+# zero-padded bytes. Every existing kernel (hash, partition sort,
+# shuffle, sort-merge join) then handles string keys as an ordinary
+# composite scalar key — and the byte column is reconstructed exactly
+# from the output words, so the bytes never ride the wire twice.
+#
+# Semantics note: keys compare by their zero-PADDED bytes, so two
+# strings differing only in trailing NUL bytes are equal keys (UTF-8
+# text never contains NULs, and encode_strings never emits interior
+# ones). The companion "<name>#len" column is ordinary 1-D payload.
+
+_WORD_PREFIX = "__sk"
+
+
+def string_key_word_names(name_idx: int, n_words: int):
+    return [f"{_WORD_PREFIX}{name_idx}w{w}" for w in range(n_words)]
+
+
+def pack_string_key(bytes_2d: jnp.ndarray):
+    """uint8[n, L] -> list of uint64[n] big-endian word columns."""
+    n, L = bytes_2d.shape
+    words = []
+    for w in range(0, L, 8):
+        acc = jnp.zeros((n,), jnp.uint64)
+        for j in range(8):
+            if w + j < L:
+                acc = acc | (
+                    bytes_2d[:, w + j].astype(jnp.uint64)
+                    << jnp.uint64(8 * (7 - j))
+                )
+        words.append(acc)
+    return words
+
+
+def unpack_string_key(words, max_len: int):
+    """Inverse of :func:`pack_string_key` -> uint8[n, max_len]."""
+    cols = []
+    for w in range(0, max_len, 8):
+        word = words[w // 8]
+        for j in range(8):
+            if w + j < max_len:
+                cols.append(
+                    ((word >> jnp.uint64(8 * (7 - j)))
+                     & jnp.uint64(0xFF)).astype(jnp.uint8)
+                )
+    return jnp.stack(cols, axis=1)
+
+
+def split_string_keys(build, probe, keys):
+    """Replace 2-D uint8 key columns with packed word columns in both
+    tables. Returns ``(build2, probe2, keys2, spec)`` where ``spec``
+    is ``[(orig_name, word_names, max_len), ...]`` for reconstruction
+    (:func:`rebuild_string_keys`); empty spec = nothing to do.
+
+    Tables are Table instances (imported lazily to keep utils free of
+    a table dependency at import time)."""
+    from distributed_join_tpu.table import Table
+
+    spec = []
+    keys2 = []
+    bcols = dict(build.columns)
+    pcols = dict(probe.columns)
+    for i, k in enumerate(keys):
+        c = bcols[k]
+        if c.ndim != 2:
+            keys2.append(k)
+            continue
+        taken = set(bcols) | set(pcols)
+        for nm in string_key_word_names(i, (c.shape[1] + 7) // 8):
+            if nm in taken:
+                # never silently overwrite a (somehow) existing column
+                raise ValueError(
+                    f"column {nm!r} collides with the packed "
+                    "string-key word columns"
+                )
+        if c.dtype != jnp.uint8 or pcols[k].dtype != jnp.uint8:
+            raise TypeError(
+                f"2-D key {k!r} must be uint8 bytes, got {c.dtype}"
+            )
+        if c.shape[1] != pcols[k].shape[1]:
+            raise TypeError(
+                f"2-D key {k!r} width mismatch: {c.shape[1]} vs "
+                f"{pcols[k].shape[1]}"
+            )
+        max_len = c.shape[1]
+        wn = string_key_word_names(i, (max_len + 7) // 8)
+        for nm, w in zip(wn, pack_string_key(bcols.pop(k))):
+            bcols[nm] = w
+        for nm, w in zip(wn, pack_string_key(pcols.pop(k))):
+            pcols[nm] = w
+        keys2.extend(wn)
+        spec.append((k, wn, max_len))
+    if not spec:
+        return build, probe, keys, []
+    return (Table(bcols, build.valid), Table(pcols, probe.valid),
+            keys2, spec)
+
+
+def rebuild_string_keys(table, spec, key_order):
+    """Inverse of :func:`split_string_keys` on a JOIN OUTPUT table:
+    word columns collapse back to the byte column, output columns
+    reordered keys-first in ``key_order``."""
+    from distributed_join_tpu.table import Table
+
+    cols = dict(table.columns)
+    rebuilt = {}
+    for name, word_names, max_len in spec:
+        rebuilt[name] = unpack_string_key(
+            [cols.pop(nm) for nm in word_names], max_len
+        )
+    out = {}
+    for k in key_order:
+        out[k] = rebuilt[k] if k in rebuilt else cols.pop(k)
+    out.update(cols)
+    return Table(out, table.valid)
+
+
+def prepare_string_key_join(build, probe, keys, build_payload,
+                            probe_payload):
+    """Shared front half of a string-key join: payload defaulting
+    (the probe's '<key>#len' companion wins; the build side's is
+    dropped outright — dead data must not ride the shuffle) + the
+    packed-word split. Returns
+    ``(build2, probe2, keys2, build_payload, probe_payload, spec)``;
+    empty spec = no string keys."""
+    from distributed_join_tpu.table import Table
+
+    str_keys = [k for k in keys if build.columns[k].ndim == 2]
+    if not str_keys:
+        return build, probe, keys, build_payload, probe_payload, []
+    drop = {k + LEN_SUFFIX for k in str_keys}
+    if build_payload is None:
+        build_payload = [
+            n for n in build.column_names
+            if n not in keys and n not in drop
+        ]
+    if probe_payload is None:
+        probe_payload = [
+            n for n in probe.column_names if n not in keys
+        ]
+    build2, probe2, keys2, spec = split_string_keys(build, probe, keys)
+    # drop build-side columns that are neither key nor payload (the
+    # dead '#len' companions) so they never ride the partition/shuffle
+    keep_b = set(keys2) | set(build_payload)
+    build2 = Table(
+        {n: c for n, c in build2.columns.items() if n in keep_b},
+        build2.valid,
+    )
+    return build2, probe2, keys2, build_payload, probe_payload, spec
