@@ -50,6 +50,10 @@ def parse_args(argv=None):
                         "key-range batches to the device — required "
                         "beyond SF ~1 (device HBM); implies --batches "
                         "semantics even at --batches 1")
+    p.add_argument("--wide-wire", action="store_true",
+                   help="stage int64 wire dtypes (round-2 behavior); "
+                        "default narrows every column to int32, which "
+                        "nearly halves the measured H2D bottleneck")
     p.add_argument("--over-decomposition-factor", type=int, default=1)
     p.add_argument("--shuffle-capacity-factor", type=float, default=1.6)
     p.add_argument("--out-capacity-factor", type=float, default=1.5)
@@ -78,6 +82,7 @@ def run(args) -> dict:
             scale_factor=args.scale_factor,
             n_batches=args.batches,
             q3_filters=args.q3_filters,
+            narrow_wire=not args.wide_wire,
         )
         gen_s = time.perf_counter() - gen_t0
         build_b = rename_batches(ob, {"o_orderkey": "key"})
@@ -97,6 +102,7 @@ def run(args) -> dict:
         sec = stats["elapsed_s"]
         record_extra = {
             "host_generator": True,
+            "narrow_wire": not args.wide_wire,
             "generate_s": gen_s,
             "batch_build_capacity": stats["build_capacity"],
             "batch_probe_capacity": stats["probe_capacity"],

@@ -503,7 +503,7 @@ def sort_merge_inner_join(
     build_payload: Optional[Sequence[str]] = None,
     probe_payload: Optional[Sequence[str]] = None,
     kernel_config: Optional["KernelConfig"] = None,
-    _internal: bool = False,
+    _internal: Sequence[str] = (),
 ) -> JoinResult:
     """Inner-join ``build`` and ``probe`` on equality of ``key`` — a
     column name or a sequence of names (composite key). A key column
@@ -534,10 +534,13 @@ def sort_merge_inner_join(
         b2, p2, keys2, bp, pp, spec = prepare_string_key_join(
             build, probe, keys, build_payload, probe_payload
         )
+        allowed = tuple(
+            nm for _, wns, _ in spec for nm in wns
+        )
         res = sort_merge_inner_join(
             b2, p2, keys2, out_capacity,
             build_payload=bp, probe_payload=pp,
-            kernel_config=kernel_config, _internal=True,
+            kernel_config=kernel_config, _internal=allowed,
         )
         return JoinResult(
             rebuild_string_keys(res.table, spec, keys),
@@ -554,14 +557,13 @@ def sort_merge_inner_join(
     # Internal record lanes (__S, __key{i}, __lo, __prow, __browidx)
     # share one dict namespace with user column names; a payload named
     # '__S' would silently overwrite a geometry lane and corrupt the
-    # join output. The packed string-key word columns (__sk{i}w{w})
-    # are exempt ONLY on the internal recursion from the string-key
-    # branch above — a user-supplied __sk name is rejected like any
-    # other dunder (split_string_keys also refuses to overwrite one).
+    # join output. Only the EXACT packed word names injected by the
+    # string-key branch above (threaded through ``_internal``) are
+    # exempt — any other dunder, including unused __sk-pattern names,
+    # is rejected (split_string_keys also refuses to overwrite one).
     reserved = [
         nm for nm in (*keys, *build_payload, *probe_payload)
-        if nm.startswith("__")
-        and not (_internal and _SK_RE.fullmatch(nm))
+        if nm.startswith("__") and nm not in _internal
     ]
     if reserved:
         raise ValueError(

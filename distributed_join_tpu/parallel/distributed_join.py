@@ -181,6 +181,9 @@ def make_join_step(
             build_local, probe_local, keys, build_payload,
             probe_payload,
         )
+        sk_names = tuple(
+            nm for _, wns, _ in str_spec for nm in wns
+        )
         b_rows, p_rows = build_local.capacity, probe_local.capacity
         b_cap = _round_up(int(math.ceil(b_rows / nb * shuffle_capacity_factor)), 8)
         p_cap = _round_up(int(math.ceil(p_rows / nb * shuffle_capacity_factor)), 8)
@@ -227,7 +230,7 @@ def make_join_step(
                 hh_out_capacity or max(p_rows // 2, 1024),
                 build_payload=bpay, probe_payload=ppay,
                 kernel_config=kernel_config,
-                _internal=bool(str_spec),
+                _internal=sk_names,
             )
             parts.append(hh_res.table)
             total = total + hh_res.total.astype(jnp.int64)
@@ -248,7 +251,7 @@ def make_join_step(
                 build_local, probe_local, keys_eff, out_cap,
                 build_payload=bpay, probe_payload=ppay,
                 kernel_config=kernel_config,
-                _internal=bool(str_spec),
+                _internal=sk_names,
             )
             parts.append(res.table)
             total = total + res.total.astype(jnp.int64)
@@ -265,7 +268,7 @@ def make_join_step(
                     recv_build, recv_probe, keys_eff, out_cap,
                     build_payload=bpay, probe_payload=ppay,
                     kernel_config=kernel_config,
-                    _internal=bool(str_spec),
+                    _internal=sk_names,
                 )
                 parts.append(res.table)
                 total = total + res.total.astype(jnp.int64)

@@ -58,6 +58,18 @@ LINEITEM_DTYPES = {
     "l_discount": np.int32,
 }
 
+#: H2D staging was the measured SF-100 bottleneck (305 s of 544 s at
+#: ~50-140 MB/s over this environment's relay — BASELINE.md config 4);
+#: every generated value fits int32 whenever the sparse orderkeys
+#: ((i//8)*32 + i%8 + 1 ~ 4*n_orders = 6M*SF) stay < 2^31 — SF up to
+#: ~357 (o_totalprice < 55.55M and l_extendedprice < 10.5M always
+#: fit), so
+#: narrow wire dtypes nearly halve the staged bytes. The join handles
+#: int32 keys natively; results are identical.
+NARROW_ORDERS_DTYPES = {k: np.int32 for k in ORDERS_DTYPES}
+NARROW_LINEITEM_DTYPES = {k: np.int32 for k in LINEITEM_DTYPES}
+MAX_NARROW_ORDERS = 2**31 - 1
+
 HostBatches = List[dict]  # one dict of numpy columns per key-range batch
 
 
@@ -100,6 +112,7 @@ def generate_tpch_host_batches(
     chunk_orders: int = DEFAULT_CHUNK_ORDERS,
     q3_filters: bool = False,
     cutoff_day: int = DATE_RANGE_DAYS // 2,
+    narrow_wire: bool = True,
 ) -> Tuple[HostBatches, HostBatches]:
     """(orders_batches, lineitem_batches): per-key-range-batch numpy
     column blocks for the config-4 join, generated chunkwise.
@@ -107,17 +120,36 @@ def generate_tpch_host_batches(
     With ``q3_filters``, rows failing Q3's date predicates
     (``o_orderdate < cutoff``, ``l_shipdate > cutoff``) are dropped at
     generation and never reach the device.
+
+    ``narrow_wire`` (default): stage every column as int32 — all
+    generated value ranges fit whenever the sparse orderkeys
+    (~6M * SF) do, i.e. SF up to ~357, and H2D bytes were the
+    measured SF-100 bottleneck. Values and join results are
+    identical; disable to reproduce the round-2 int64-wire
+    artifacts (the guard below raises past the limit).
     """
     if n_batches < 1:
         raise ValueError("n_batches must be >= 1")
     rng = np.random.default_rng(seed)
     n_orders = int(ORDERS_PER_SF * scale_factor)
+    if narrow_wire and n_orders * 4 >= MAX_NARROW_ORDERS:
+        raise ValueError(
+            "narrow_wire requires orderkeys < 2^31; lower the scale "
+            "factor or pass narrow_wire=False"
+        )
+    odt = NARROW_ORDERS_DTYPES if narrow_wire else ORDERS_DTYPES
+    ldt = NARROW_LINEITEM_DTYPES if narrow_wire else LINEITEM_DTYPES
 
     oparts: List[List[dict]] = [[] for _ in range(n_batches)]
     lparts: List[List[dict]] = [[] for _ in range(n_batches)]
     for start in range(0, n_orders, chunk_orders):
         count = min(chunk_orders, n_orders - start)
         orders, lineitem = _gen_chunk(rng, start, count)
+        if narrow_wire:
+            orders = {k: v.astype(np.int32) for k, v in orders.items()}
+            lineitem = {
+                k: v.astype(np.int32) for k, v in lineitem.items()
+            }
         if q3_filters:
             orders = _select(orders, orders["o_orderdate"] < cutoff_day)
             lineitem = _select(lineitem, lineitem["l_shipdate"] > cutoff_day)
@@ -143,7 +175,7 @@ def generate_tpch_host_batches(
             parts[b] = None
         return out
 
-    return _concat(oparts, ORDERS_DTYPES), _concat(lparts, LINEITEM_DTYPES)
+    return _concat(oparts, odt), _concat(lparts, ldt)
 
 
 def rename_batches(batches: HostBatches, mapping: dict) -> HostBatches:

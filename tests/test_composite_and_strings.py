@@ -206,7 +206,8 @@ def test_string_key_mixed_composite():
     assert int(res.total) == len(want) and not bool(res.overflow)
 
 
-def test_string_key_distributed_8dev():
+@pytest.mark.parametrize("shuffle", ["padded", "ragged", "ppermute"])
+def test_string_key_distributed_8dev(shuffle):
     import pandas as pd
 
     import distributed_join_tpu as dj
@@ -226,7 +227,7 @@ def test_string_key_distributed_8dev():
     p = Table(pcols, jnp.ones(npr, bool))
     comm = dj.make_communicator("tpu", n_ranks=8)
     res = dj.distributed_inner_join(
-        b, p, comm, key="name",
+        b, p, comm, key="name", shuffle=shuffle,
         out_capacity_factor=10.0, shuffle_capacity_factor=6.0,
     )
     want = pd.DataFrame({"name": [f"n{i:05d}" for i in bids]}).merge(
