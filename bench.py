@@ -15,6 +15,16 @@ with benchmark/distributed_join.py.
 ``vs_baseline`` is value / 125 M rows/s/chip — the BASELINE.json north
 star (>= 1 B rows/s aggregate on 8 v5e chips) divided per chip; there
 are no reference-published numbers (BASELINE.md).
+
+Output sizing (round-2 weak #5 / round-3 #8): the join is measured
+under BOTH capacity stories and both appear in the one JSON line —
+
+- ``value``: output block sized from the known match count + 25% slack
+  (mirrors the reference's exactly-sized cudf::inner_join allocation;
+  comparable with BENCH_r01..r03).
+- ``value_capacity_contract``: output block sized by the flag driver's
+  general contract, ``out_capacity_factor`` (1.2) x probe rows — what a
+  user who does NOT know the match count pays.
 """
 
 from __future__ import annotations
@@ -59,20 +69,24 @@ def main() -> None:
     build, probe = comm.device_put_sharded((build, probe))
     jax.block_until_ready((build, probe))
 
-    step = make_join_step(
-        comm,
-        key="key",
-        over_decomposition=1,
-        out_rows_per_rank=int(EXPECTED_MATCHES * OUT_SLACK / n_dev),
-    )
+    def measure(**sizing):
+        step = make_join_step(
+            comm, key="key", over_decomposition=1, **sizing
+        )
+        per_join, total, overflow = timed_join_throughput(
+            comm, step, build, probe, ITERS
+        )
+        assert total > 0 and not overflow, (total, overflow)
+        rows_per_sec = (BUILD_NROWS + PROBE_NROWS) / per_join
+        return rows_per_sec / 1e6 / n_dev
 
-    per_join, total, overflow = timed_join_throughput(
-        comm, step, build, probe, ITERS
+    m_rows_per_chip = measure(
+        out_rows_per_rank=int(EXPECTED_MATCHES * OUT_SLACK / n_dev)
     )
-    assert total > 0 and not overflow, (total, overflow)
-
-    rows_per_sec = (BUILD_NROWS + PROBE_NROWS) / per_join
-    m_rows_per_chip = rows_per_sec / 1e6 / n_dev
+    # Same join under the flag driver's general capacity contract
+    # (distributed_join.DEFAULT_OUT_CAPACITY_FACTOR over probe rows) —
+    # no match-count oracle.
+    m_rows_contract = measure()
     print(
         json.dumps(
             {
@@ -82,6 +96,11 @@ def main() -> None:
                 "vs_baseline": round(
                     m_rows_per_chip / BASELINE_M_ROWS_PER_SEC_PER_CHIP, 4
                 ),
+                "value_capacity_contract": round(m_rows_contract, 3),
+                "out_rows": {
+                    "match_sized": int(EXPECTED_MATCHES * OUT_SLACK),
+                    "contract": "out_capacity_factor=1.2 x probe rows",
+                },
             }
         )
     )

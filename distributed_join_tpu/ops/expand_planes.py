@@ -1,4 +1,7 @@
-"""Pallas log-shift record expansion (u32 planes).
+"""Pallas log-shift record expansion (u32 planes). EXPERIMENTAL — not
+wired into the production join: the fused build side is blocked by
+duplicate-key rank revisits (proof sketch below); ops/join.py uses
+the MXU expand kernel (ops/expand_pallas.py) instead.
 
 Same job as ops/expand_pallas.expand_gather — broadcast each record's
 values down its output run, plus the fused build-side materialization

@@ -67,7 +67,6 @@ shape as round 1 for exactly the columns that need it.
 from __future__ import annotations
 
 import dataclasses
-import re
 import warnings
 from typing import Optional, Sequence
 
@@ -96,11 +95,6 @@ def _dtype_sentinel_max(dt):
 # initialize the XLA backend at import time, which breaks the multi-host
 # bootstrap contract (jax.distributed.initialize must run first).
 _I32_MAX = 2**31 - 1
-
-# the packed string-key word columns this module injects for itself
-# (utils/strings.string_key_word_names)
-_SK_RE = re.compile(r"__sk\d+w\d+")
-
 
 def _holds_i32_exactly(dt) -> bool:
     """Can dt round-trip any NON-NEGATIVE int32 value (for riding the
@@ -525,6 +519,14 @@ def sort_merge_inner_join(
     # reconstructed exactly from the output words. This runs BEFORE
     # payload defaulting: the companion "<key>#len" columns exist on
     # both sides and the probe's copy wins (keys-from-probe).
+    for k in keys:
+        if build.columns[k].ndim != probe.columns[k].ndim:
+            raise TypeError(
+                f"key {k!r} dimensionality mismatch: build ndim "
+                f"{build.columns[k].ndim} vs probe ndim "
+                f"{probe.columns[k].ndim} (string keys must be 2-D "
+                "uint8 byte columns on BOTH sides)"
+            )
     if any(build.columns[k].ndim == 2 for k in keys):
         from distributed_join_tpu.utils.strings import (
             prepare_string_key_join,

@@ -1,4 +1,8 @@
-"""Pallas alternating-orientation merge sort for the join's big sorts.
+"""Pallas alternating-orientation merge sort (EXPERIMENTAL — not wired
+into the production join; committed as the measured falsification
+artifact for the round-2 radix-sort estimate, docs/ROOFLINE.md §6:
+it lands at ~168 ms vs lax.sort's 166 ms at 20M, parity not victory,
+so ops/join.py keeps lax.sort).
 
 The reference's local join delegates sorting/hashing to cuDF GPU
 kernels (SURVEY.md §2 "Local join step"); this framework's equivalent

@@ -235,6 +235,14 @@ def prepare_string_key_join(build, probe, keys, build_payload,
     empty spec = no string keys."""
     from distributed_join_tpu.table import Table
 
+    for k in keys:
+        if build.columns[k].ndim != probe.columns[k].ndim:
+            raise TypeError(
+                f"key {k!r} dimensionality mismatch: build ndim "
+                f"{build.columns[k].ndim} vs probe ndim "
+                f"{probe.columns[k].ndim} (string keys must be 2-D "
+                "uint8 byte columns on BOTH sides)"
+            )
     str_keys = [k for k in keys if build.columns[k].ndim == 2]
     if not str_keys:
         return build, probe, keys, build_payload, probe_payload, []

@@ -260,3 +260,21 @@ def test_user_sk_pattern_column_rejected():
                jnp.ones(8, bool))
     with pytest.raises(ValueError, match="reserved"):
         sort_merge_inner_join(b2, p2, "key", 64)
+
+
+def test_mixed_dimensionality_key_raises_typeerror():
+    """A 2-D key on one side with a 1-D key on the other must raise a
+    TypeError naming the ndim mismatch — not IndexError deep in the
+    packed-word split (2-D build / 1-D probe) or a silent bypass of
+    string-key detection (1-D build / 2-D probe). Advisor r3 finding."""
+    by, bl = encode_strings(["aa", "bb", "cc"], 8)
+    scalar = jnp.array([1, 2, 3], dtype=jnp.int64)
+    pay = jnp.array([7, 8, 9], dtype=jnp.int64)
+    b_str = Table.from_dense({"k": by, "k#len": bl, "bp": pay})
+    p_scalar = Table.from_dense({"k": scalar, "pp": pay})
+    with pytest.raises(TypeError, match="ndim"):
+        sort_merge_inner_join(b_str, p_scalar, "k", 16)
+    b_scalar = Table.from_dense({"k": scalar, "bp": pay})
+    p_str = Table.from_dense({"k": by, "k#len": bl, "pp": pay})
+    with pytest.raises(TypeError, match="ndim"):
+        sort_merge_inner_join(b_scalar, p_str, "k", 16)
