@@ -76,11 +76,14 @@ def parse_args(argv=None):
                    help="accepted for reference CLI parity; ignored — XLA "
                         "owns TPU memory, there is no RDMA registration")
     p.add_argument("--compression", action="store_true",
-                   help="accepted for reference CLI parity; MEASURED and "
-                        "dropped on this hardware: the FoR+bitpack codec "
-                        "(ops/compression.py) breaks even only below "
-                        "~7 GB/s of wire bandwidth "
+                   help="FoR+bitpack the integer columns on the shuffle "
+                        "wire (the reference's nvcomp path). Opt-in for "
+                        "slow links: the codec breaks even only below "
+                        "~7 GB/s of wire bandwidth, well under ICI "
                         "(results/compression_for_bitpack.json)")
+    p.add_argument("--compression-bits", type=int, default=16,
+                   help="packed residual width for --compression "
+                        "(2/4/8/16/32; overflow auto-retries wider)")
     # -- framework flags ------------------------------------------------
     p.add_argument("--n-ranks", type=int, default=None,
                    help="mesh size; default all visible devices")
@@ -130,11 +133,11 @@ def run(args) -> dict:
         print(f"note: --registration-method={args.registration_method} "
               "ignored (no RDMA registration on TPU)", file=sys.stderr)
     if args.compression:
-        print("note: --compression ignored: measured break-even wire "
-              "bandwidth is ~5-7 GB/s (results/"
-              "compression_for_bitpack.json), below both ICI and "
-              "typical DCN; the codec (ops/compression.py) is wired "
-              "for sub-breakeven links only", file=sys.stderr)
+        print("note: --compression ON (FoR+bitpack, "
+              f"bits={args.compression_bits}); measured break-even "
+              "wire bandwidth is ~5-7 GB/s (results/"
+              "compression_for_bitpack.json) — above that, raw is "
+              "faster", file=sys.stderr)
 
     comm = make_communicator(args.communicator, n_ranks=args.n_ranks)
     n = comm.n_ranks
@@ -196,6 +199,9 @@ def run(args) -> dict:
         comm,
         key=join_key,
         shuffle=args.shuffle,
+        compression_bits=(
+            args.compression_bits if args.compression else None
+        ),
         kernel_config=_kernel_config_from_args(args),
         over_decomposition=args.over_decomposition_factor,
         shuffle_capacity_factor=args.shuffle_capacity_factor,
@@ -223,6 +229,9 @@ def run(args) -> dict:
         "selectivity": args.selectivity,
         "over_decomposition_factor": args.over_decomposition_factor,
         "shuffle": args.shuffle,
+        "compression_bits": (
+            args.compression_bits if args.compression else None
+        ),
         "expand_kernel": args.expand_kernel,
         "compact_kernel": args.compact_kernel,
         "kernel_block": args.kernel_block,

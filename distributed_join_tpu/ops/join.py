@@ -519,14 +519,9 @@ def sort_merge_inner_join(
     # reconstructed exactly from the output words. This runs BEFORE
     # payload defaulting: the companion "<key>#len" columns exist on
     # both sides and the probe's copy wins (keys-from-probe).
-    for k in keys:
-        if build.columns[k].ndim != probe.columns[k].ndim:
-            raise TypeError(
-                f"key {k!r} dimensionality mismatch: build ndim "
-                f"{build.columns[k].ndim} vs probe ndim "
-                f"{probe.columns[k].ndim} (string keys must be 2-D "
-                "uint8 byte columns on BOTH sides)"
-            )
+    from distributed_join_tpu.utils.strings import check_key_ndim
+
+    check_key_ndim(build, probe, keys)
     if any(build.columns[k].ndim == 2 for k in keys):
         from distributed_join_tpu.utils.strings import (
             prepare_string_key_join,

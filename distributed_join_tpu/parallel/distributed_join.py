@@ -41,6 +41,7 @@ from distributed_join_tpu.ops.partition import radix_hash_partition
 from distributed_join_tpu.parallel.communicator import Communicator
 from distributed_join_tpu.parallel.shuffle import (
     shuffle_padded,
+    shuffle_padded_compressed,
     shuffle_ragged,
 )
 from distributed_join_tpu.table import Table
@@ -57,7 +58,8 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
-                   mode: str = "padded"):
+                   mode: str = "padded",
+                   compression_bits: Optional[int] = None):
     if mode == "ragged":
         # Exact-size exchange: receive buffer = the same total rows the
         # padded layout would flatten to, but wire bytes = actual rows.
@@ -70,10 +72,14 @@ def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
     padded, counts, overflow, _ = pt.to_padded(
         capacity, bucket_start=batch * n_ranks, n_buckets=n_ranks
     )
-    table, _ = shuffle_padded(
-        comm, padded, counts, capacity,
-        via="ppermute" if mode == "ppermute" else "all_to_all",
-    )
+    via = "ppermute" if mode == "ppermute" else "all_to_all"
+    if compression_bits is not None:
+        table, _, c_ovf = shuffle_padded_compressed(
+            comm, padded, counts, capacity, bits=compression_bits,
+            via=via,
+        )
+        return table, overflow | c_ovf
+    table, _ = shuffle_padded(comm, padded, counts, capacity, via=via)
     return table, overflow
 
 
@@ -91,6 +97,7 @@ def make_join_step(
     hh_build_capacity: Optional[int] = None,
     hh_out_capacity: Optional[int] = None,
     shuffle: str = "padded",
+    compression_bits: Optional[int] = None,
     kernel_config=None,
 ):
     """The raw per-rank join step (partition -> shuffle -> local join).
@@ -100,6 +107,14 @@ def make_join_step(
     actual rows), or "ppermute" (padded blocks over a
     collective-permute chain whose lowering the scheduler can overlap
     with compute; docs/OVERLAP.md).
+
+    ``compression_bits``: when set, integer columns ride the padded/
+    ppermute shuffle FoR+bitpacked at this width (the reference's
+    ``--compression`` / nvcomp path; shuffle.shuffle_padded_compressed).
+    A residual wider than ``bits`` raises the overflow flag —
+    ``auto_retry`` widens up to 32 — never corrupts rows. Opt-in only:
+    measured break-even wire bandwidth (~5-7 GB/s,
+    results/compression_for_bitpack.json) is below ICI.
 
     ONE capacity contract across all modes: the unit of capacity is
     the per-(sender, destination) bucket,
@@ -151,6 +166,12 @@ def make_join_step(
         # reaches the shuffle, and a typo'd mode must not silently
         # report success.
         raise ValueError(f"unknown shuffle mode {shuffle!r}")
+    if compression_bits is not None and shuffle == "ragged":
+        raise ValueError(
+            "compression applies to the padded/ppermute shuffles; the "
+            "ragged exchange already sends exact rows (combining the "
+            "two is unimplemented)"
+        )
     nb = k * n
 
     keys = [key] if isinstance(key, str) else list(key)
@@ -261,9 +282,11 @@ def make_join_step(
             ptp = radix_hash_partition(probe_local, keys_eff, nb)
             for b in range(k):
                 recv_build, ovf_b = _batch_shuffle(
-                    comm, ptb, b, n, b_cap, mode=shuffle)
+                    comm, ptb, b, n, b_cap, mode=shuffle,
+                    compression_bits=compression_bits)
                 recv_probe, ovf_p = _batch_shuffle(
-                    comm, ptp, b, n, p_cap, mode=shuffle)
+                    comm, ptp, b, n, p_cap, mode=shuffle,
+                    compression_bits=compression_bits)
                 res = sort_merge_inner_join(
                     recv_build, recv_probe, keys_eff, out_cap,
                     build_payload=bpay, probe_payload=ppay,
@@ -345,6 +368,7 @@ def distributed_inner_join(
         )
         hh_out_cap = hh_out_cap or max(probe.capacity // (2 * n), 1024)
     out_rows = opts.pop("out_rows_per_rank", None)
+    comp_bits = opts.pop("compression_bits", None)
     for attempt in range(auto_retry + 1):
         fn = make_distributed_join(
             comm, key=key,
@@ -353,6 +377,7 @@ def distributed_inner_join(
             out_rows_per_rank=out_rows,
             hh_build_capacity=hh_build_cap,
             hh_out_capacity=hh_out_cap,
+            compression_bits=comp_bits,
             **opts,
         )
         res = fn(build, probe)
@@ -367,4 +392,8 @@ def distributed_inner_join(
         if skew_on:
             hh_build_cap *= 2
             hh_out_cap *= 2
+        if comp_bits is not None and comp_bits < 32:
+            # Overflow may also mean a codec block's residual outgrew
+            # the packed width; widening is the codec's retry axis.
+            comp_bits = min(comp_bits * 2, 32)
     raise AssertionError("unreachable")

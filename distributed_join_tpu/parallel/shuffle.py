@@ -62,6 +62,92 @@ def shuffle_padded(
     return unpad(recv_cols, recv_counts, capacity), recv_counts
 
 
+def shuffle_padded_compressed(
+    comm: Communicator, padded_columns, counts: jax.Array, capacity: int,
+    bits: int, block: int = 256, via: str = "all_to_all",
+) -> Tuple[Table, jax.Array, jax.Array]:
+    """Padded shuffle with the FoR+bitpack codec on the wire.
+
+    The reference's ``--compression`` path: compress each partition
+    buffer before the all-to-all, decompress after (SURVEY.md §2
+    "nvcomp compression"). Here every integer column's per-destination
+    block is encoded row-wise (one frame stream per destination, so
+    the all-to-all's leading-axis redistribution never splits a codec
+    block across destinations), the uint32 word + int64 frame planes
+    ride the collective, and receivers decode.
+
+    Static shapes force the codec's compile-time ``bits`` contract
+    (ops/compression.py): a block whose frame-of-reference residual
+    exceeds ``bits`` cannot pack losslessly, so the returned
+    ``compression_overflow`` flag fires and the caller must retry
+    wider (``distributed_inner_join``'s auto_retry doubles bits up to
+    32) — rows are never silently corrupted. Measured economics:
+    break-even wire bandwidth is ~5-7 GB/s
+    (results/compression_for_bitpack.json) — below ICI, so this is an
+    opt-in for slow (DCN-class) links, off by default.
+
+    Returns ``(received table, received counts, compression_overflow)``.
+    """
+    from distributed_join_tpu.ops.compression import (
+        Packed,
+        for_bitpack_decode,
+        for_bitpack_encode,
+    )
+    from distributed_join_tpu.utils.strings import _WORD_PREFIX
+
+    a2a = (
+        comm.ppermute_all_to_all if via == "ppermute" else comm.all_to_all
+    )
+    recv_counts = comm.all_to_all(counts)
+    n_ranks = counts.shape[0]
+    lane = jnp.arange(capacity, dtype=jnp.int32)
+    row_valid = lane[None, :] < counts[:, None]
+    c_ovf = jnp.bool_(False)
+    recv_cols = {}
+    for name, col in padded_columns.items():
+        compressible = (
+            col.ndim == 2
+            and jnp.issubdtype(col.dtype, jnp.integer)
+            and col.dtype.itemsize >= 4
+            # The packed string-key word columns are big-endian byte
+            # packs: per-block spans ~2^40+, wider than any packable
+            # width — they would overflow at every bits, so they ride
+            # raw by construction.
+            and not name.startswith(_WORD_PREFIX)
+        )
+        if not compressible:
+            # uint8 string payload planes etc. ride raw.
+            recv_cols[name] = a2a(col)
+            continue
+
+        # Padding slots hold clipped-gather garbage (possibly a mix of
+        # a neighboring bucket's rows and the table's zero pad rows) —
+        # a block mixing 0 with large-magnitude real values would blow
+        # the residual span for data whose REAL residuals are tiny.
+        # Fill them with the bucket's last valid row instead (residual
+        # 0 against a real frame, the codec's own padding trick).
+        fill = col[jnp.arange(n_ranks), jnp.maximum(counts - 1, 0)]
+        col = jnp.where(row_valid, col, fill[:, None])
+
+        def _enc(row):
+            p = for_bitpack_encode(row, bits, block)
+            return p.words, p.frames, p.overflow
+
+        words, frames, ovf = jax.vmap(_enc)(col)
+        c_ovf = c_ovf | jnp.any(ovf)
+        rwords, rframes = a2a(words), a2a(frames)
+
+        def _dec(w, f, dt=col.dtype):
+            return for_bitpack_decode(
+                Packed(w, f, None, None, n=capacity, bits=bits,
+                       block=block),
+                dtype=dt,
+            )
+
+        recv_cols[name] = jax.vmap(_dec)(rwords, rframes)
+    return unpad(recv_cols, recv_counts, capacity), recv_counts, c_ovf
+
+
 def ragged_plan(comm: Communicator, counts: jax.Array, out_capacity: int,
                 capacity_per_bucket: int | None = None):
     """Phase 1 of the exact-size shuffle: from each rank's (n,) count

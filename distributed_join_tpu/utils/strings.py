@@ -156,6 +156,21 @@ def unpack_string_key(words, max_len: int):
     return jnp.stack(cols, axis=1)
 
 
+def check_key_ndim(build, probe, keys):
+    """Raise TypeError if any key column's dimensionality differs
+    between sides — 2-D build / 1-D probe used to IndexError deep in
+    the packed-word split, and 1-D build / 2-D probe silently bypassed
+    string-key detection (advisor r3)."""
+    for k in keys:
+        if build.columns[k].ndim != probe.columns[k].ndim:
+            raise TypeError(
+                f"key {k!r} dimensionality mismatch: build ndim "
+                f"{build.columns[k].ndim} vs probe ndim "
+                f"{probe.columns[k].ndim} (string keys must be 2-D "
+                "uint8 byte columns on BOTH sides)"
+            )
+
+
 def split_string_keys(build, probe, keys):
     """Replace 2-D uint8 key columns with packed word columns in both
     tables. Returns ``(build2, probe2, keys2, spec)`` where ``spec``
@@ -235,14 +250,7 @@ def prepare_string_key_join(build, probe, keys, build_payload,
     empty spec = no string keys."""
     from distributed_join_tpu.table import Table
 
-    for k in keys:
-        if build.columns[k].ndim != probe.columns[k].ndim:
-            raise TypeError(
-                f"key {k!r} dimensionality mismatch: build ndim "
-                f"{build.columns[k].ndim} vs probe ndim "
-                f"{probe.columns[k].ndim} (string keys must be 2-D "
-                "uint8 byte columns on BOTH sides)"
-            )
+    check_key_ndim(build, probe, keys)
     str_keys = [k for k in keys if build.columns[k].ndim == 2]
     if not str_keys:
         return build, probe, keys, build_payload, probe_payload, []

@@ -24,3 +24,16 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: most of the suite's wall time is XLA
+# compiling the same 8-device shard_map programs run after run (this
+# box has ONE cpu core — no xdist escape). First run populates, repeat
+# runs replay. Safe to delete the dir at any time.
+jax.config.update("jax_compilation_cache_dir", "/tmp/djtpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+try:
+    jax.config.update("jax_persistent_cache_enable_xla_caches",
+                      "all")
+except Exception:  # pragma: no cover - older jax
+    pass

@@ -49,6 +49,7 @@ def test_plane_compact_matches_reference(n, density, capacity):
         )
 
 
+@pytest.mark.slow
 def test_join_kernel_path_with_plane_compact():
     """CPU-runnable integration of the join's kernel path with the
     plane compaction (the production default on TPU): interpret mode,

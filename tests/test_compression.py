@@ -53,3 +53,127 @@ def test_negative_and_constant_blocks():
     p = for_bitpack_encode(jnp.asarray(x), 16)
     assert not bool(p.overflow)
     np.testing.assert_array_equal(np.asarray(for_bitpack_decode(p)), x)
+
+
+# -- the wired path: compression riding the distributed shuffle --------
+
+def _small_tables(rand_max=1500, seed=7):
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+    )
+    return generate_build_probe_tables(
+        seed=seed, build_nrows=4096, probe_nrows=8192,
+        rand_max=rand_max, selectivity=0.5,
+    )
+
+
+def test_compressed_shuffle_join_matches_oracle():
+    """--compression wired end-to-end: integer columns ride the padded
+    shuffle FoR+bitpacked and the join still matches the pandas oracle
+    exactly (VERDICT r3 missing #3)."""
+    import distributed_join_tpu as dj
+
+    b, p = _small_tables()
+    res = dj.distributed_inner_join(
+        b, p, dj.make_communicator("tpu", n_ranks=8),
+        out_capacity_factor=3.0, shuffle_capacity_factor=2.5,
+        compression_bits=16,
+    )
+    assert not bool(res.overflow)
+    want = b.to_pandas().merge(p.to_pandas(), on="key")
+    got = res.table.to_pandas()
+    assert len(got) == len(want)
+    lhs = got.sort_values(list(got.columns)).reset_index(drop=True)
+    rhs = want[list(got.columns)].sort_values(
+        list(got.columns)).reset_index(drop=True)
+    assert lhs.equals(rhs)
+
+
+@pytest.mark.slow  # auto_retry ladder = several 8-device compiles
+def test_compressed_shuffle_overflow_retries_wider():
+    """Keys spanning more than 2**bits: the codec overflow flag must
+    fire (not corrupt rows), and auto_retry's bits-doubling ladder must
+    land an exact result."""
+    import distributed_join_tpu as dj
+
+    b, p = _small_tables(rand_max=1 << 24, seed=11)
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    res_narrow = dj.distributed_inner_join(
+        b, p, comm, out_capacity_factor=3.0,
+        shuffle_capacity_factor=2.5, compression_bits=4,
+    )
+    assert bool(res_narrow.overflow)
+    res = dj.distributed_inner_join(
+        b, p, comm, out_capacity_factor=3.0,
+        shuffle_capacity_factor=2.5, compression_bits=4, auto_retry=4,
+    )
+    assert not bool(res.overflow)
+    want = b.to_pandas().merge(p.to_pandas(), on="key")
+    assert int(res.total) == len(want)
+
+
+def test_compression_rejected_with_ragged():
+    import distributed_join_tpu as dj
+    from distributed_join_tpu.parallel.distributed_join import (
+        make_join_step,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    with pytest.raises(ValueError, match="ragged"):
+        make_join_step(comm, shuffle="ragged", compression_bits=16)
+
+
+def test_compressed_shuffle_string_key_rides_raw():
+    """String join keys become uint64 packed-word columns whose spans
+    exceed any packable width — they must ride the wire raw (by name
+    prefix), not permanently overflow (review r4 finding)."""
+    import distributed_join_tpu as dj
+    from distributed_join_tpu.table import Table
+    from distributed_join_tpu.utils.strings import encode_strings
+
+    rng = np.random.default_rng(5)
+    names = [f"widget-{i:05d}" for i in range(256)]
+    bsel = rng.integers(0, 256, 1024)
+    psel = rng.integers(0, 256, 2048)
+    bby, bbl = encode_strings([names[i] for i in bsel], 16)
+    pby, ppl = encode_strings([names[i] for i in psel], 16)
+    b = Table.from_dense({"k": bby, "k#len": bbl,
+                          "bp": jnp.asarray(bsel, jnp.int64)})
+    p = Table.from_dense({"k": pby, "k#len": ppl,
+                          "pp": jnp.asarray(psel, jnp.int64)})
+    res = dj.distributed_inner_join(
+        b, p, dj.make_communicator("tpu", n_ranks=8), "k",
+        out_capacity_factor=16.0, shuffle_capacity_factor=4.0,
+        compression_bits=16,
+    )
+    assert not bool(res.overflow)
+    import pandas as pd
+    want = len(pd.DataFrame({"k": bsel}).merge(
+        pd.DataFrame({"k": psel}), on="k"))
+    assert int(res.total) == want
+
+
+def test_compressed_shuffle_pad_slots_masked():
+    """Large-magnitude values with tiny spread (epoch-nanosecond-style)
+    must compress: padding slots are filled with the bucket's last
+    valid row, so a block never mixes clipped-gather zeros with real
+    values (review r4 finding)."""
+    import distributed_join_tpu as dj
+    from distributed_join_tpu.table import Table
+
+    base = 1_700_000_000_000_000_000
+    rng = np.random.default_rng(9)
+    bk = base + rng.integers(0, 200, 4096).astype(np.int64)
+    pk = base + rng.integers(0, 200, 4099).astype(np.int64)  # pad_to pads
+    b = Table.from_dense({"key": jnp.asarray(bk),
+                          "bp": jnp.asarray(bk - base)})
+    p = Table.from_dense({"key": jnp.asarray(pk),
+                          "pp": jnp.asarray(pk - base)})
+    res = dj.distributed_inner_join(
+        b, p, dj.make_communicator("tpu", n_ranks=8),
+        out_capacity_factor=50.0, shuffle_capacity_factor=3.0,
+        compression_bits=8,
+    )
+    assert not bool(res.overflow)
+    want = len(b.to_pandas().merge(p.to_pandas(), on="key"))
+    assert int(res.total) == want

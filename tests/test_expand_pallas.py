@@ -169,6 +169,7 @@ def test_window_checker_flags_gap_data():
     assert not bool(build_windows_ok(S, lo, out_cap, block=256))
 
 
+@pytest.mark.slow
 def test_join_level_gap_data_falls_back_exact(monkeypatch):
     """Join-level oracle on data with mostly-unmatched build keys
     (sparse probe hits over a wide key domain): the cond must route to
@@ -195,6 +196,7 @@ def test_join_level_gap_data_falls_back_exact(monkeypatch):
     pd.testing.assert_frame_equal(got[want.columns], want)
 
 
+@pytest.mark.slow
 def test_join_kernel_path_fallback_branch_exact(monkeypatch):
     """Force build_windows_ok False so the lax.cond in
     _join_kernel_path takes the XLA-gather fallback branch (the
@@ -260,6 +262,7 @@ def test_expand_empty():
     assert out[0].shape == (64,)
 
 
+@pytest.mark.slow
 def test_join_level_pallas_path_matches_oracle(monkeypatch):
     """The join-level wiring of the kernel (u64 lane encode/decode per
     dtype, the __lo geometry lane, start_b riding as the S lane) — CPU

@@ -7,6 +7,8 @@ import jax.numpy as jnp
 
 from distributed_join_tpu.ops.expand_planes import expand_pull
 
+pytestmark = pytest.mark.slow  # experimental kernel, interpret-mode minutes
+
 I32_MAX = 2**31 - 1
 BLOCK = 2048
 

@@ -77,6 +77,7 @@ def _oracle(build, probe):
     return len(build.to_pandas().merge(probe.to_pandas(), on="key"))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("over_decomposition", [1, 2])
 def test_zipf_join_with_skew_handling(over_decomposition):
     comm = dj.make_communicator("tpu", n_ranks=8)
@@ -102,6 +103,7 @@ def test_zipf_join_with_skew_handling(over_decomposition):
     assert int(res.total) == _oracle(build, probe)
 
 
+@pytest.mark.slow
 def test_zipf_skew_relieves_shuffle_padding():
     """The point of the skew path: a hot key that overflows the padded
     shuffle at a tight capacity factor must fit once HH rows bypass it."""
@@ -128,6 +130,7 @@ def test_zipf_skew_relieves_shuffle_padding():
     assert int(skewed.total) == _oracle(build, probe)
 
 
+@pytest.mark.slow
 def test_auto_retry_recovers_from_overflow():
     comm = dj.make_communicator("tpu", n_ranks=8)
     rows, rand_max = 8192, 2048

@@ -23,6 +23,8 @@ from distributed_join_tpu.ops.sort_pallas import (
     val_to_planes,
 )
 
+pytestmark = pytest.mark.slow  # experimental kernel, interpret-mode minutes
+
 TILE = 1024
 
 
