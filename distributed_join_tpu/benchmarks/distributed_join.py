@@ -108,8 +108,11 @@ def parse_args(argv=None):
                         "fraction of one rank's probe rows")
     p.add_argument("--hh-slots", type=int, default=64,
                    help="static heavy-hitter key slots")
+    p.add_argument("--hh-probe-capacity", type=int, default=None,
+                   help="HH probe block rows per rank (default 1/8 of "
+                        "local probe rows; size up for heavy Zipf)")
     p.add_argument("--hh-out-capacity", type=int, default=None,
-                   help="HH-path output rows per rank (default half "
+                   help="HH-path output rows per rank (default 1/4 of "
                         "the local probe rows; size up for heavy Zipf)")
     p.add_argument("--key-columns", type=int, default=1,
                    help=">1 joins on a composite multi-column key "
@@ -125,6 +128,30 @@ def parse_args(argv=None):
                    help="also write the result record to this file")
     add_platform_arg(p)
     return p.parse_args(argv)
+
+
+def _string_wire_accounting(build, shuffle_mode):
+    """Exact vs fixed-width wire bytes for the build side's string
+    payload column (the byte-exact plane exchange runs in ragged mode;
+    parallel/shuffle.shuffle_ragged varwidth)."""
+    import numpy as np
+
+    from distributed_join_tpu.parallel.distributed_join import (
+        _varwidth_col,
+    )
+
+    name = _varwidth_col(build)
+    if name is None:
+        return None
+    col = build.columns[name]
+    lens = np.asarray(build.columns[name + "#len"])
+    exact = int(((lens.astype(np.int64) + 3) // 4 * 4).sum())
+    return {
+        "column": name,
+        "fixed_width_bytes": int(col.shape[0]) * int(col.shape[1]),
+        "exact_bytes": exact,
+        "byte_exact_on_wire": shuffle_mode == "ragged",
+    }
 
 
 def run(args) -> dict:
@@ -208,6 +235,7 @@ def run(args) -> dict:
         out_capacity_factor=args.out_capacity_factor,
         skew_threshold=args.skew_threshold,
         hh_slots=args.hh_slots,
+        hh_probe_capacity=args.hh_probe_capacity,
         hh_out_capacity=args.hh_out_capacity,
     )
     iters = args.iterations
@@ -240,6 +268,7 @@ def run(args) -> dict:
         "key_columns": args.key_columns,
         "string_payload_bytes": args.string_payload_bytes,
         "string_key_bytes": args.string_key_bytes,
+        "string_wire_bytes": _string_wire_accounting(build, args.shuffle),
         "matches_per_join": matches,
         "overflow": overflow,
         "elapsed_per_join_s": sec_per_join,

@@ -98,9 +98,17 @@ class PartitionedTable:
 
 
 def radix_hash_partition(
-    table: Table, key_cols: Sequence[str], n_buckets: int
+    table: Table, key_cols: Sequence[str], n_buckets: int,
+    order_within: str | None = None,
 ) -> PartitionedTable:
-    """Partition ``table`` into ``n_buckets`` by hash of ``key_cols``."""
+    """Partition ``table`` into ``n_buckets`` by hash of ``key_cols``.
+
+    ``order_within`` names a 1-D integer column; when given, rows
+    within each bucket additionally sort by it DESCENDING. The
+    variable-width string wire (parallel/shuffle.shuffle_ragged's
+    ``varwidth``) relies on this: with rows ordered by byte length
+    desc, the rows still alive at u32 word-plane ``w`` form a PREFIX
+    of every bucket, so each plane ships as one ragged slice."""
     b = bucket_ids([table.columns[c] for c in key_cols], n_buckets)
     # Padding rows get bucket n_buckets so they sort after every real bucket.
     b = jnp.where(table.valid, b, jnp.int32(n_buckets))
@@ -108,11 +116,22 @@ def radix_hash_partition(
     # jnp.argsort, whose x64-mode int64 iota operand would double every
     # sort lane on TPU (emulated 64-bit).
     n = b.shape[0]
-    sorted_b, order = jax.lax.sort(
-        (b, jnp.arange(n, dtype=jnp.int32)), num_keys=1, is_stable=True
+    operands = [b]
+    if order_within is not None:
+        oc = table.columns[order_within]
+        if oc.ndim != 1 or not jnp.issubdtype(oc.dtype, jnp.integer):
+            raise TypeError(
+                f"order_within column {order_within!r} must be a 1-D "
+                f"integer column, got ndim={oc.ndim} dtype={oc.dtype}"
+            )
+        operands.append(-oc.astype(jnp.int32))
+    operands.append(jnp.arange(n, dtype=jnp.int32))
+    *sorted_ops, order = jax.lax.sort(
+        tuple(operands), num_keys=len(operands) - 1, is_stable=True
     )
     offsets = jnp.searchsorted(
-        sorted_b, jnp.arange(n_buckets + 1, dtype=jnp.int32), side="left"
+        sorted_ops[0], jnp.arange(n_buckets + 1, dtype=jnp.int32),
+        side="left",
     ).astype(jnp.int32)
     counts = jnp.diff(offsets)
     return PartitionedTable(table, order, offsets, counts)

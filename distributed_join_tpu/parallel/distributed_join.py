@@ -57,9 +57,23 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _varwidth_col(table: Table) -> Optional[str]:
+    """First 2-D uint8 column with a '<name>#len' companion and
+    4-aligned width — the column the ragged shuffle ships byte-exactly
+    (one per table; any further string columns ride row-exact
+    fixed-width)."""
+    for name, c in table.columns.items():
+        if (c.ndim == 2 and c.dtype == jnp.uint8
+                and c.shape[1] % 4 == 0
+                and name + "#len" in table.columns):
+            return name
+    return None
+
+
 def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
                    mode: str = "padded",
-                   compression_bits: Optional[int] = None):
+                   compression_bits: Optional[int] = None,
+                   varwidth: Optional[str] = None):
     if mode == "ragged":
         # Exact-size exchange: receive buffer = the same total rows the
         # padded layout would flatten to, but wire bytes = actual rows.
@@ -67,7 +81,7 @@ def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
         # mode: auto_retry fires under identical conditions.
         return shuffle_ragged(
             comm, pt, n_ranks * capacity, bucket_start=batch * n_ranks,
-            capacity_per_bucket=capacity,
+            capacity_per_bucket=capacity, varwidth=varwidth,
         )
     padded, counts, overflow, _ = pt.to_padded(
         capacity, bucket_start=batch * n_ranks, n_buckets=n_ranks
@@ -95,6 +109,7 @@ def make_join_step(
     skew_threshold: Optional[float] = None,
     hh_slots: int = DEFAULT_HH_SLOTS,
     hh_build_capacity: Optional[int] = None,
+    hh_probe_capacity: Optional[int] = None,
     hh_out_capacity: Optional[int] = None,
     shuffle: str = "padded",
     compression_bits: Optional[int] = None,
@@ -147,14 +162,16 @@ def make_join_step(
     Skew handling (BASELINE config 3; :mod:`..parallel.skew`): pass
     ``skew_threshold`` — a key becomes a heavy hitter when its global
     probe count exceeds ``skew_threshold * local_probe_rows``. HH probe
-    rows skip the shuffle and stay local; HH build rows are broadcast
-    (``hh_build_capacity`` slots per rank, default ``hh_slots * 32``)
-    and joined locally into an extra output block of
-    ``hh_out_capacity`` rows (default: HALF of local probe rows — a
-    full-probe-size block doubled peak memory whether or not skew
-    existed). Heavy-hitter mass above that (Zipf alpha >= ~1.4 puts
-    ~90% of probe rows in the top keys) overflows and is caught by the
-    flag / ``auto_retry`` doubling; size it explicitly for known-heavy
+    rows skip the shuffle and stay local, compacted into an
+    ``hh_probe_capacity`` block (default 1/8 of local probe rows —
+    streaming-kernel packed on TPU, so the HH join's cost scales with
+    the block, not the full probe; round-3 VERDICT #2); HH build rows
+    are broadcast (``hh_build_capacity`` slots per rank, default
+    ``hh_slots * 32``) and joined locally into an extra output block
+    of ``hh_out_capacity`` rows (default 1/4 of local probe rows).
+    Heavy-hitter mass above these (Zipf alpha >= ~1.4 puts ~90% of
+    probe rows in the top keys) overflows and is caught by the flag /
+    ``auto_retry`` doubling; size them explicitly for known-heavy
     workloads.
     """
     n = comm.n_ranks
@@ -243,19 +260,32 @@ def make_join_step(
             hh_build, ovf_hb = skew.broadcast_heavy_build(
                 comm, build_local, is_hh_b,
                 hh_build_capacity or hh_slots * HH_BUILD_SLOTS_PER_HH,
+                kernel_config=kernel_config,
             )
-            # HH probe rows stay local: same arrays, narrowed validity.
-            hh_probe = Table(probe_local.columns, probe_local.valid & is_hh_p)
+            # HH probe rows stay local, COMPACTED into a right-sized
+            # block first (round-3 VERDICT #2: narrowing validity on
+            # the full-capacity arrays made the HH join re-sort all
+            # p_rows to join a typically-tiny subset — the whole HH
+            # path then cost ~90% of the join even with zero heavy
+            # keys). Overflowing the block raises the flag;
+            # auto_retry doubles it like every other capacity.
+            hh_probe_cap = _round_up(
+                hh_probe_capacity or max(p_rows // 8, 1024), 8
+            )
+            hh_probe, _, ovf_hp = skew.extract_prefix(
+                probe_local, probe_local.valid & is_hh_p, hh_probe_cap,
+                kernel_config=kernel_config,
+            )
             hh_res = sort_merge_inner_join(
                 hh_build, hh_probe, keys_eff,
-                hh_out_capacity or max(p_rows // 2, 1024),
+                hh_out_capacity or max(p_rows // 4, 1024),
                 build_payload=bpay, probe_payload=ppay,
                 kernel_config=kernel_config,
                 _internal=sk_names,
             )
             parts.append(hh_res.table)
             total = total + hh_res.total.astype(jnp.int64)
-            overflow = overflow | ovf_hb | hh_res.overflow
+            overflow = overflow | ovf_hb | ovf_hp | hh_res.overflow
             # The normal path sees neither side's HH rows.
             build_local = Table(build_local.columns,
                                 build_local.valid & ~is_hh_b)
@@ -278,15 +308,26 @@ def make_join_step(
             total = total + res.total.astype(jnp.int64)
             overflow = overflow | res.overflow
         else:
-            ptb = radix_hash_partition(build_local, keys_eff, nb)
-            ptp = radix_hash_partition(probe_local, keys_eff, nb)
+            # Byte-exact string wire (ragged mode): order each bucket
+            # by the string column's length desc so its u32 planes
+            # ship as ragged prefixes (shuffle_ragged's varwidth).
+            vb = _varwidth_col(build_local) if shuffle == "ragged" \
+                else None
+            vp = _varwidth_col(probe_local) if shuffle == "ragged" \
+                else None
+            ptb = radix_hash_partition(
+                build_local, keys_eff, nb,
+                order_within=vb + "#len" if vb else None)
+            ptp = radix_hash_partition(
+                probe_local, keys_eff, nb,
+                order_within=vp + "#len" if vp else None)
             for b in range(k):
                 recv_build, ovf_b = _batch_shuffle(
                     comm, ptb, b, n, b_cap, mode=shuffle,
-                    compression_bits=compression_bits)
+                    compression_bits=compression_bits, varwidth=vb)
                 recv_probe, ovf_p = _batch_shuffle(
                     comm, ptp, b, n, p_cap, mode=shuffle,
-                    compression_bits=compression_bits)
+                    compression_bits=compression_bits, varwidth=vp)
                 res = sort_merge_inner_join(
                     recv_build, recv_probe, keys_eff, out_cap,
                     build_payload=bpay, probe_payload=ppay,
@@ -361,12 +402,14 @@ def distributed_inner_join(
     # overflow can originate in the skew path as well as the shuffle.
     skew_on = opts.get("skew_threshold") is not None
     hh_build_cap = opts.pop("hh_build_capacity", None)
+    hh_probe_cap = opts.pop("hh_probe_capacity", None)
     hh_out_cap = opts.pop("hh_out_capacity", None)
     if skew_on:
         hh_build_cap = hh_build_cap or (
             opts.get("hh_slots", DEFAULT_HH_SLOTS) * HH_BUILD_SLOTS_PER_HH
         )
-        hh_out_cap = hh_out_cap or max(probe.capacity // (2 * n), 1024)
+        hh_probe_cap = hh_probe_cap or max(probe.capacity // (8 * n), 1024)
+        hh_out_cap = hh_out_cap or max(probe.capacity // (4 * n), 1024)
     out_rows = opts.pop("out_rows_per_rank", None)
     comp_bits = opts.pop("compression_bits", None)
     for attempt in range(auto_retry + 1):
@@ -376,6 +419,7 @@ def distributed_inner_join(
             out_capacity_factor=out_f,
             out_rows_per_rank=out_rows,
             hh_build_capacity=hh_build_cap,
+            hh_probe_capacity=hh_probe_cap,
             hh_out_capacity=hh_out_cap,
             compression_bits=comp_bits,
             **opts,
@@ -383,6 +427,16 @@ def distributed_inner_join(
         res = fn(build, probe)
         if attempt == auto_retry or not bool(res.overflow):
             return res
+        if comp_bits is not None and comp_bits < 32:
+            # The flag can't distinguish a codec-width overflow from a
+            # capacity overflow, so the ladder widens the CHEAP axis
+            # first: bits-only recompiles (at most 3: 4->8->16->32)
+            # before any buffer grows — otherwise a pure bits overflow
+            # would inflate every shuffle/out/HH buffer up to 8x for
+            # nothing (review r4). Size auto_retry accordingly when
+            # compressing.
+            comp_bits = min(comp_bits * 2, 32)
+            continue
         # Double every capacity a retry can relieve — out_rows_per_rank
         # supersedes out_capacity_factor when set, so it must scale too.
         shuffle_f *= 2.0
@@ -390,10 +444,13 @@ def distributed_inner_join(
         if out_rows is not None:
             out_rows *= 2
         if skew_on:
+            # The HH defaults are sized for the common mild-skew case
+            # (probe/8); one retry must still cover ANY skew — Zipf
+            # alpha>=1.4 puts ~90% of probe rows in the HH set — so
+            # the skew capacities jump straight to full local probe
+            # coverage rather than creeping by doublings.
+            p_local = probe.capacity // n
             hh_build_cap *= 2
-            hh_out_cap *= 2
-        if comp_bits is not None and comp_bits < 32:
-            # Overflow may also mean a codec block's residual outgrew
-            # the packed width; widening is the codec's retry axis.
-            comp_bits = min(comp_bits * 2, 32)
+            hh_probe_cap = max(hh_probe_cap * 2, p_local)
+            hh_out_cap = max(hh_out_cap * 2, p_local)
     raise AssertionError("unreachable")

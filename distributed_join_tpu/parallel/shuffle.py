@@ -170,6 +170,16 @@ def ragged_plan(comm: Communicator, counts: jax.Array, out_capacity: int,
     under the same conditions in every shuffle mode instead of one
     mode silently accepting a layout another would reject.
     """
+    send_sizes, recv_sizes, output_offsets, total_recv, overflow, _ = \
+        _ragged_plan_matrices(comm, counts, out_capacity,
+                              capacity_per_bucket)
+    return send_sizes, recv_sizes, output_offsets, total_recv, overflow
+
+
+def _ragged_plan_matrices(comm, counts, out_capacity,
+                          capacity_per_bucket=None):
+    """ragged_plan + the full (start, allowed) matrices the
+    variable-width plane exchange needs."""
     n = comm.n_ranks
     me = comm.axis_index()
     # Full count matrix: M[j, i] = rows rank j sends to rank i.
@@ -185,8 +195,8 @@ def ragged_plan(comm: Communicator, counts: jax.Array, out_capacity: int,
     recv_sizes = comm.pvary(allowed[:, me].astype(jnp.int32))
     output_offsets = comm.pvary(start[me, :].astype(jnp.int32))
     total_recv = jnp.sum(recv_sizes)
-    return send_sizes, recv_sizes, output_offsets, total_recv, \
-        comm.pvary(overflow)
+    return (send_sizes, recv_sizes, output_offsets, total_recv,
+            comm.pvary(overflow), (start, allowed))
 
 
 def shuffle_ragged(
@@ -195,6 +205,7 @@ def shuffle_ragged(
     out_capacity: int,
     bucket_start: int = 0,
     capacity_per_bucket: int | None = None,
+    varwidth: str | None = None,
 ) -> Tuple[Table, jax.Array]:
     """Exact-size shuffle of ``n_ranks`` buckets starting at
     ``bucket_start``: wire bytes = actual rows, not padded capacity.
@@ -203,25 +214,95 @@ def shuffle_ragged(
     The received rows pack contiguously in sender-rank order; rows a
     clamped transfer dropped are reported via the flag, never silently
     presented as success.
+
+    ``varwidth`` names a 2-D uint8 string column to ship BYTE-exactly
+    (the reference's offsets+chars children exchange, SURVEY.md §2):
+    rows within each bucket must be partition-ordered by the column's
+    "<name>#len" companion DESCENDING (radix_hash_partition's
+    ``order_within``), so the rows still alive at u32 word-plane ``w``
+    form a prefix of every bucket — each of the column's width/4
+    planes then ships as its own ragged slice of exactly
+    ``ceil(len/4)`` words per row, and reconstruction is free: planes
+    land row-aligned at the receiver's row offsets and the skipped
+    tail slots stay zero, which IS the fixed-width zero-padded
+    representation. Wire bytes for the column drop from
+    ``rows * max_len`` to ``sum(ceil(len/4) * 4)``.
     """
     n = comm.n_ranks
     counts = pt.counts[bucket_start : bucket_start + n].astype(jnp.int32)
     offsets = pt.offsets[bucket_start : bucket_start + n].astype(jnp.int32)
-    send_sizes, recv_sizes, output_offsets, total_recv, overflow = (
-        ragged_plan(comm, counts, out_capacity,
-                    capacity_per_bucket=capacity_per_bucket)
+    (send_sizes, recv_sizes, output_offsets, total_recv, overflow,
+     (start, allowed)) = _ragged_plan_matrices(
+        comm, counts, out_capacity,
+        capacity_per_bucket=capacity_per_bucket,
     )
     # One gather per column materializes the bucket-sorted layout the
     # input offsets point into (no padding, unlike to_padded).
     sorted_table = pt.table
     out_cols = {}
     for name, col in sorted_table.columns.items():
+        if name == varwidth:
+            out_cols[name] = _varwidth_exchange(
+                comm, col,
+                sorted_table.columns[name + "#len"],
+                offsets, counts, start, allowed, out_capacity,
+            )
+            continue
         out = jnp.zeros((out_capacity,) + col.shape[1:], col.dtype)
         out_cols[name] = comm.ragged_all_to_all(
             col, out, offsets, send_sizes, output_offsets, recv_sizes
         )
     valid = jnp.arange(out_capacity, dtype=jnp.int32) < total_recv
     return Table(out_cols, valid), overflow
+
+
+def _varwidth_exchange(comm, col, lens, offsets, counts, start, allowed,
+                       out_capacity: int):
+    """Byte-exact exchange of one bucket-sorted (rows, L) uint8 column
+    whose buckets are ordered by ``lens`` descending. Plane ``w`` of
+    the u32 view is alive for exactly the first
+    ``k[b, w] = #(len > 4w)`` rows of each bucket."""
+    from jax import lax
+
+    n = comm.n_ranks
+    me = comm.axis_index()
+    rows, L = col.shape
+    assert L % 4 == 0, f"varwidth column width {L} must be 4-aligned"
+    W = L // 4
+    w32 = lax.bitcast_convert_type(
+        col.reshape(rows, W, 4), jnp.uint32
+    )                                                   # (rows, W)
+    # k[b, w]: rows of bucket b alive at plane w — a prefix count,
+    # read off a cumulative sum at the bucket boundaries.
+    alive = (
+        lens[:, None].astype(jnp.int32)
+        > (4 * jnp.arange(W, dtype=jnp.int32))[None, :]
+    )
+    cs = jnp.concatenate(
+        [jnp.zeros((1, W), jnp.int32),
+         jnp.cumsum(alive.astype(jnp.int32), axis=0)]
+    )                                                   # (rows+1, W)
+    ends = jnp.minimum(offsets + counts, rows)
+    k = cs[ends] - cs[jnp.minimum(offsets, rows)]       # (n, W)
+    # Row-level clamping drops each bucket's TAIL — the shortest rows,
+    # whose plane contributions are also the tail of every plane
+    # prefix — so min(k, allowed_rows) keeps sender/receiver plans
+    # consistent with the row exchange.
+    gk = comm.all_gather(k).reshape(n, n, W)
+    k_allowed = jnp.minimum(gk, allowed[:, :, None])
+    out_planes = []
+    for w in range(W):
+        out = jnp.zeros((out_capacity,), jnp.uint32)
+        out_planes.append(comm.ragged_all_to_all(
+            w32[:, w], out, offsets,
+            comm.pvary(k_allowed[me, :, w].astype(jnp.int32)),
+            comm.pvary(start[me, :].astype(jnp.int32)),
+            comm.pvary(k_allowed[:, me, w].astype(jnp.int32)),
+        ))
+    out32 = jnp.stack(out_planes, axis=1)               # (out_cap, W)
+    return lax.bitcast_convert_type(out32, jnp.uint8).reshape(
+        out_capacity, L
+    )
 
 
 def shuffle_partitioned(

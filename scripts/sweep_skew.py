@@ -73,6 +73,7 @@ def on_chip_overhead(report):
         }.items():
             step = make_join_step(
                 comm, key="key", out_rows_per_rank=int(rows * 1.4),
+                hh_probe_capacity=int(rows * 1.1),
                 hh_out_capacity=int(rows * 1.2), **opts,
             )
 
@@ -115,6 +116,7 @@ def mesh_capacity_crossover(report):
     for label, opts in {
         "naive": {},
         "skew_t0.002_s128": {"skew_threshold": 0.002, "hh_slots": 128,
+                             "hh_probe_capacity": rows,
                              "hh_out_capacity": rows * 2},
     }.items():
         min_ok = None

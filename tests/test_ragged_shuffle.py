@@ -137,3 +137,117 @@ def test_ragged_flags_hot_bucket_like_padded():
         "pooled clamp flagged a layout it can hold (test premise broke)"
     assert bool(jnp.any(un)), \
         "unified per-bucket contract missed the hot bucket"
+
+
+def test_varwidth_string_wire_matches_padded():
+    """The byte-exact plane exchange must reconstruct EXACTLY the
+    fixed-width zero-padded column the padded shuffle would deliver
+    (same rows, same bytes), while shipping only ceil(len/4) words per
+    row (VERDICT r3 #5: the reference's offsets+chars exchange)."""
+    import numpy as np
+
+    import distributed_join_tpu as dj
+    from distributed_join_tpu.ops.partition import radix_hash_partition
+    from distributed_join_tpu.parallel.shuffle import shuffle_ragged
+    from distributed_join_tpu.table import Table
+    from distributed_join_tpu.utils.strings import encode_strings
+
+    rng = np.random.default_rng(17)
+    n_rows = 4096
+    # lengths 0..20 over a 24-byte column — plenty of per-row slack
+    words = ["", "a", "xyzzy", "variable-width-strs", "word" * 5]
+    vals = [words[i % len(words)] + str(rng.integers(10))
+            if words[i % len(words)] else ""
+            for i in range(n_rows)]
+    by, bl = encode_strings(vals, 24)
+    keys = jnp.asarray(rng.integers(0, 512, n_rows), jnp.int64)
+    t = Table.from_dense({"key": keys, "s": by, "s#len": bl})
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+
+    def shard_rows(x):
+        return x
+
+    cap = 4096 // 8  # out rows per rank (pooled 8x shuffle capacity)
+
+    def run(varwidth):
+        def step(tt):
+            pt = radix_hash_partition(
+                tt, ["key"], 8,
+                order_within="s#len" if varwidth else None)
+            got, ovf = shuffle_ragged(
+                comm, pt, 8 * cap, varwidth="s" if varwidth else None)
+            ovf = comm.psum(ovf.astype(jnp.int32)) > 0
+            return got.columns["key"], got.columns["s"], \
+                got.columns["s#len"], got.valid, ovf
+        return comm.spmd(step, sharded_out=(False, False, False,
+                                            False, True))(t)
+
+    k1, s1, l1, v1, o1 = run(False)
+    k2, s2, l2, v2, o2 = run(True)
+    assert not bool(o1) and not bool(o2)
+    v1n, v2n = np.asarray(v1), np.asarray(v2)
+    # identical valid rows; row ORDER differs (length-desc buckets), so
+    # compare as multisets of (key, len, bytes) records
+    assert v1n.sum() == v2n.sum()
+
+    def recs(k, s, l, v):
+        k, s, l = np.asarray(k)[v], np.asarray(s)[v], np.asarray(l)[v]
+        return sorted(
+            (int(k[i]), int(l[i]), bytes(s[i])) for i in range(len(k))
+        )
+
+    assert recs(k1, s1, l1, v1n) == recs(k2, s2, l2, v2n)
+    # and the varwidth bytes are exactly zero-padded like encode_strings
+    s2n = np.asarray(s2)[v2n]
+    l2n = np.asarray(l2)[v2n]
+    for i in range(len(l2n)):
+        assert not s2n[i, int(l2n[i]):].any()
+
+
+def test_varwidth_distributed_join_strings_vs_oracle():
+    """End-to-end: variable-length string payloads ride the ragged
+    distributed join byte-exactly and decode to the oracle's strings."""
+    import numpy as np
+
+    import distributed_join_tpu as dj
+    from distributed_join_tpu.table import Table
+    from distributed_join_tpu.utils.strings import (
+        decode_strings,
+        encode_strings,
+    )
+
+    rng = np.random.default_rng(23)
+    nb_, np_ = 2048, 4096
+    bkeys = rng.integers(0, 700, nb_)
+    pkeys = rng.integers(0, 700, np_)
+    names = {k: f"item-{k}" + "x" * int(k % 17) for k in range(700)}
+    bvals = [names[int(k)] for k in bkeys]
+    by, bl = encode_strings(bvals, 28)
+    b = Table.from_dense({
+        "key": jnp.asarray(bkeys, jnp.int64), "s": by, "s#len": bl,
+    })
+    p = Table.from_dense({
+        "key": jnp.asarray(pkeys, jnp.int64),
+        "pp": jnp.asarray(pkeys * 7, jnp.int64),
+    })
+    res = dj.distributed_inner_join(
+        b, p, dj.make_communicator("tpu", n_ranks=8),
+        shuffle="ragged", out_capacity_factor=8.0,
+        shuffle_capacity_factor=3.0,
+    )
+    assert not bool(res.overflow)
+    import pandas as pd
+    valid = np.asarray(res.table.valid)
+    gkey = np.asarray(res.table.columns["key"])[valid]
+    gs = np.asarray(res.table.columns["s"])[valid]
+    gl = np.asarray(res.table.columns["s#len"])[valid]
+    gpp = np.asarray(res.table.columns["pp"])[valid]
+    gstr = decode_strings(gs, gl)
+    want = pd.DataFrame({"key": bkeys, "s": bvals}).merge(
+        pd.DataFrame({"key": pkeys, "pp": pkeys * 7}), on="key")
+    assert len(gkey) == len(want) == int(res.total)
+    lhs = sorted(zip(gkey.tolist(), gstr, gpp.tolist()))
+    rhs = sorted(zip(want["key"].tolist(), want["s"].tolist(),
+                     want["pp"].tolist()))
+    assert lhs == rhs

@@ -89,8 +89,9 @@ def test_zipf_join_with_skew_handling(over_decomposition):
         jax.random.PRNGKey(1), rows, alpha=1.5, rand_max=rand_max
     )
     # alpha=1.5 puts ~90% of probe rows in the heavy hitters — beyond
-    # the half-probe default HH output block, so rely on the documented
-    # auto_retry contract (one doubling restores full-probe capacity).
+    # the probe/8 and probe/4 default HH blocks, so rely on the
+    # documented auto_retry contract: one skew retry jumps the HH
+    # probe/out capacities straight to full local probe coverage.
     res = dj.distributed_inner_join(
         build, probe, comm,
         skew_threshold=0.05,
