@@ -103,12 +103,44 @@ def global_heavy_hitters(
     valid: jax.Array,
     k: int,
     threshold,
+    sample: int = 16,
 ) -> HeavyHitters:
     """Replicated global top-``k`` keys with aggregated count >
     ``threshold`` (a traced or static int). Aggregation is exact over
     the union of per-rank candidate lists (a key missing from some
-    rank's list undercounts — see module docstring)."""
-    lk, lc = local_top_keys(keys, valid, k)
+    rank's list undercounts — see module docstring).
+
+    ``sample``: detection runs on a 1/``sample`` quasi-random subset —
+    a heavy key (count > threshold, typically >=0.1% of rows) appears
+    hundreds of times in the sample, so detection stays robust while
+    the top-keys sort drops from the full shard to n/sample rows (the
+    sort WAS ~half the skew path's fixed overhead at 10M uniform
+    rows). Rows are picked by a multiplicative index mix, NOT a fixed
+    stride — ``keys[::16]`` would deterministically miss a heavy key
+    living only at positions != 0 mod 16 (periodic layouts from
+    round-robin re-partitions do exist; review r4). Counts and
+    threshold are compared in sampled units; reported counts are
+    scaled back up. Small shards (n < 64*k*sample) disable sampling.
+    Classification CONSISTENCY across sides/ranks — what correctness
+    needs — is unaffected: it comes from the replicated HH set, not
+    from who sampled what."""
+    n = keys.shape[0]
+    if sample > 1 and n >= 64 * k * sample:
+        m = n // sample
+        # odd multiplier -> positions cycle through all residues of
+        # every power-of-two period; near-uniform coverage of any
+        # periodic layout. Collisions (gcd(C, n) > 1) repeat a few
+        # rows — harmless for approximate counting.
+        idx = ((
+            jnp.arange(m, dtype=jnp.int64) * jnp.int64(2654435761)
+        ) % jnp.int64(n)).astype(jnp.int32)
+        keys_d = keys[idx]
+        valid_d = valid[idx]
+        thr = threshold // sample
+    else:
+        sample = 1
+        keys_d, valid_d, thr = keys, valid, threshold
+    lk, lc = local_top_keys(keys_d, valid_d, k)
     gk = comm.all_gather(lk)                      # (n*k,)
     gc = comm.all_gather(lc)                      # (n*k,) int32
     nk = gk.shape[0]
@@ -125,14 +157,27 @@ def global_heavy_hitters(
     real = gk != sentinel
     score = jnp.where(real & ~dup, tot, 0)
     top_counts, top_idx = lax.top_k(score, k)
-    slot_valid = top_counts > threshold
+    slot_valid = top_counts > thr
     hh_keys = jnp.where(slot_valid, gk[top_idx], sentinel)
-    return HeavyHitters(hh_keys, top_counts, slot_valid)
+    return HeavyHitters(hh_keys, top_counts * sample, slot_valid)
+
+
+# Above this K, mark_heavy's unrolled compare chain would bloat the
+# program; the rolled fori_loop costs ~100s of us of device-loop
+# overhead PER SLOT (docs/ROOFLINE.md §6), so unrolling is the fast
+# path for the default K=64.
+_MARK_UNROLL_MAX = 512
 
 
 def mark_heavy(keys: jax.Array, hh: HeavyHitters) -> jax.Array:
     """Row-wise bool: key is in the HH set. K elementwise passes — no
     (rows, K) materialization (which would be GBs at 10M rows)."""
+    K = hh.keys.shape[0]
+    if K <= _MARK_UNROLL_MAX:
+        acc = keys != keys
+        for j in range(K):
+            acc = acc | ((keys == hh.keys[j]) & hh.slot_valid[j])
+        return acc
 
     def body(j, acc):
         hk = lax.dynamic_index_in_dim(hh.keys, j, keepdims=False)
@@ -141,7 +186,7 @@ def mark_heavy(keys: jax.Array, hh: HeavyHitters) -> jax.Array:
 
     # Init derived from `keys` (all-False, same shape) so the carry is
     # rank-varying under shard_map's vma tracking, like the body output.
-    return lax.fori_loop(0, hh.keys.shape[0], body, keys != keys)
+    return lax.fori_loop(0, K, body, keys != keys)
 
 
 def extract_prefix(table: Table, sel: jax.Array, capacity: int,

@@ -1,0 +1,43 @@
+"""Run: PYTHONPATH=. python scripts/measure_skew_overhead.py
+
+VERDICT r3 #2 'Done' criterion: HH-path overhead at 10M/1-rank
+UNIFORM with DEFAULT capacities (probe/8 block, streaming-kernel
+compaction), vs the naive path."""
+import json, jax
+import distributed_join_tpu as dj
+from distributed_join_tpu.parallel.communicator import LocalCommunicator
+from distributed_join_tpu.parallel.distributed_join import make_join_step
+from distributed_join_tpu.utils.benchmarking import (
+    consume_all_columns, measure_chained)
+from distributed_join_tpu.utils.generators import generate_build_probe_tables
+
+rows = 10_000_000
+comm = LocalCommunicator()
+build, probe = generate_build_probe_tables(
+    seed=42, build_nrows=rows, probe_nrows=rows, selectivity=0.3)
+jax.block_until_ready((build.columns, probe.columns))
+out = {}
+for label, opts in {
+    "naive": {},
+    "skew_default_caps": {"skew_threshold": 0.001, "hh_slots": 64},
+}.items():
+    step = make_join_step(comm, key="key",
+                          out_rows_per_rank=int(rows * 0.75), **opts)
+    def body(i, b, p):
+        bt = type(b)({k: (c + i.astype(c.dtype) - i.astype(c.dtype)
+                          if k == "key" else c)
+                      for k, c in b.columns.items()}, b.valid)
+        res = step(bt, p)
+        return consume_all_columns(res.table) + res.total \
+            + res.overflow.astype("int64")
+    sec = measure_chained(label, body, build, probe)
+    out[label] = round(sec * 1e3, 1)
+out["overhead_pct"] = round(
+    100 * (out["skew_default_caps"] - out["naive"]) / out["naive"], 1)
+print(json.dumps(out))
+import pathlib
+with open(pathlib.Path(__file__).resolve().parent.parent
+          / "results" / "skew_overhead_uniform_r4.json", "w") as f:
+    json.dump({"rows": rows, "ranks": 1,
+               "defaults": "hh_probe=p/8 hh_out=p/4, streaming-kernel extract",
+               "ms_per_join": out}, f, indent=2)

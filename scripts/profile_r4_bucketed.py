@@ -1,0 +1,179 @@
+"""Round-4 go/no-go: the bucketed-sub-join hypothesis (VERDICT r3 #3).
+
+ROOFLINE §6's striking fact: the bench join's 20M merged operand set
+sorts 4-7x faster as INDEPENDENT RUNS — lax.sort over a 2-D (B, n/B)
+array sorts rows independently at 24-45 ms where the flat 20M sort
+costs ~166 ms. The hypothesis: route rows into B hash buckets cheaper
+than a full-width sort, then sort/join per bucket.
+
+This script measures every priced component on the real chip:
+
+  A. the flat merged sort (the incumbent);
+  B. the same operands sorted as a 2-D (B, n/B) batch — the prize;
+  C. the flat sort with an 8-BIT bucket id PREPENDED as leading sort
+     key (does XLA's sort exploit a tiny leading key? VERDICT's named
+     measurement);
+  D. the routing candidates' floors:
+       D1. sort-based partition ((i32 bucket, i32 row) sort + one
+           composed 2-D row gather into the (B, cap) layout) — the
+           machinery the repo already owns;
+       D2. B-pass plane compaction (measured single-pass throughput
+           x B — the streaming-kernel candidate).
+
+Verdict = A - (routing + B') where B' is the bucketed sort at padded
+capacity. Writes results/bucketed_subjoin_r4.json.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+import distributed_join_tpu  # noqa: F401
+from distributed_join_tpu.utils.benchmarking import measure_chained
+
+N = 20_000_000
+B = 16
+PAD = 1.3  # per-bucket capacity factor for the batched layout
+
+
+def operands(n=N, seed=1):
+    rng = np.random.default_rng(seed)
+    k = jnp.asarray(rng.integers(0, n // 2, n), jnp.int64)
+    t = (jnp.arange(n, dtype=jnp.int32) % 2).astype(jnp.int8)
+    v = (jnp.arange(n, dtype=jnp.int64) * 7) % (1 << 40)
+    jax.block_until_ready((k, t, v))
+    return k, t, v
+
+
+def consume(*arrs):
+    acc = jnp.int64(0)
+    for a in arrs:
+        a = a.reshape(-1)
+        acc = acc + a[0].astype(jnp.int64) + a[-1].astype(jnp.int64)
+    return acc
+
+
+def a_flat_sort(out):
+    k, t, v = operands()
+
+    def body(i, k, t, v):
+        srt = lax.sort((k + i.astype(jnp.int64), t, v), num_keys=2)
+        return consume(*srt)
+
+    out["A_flat_sort_s"] = measure_chained(
+        "A. flat 20M sort (i64,i8,i64) nk=2", body, k, t, v, iters=4)
+
+
+def b_batched_sort(out):
+    n_pad = int(N * PAD)
+    n_pad -= n_pad % B
+    k, t, v = operands(n_pad)
+    k2 = k.reshape(B, -1)
+    t2 = t.reshape(B, -1)
+    v2 = v.reshape(B, -1)
+    jax.block_until_ready((k2, t2, v2))
+
+    def body(i, k2, t2, v2):
+        srt = lax.sort((k2 + i.astype(jnp.int64), t2, v2),
+                       dimension=-1, num_keys=2)
+        return consume(*srt)
+
+    out["B_batched_sort_s"] = measure_chained(
+        f"B. batched ({B}, {n_pad//B}) sort incl. {PAD}x pad",
+        body, k2, t2, v2, iters=4)
+
+
+def c_bucket_prefix_sort(out):
+    k, t, v = operands()
+    bid = (k & jnp.int64(B - 1)).astype(jnp.uint8)
+    jax.block_until_ready(bid)
+
+    def body(i, bid, k, t, v):
+        srt = lax.sort((bid, k + i.astype(jnp.int64), t, v), num_keys=3)
+        return consume(*srt)
+
+    out["C_bucket_leading_key_sort_s"] = measure_chained(
+        "C. flat sort with u8 bucket leading key nk=3",
+        body, bid, k, t, v, iters=4)
+
+
+def d1_partition_route(out):
+    k, t, v = operands()
+    cap = int(N * PAD) // B
+
+    def body(i, k, t, v):
+        kk = k + i.astype(jnp.int64)
+        bid = (kk & jnp.int64(B - 1)).astype(jnp.int32)
+        sb, order = lax.sort(
+            (bid, jnp.arange(N, dtype=jnp.int32)), num_keys=1,
+            is_stable=True)
+        offs = jnp.searchsorted(
+            sb, jnp.arange(B, dtype=jnp.int32), side="left"
+        ).astype(jnp.int32)
+        lane = jnp.arange(cap, dtype=jnp.int32)
+        idx = order[jnp.clip(offs[:, None] + lane[None, :], 0, N - 1)]
+        # one composed row-gather per operand group (k,v pack as 2-D)
+        kv = jnp.stack([kk, v], axis=1)        # (N, 2) i64
+        routed = kv[idx]                        # (B, cap, 2)
+        tt = t[idx]                             # (B, cap)
+        return consume(routed, tt, sb)
+
+    out["D1_sort_partition_route_s"] = measure_chained(
+        f"D1. partition route -> ({B},{cap}) layout", body, k, t, v,
+        iters=4)
+
+
+def d2_plane_compact_floor(out):
+    from distributed_join_tpu.ops.compact_planes import (
+        plane_stream_compact,
+    )
+
+    k, t, v = operands()
+    cap = int(N * PAD) // B
+
+    def body(i, k, t, v):
+        kk = (k + i.astype(jnp.int64)).astype(jnp.uint64)
+        mask = (kk & jnp.uint64(B - 1)) == jnp.uint64(0)
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        outs = plane_stream_compact(
+            mask, pos, [kk, v.astype(jnp.uint64)], cap)
+        return consume(*outs)
+
+    one = measure_chained(
+        "D2. ONE plane-compact pass 20M -> cap", body, k, t, v, iters=4)
+    out["D2_single_compact_pass_s"] = one
+    out["D2_B_pass_floor_s"] = one * B
+
+
+def main():
+    out = {"n": N, "buckets": B, "pad": PAD}
+    a_flat_sort(out)
+    b_batched_sort(out)
+    c_bucket_prefix_sort(out)
+    d1_partition_route(out)
+    d2_plane_compact_floor(out)
+    win_d1 = out["A_flat_sort_s"] - (
+        out["D1_sort_partition_route_s"] + out["B_batched_sort_s"])
+    out["verdict"] = {
+        "prize_batched_vs_flat_s": out["A_flat_sort_s"]
+        - out["B_batched_sort_s"],
+        "route_via_partition_net_s": win_d1,
+        "route_via_B_compact_passes_net_s": out["A_flat_sort_s"] - (
+            out["D2_B_pass_floor_s"] + out["B_batched_sort_s"]),
+        "go": bool(win_d1 > 0.02),
+    }
+    print(json.dumps(out["verdict"], indent=2))
+    p = pathlib.Path(__file__).resolve().parent.parent / "results" / \
+        "bucketed_subjoin_r4.json"
+    p.write_text(json.dumps(out, indent=2))
+    print("wrote", p)
+
+
+if __name__ == "__main__":
+    main()

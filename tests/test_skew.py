@@ -186,3 +186,29 @@ def test_hh_slots_exceeding_local_rows():
         out_capacity_factor=4.0, shuffle_capacity_factor=4.0,
     )
     assert int(res.total) == _oracle(build, probe)
+
+
+def test_sampled_detection_sees_periodic_heavy_key():
+    """Detection samples 1/16 of rows via a multiplicative index mix —
+    a heavy key living ONLY at odd positions (period-2 layout; a fixed
+    [::16] stride would see positions 0 mod 16 only and miss it or
+    16x-overcount it) must still be detected (review r4)."""
+    import jax.numpy as jnp
+
+    from distributed_join_tpu.parallel import skew
+    import distributed_join_tpu as dj
+
+    comm = dj.make_communicator("local")
+    n = 1 << 17  # big enough that sampling engages (64*k*sample)
+    keys = jnp.arange(n, dtype=jnp.int64)
+    hot = jnp.where(jnp.arange(n) % 2 == 1, jnp.int64(7), keys)
+    hh = skew.global_heavy_hitters(
+        comm, hot, jnp.ones(n, bool), 64,
+        threshold=jnp.int32(n // 10), sample=16,
+    )
+    import numpy as np
+    ks = np.asarray(hh.keys)[np.asarray(hh.slot_valid)]
+    assert 7 in ks.tolist()
+    # and the scaled count estimate is in the right ballpark (half n)
+    cnt = int(np.asarray(hh.counts)[np.asarray(hh.keys) == 7][0])
+    assert n // 4 < cnt < n, cnt
