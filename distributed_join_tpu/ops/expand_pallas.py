@@ -91,9 +91,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-# f32 holds integers up to 2^24 exactly; the rank arithmetic rides f32
-# lanes, so the build path is only taken when every quantity involved
-# (build rows, output capacity) stays below this.
+# f32 holds integers up to 2^24 exactly. Round 4 made the build-mode
+# kernel's rank arithmetic BLOCK-RELATIVE (hi/lo-split i32 aux rows),
+# so the fused build path no longer has a 2^24 limit; the constant
+# remains for the NON-build kernel's S-lane choice (s_u64_lane).
 _F32_EXACT = 1 << 24
 
 
@@ -324,9 +325,9 @@ def _expand_kernel_b8(*refs, block: int, chunk: int, ck8: int,
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    (r0a_ref, w1a_ref, w2a_ref, v8_hbm, aux_hbm, bv_hbm, out_ref,
-     v8_vmem, aux_vmem, b1_vmem, b2_vmem, sem_v, sem_a, sem_b1,
-     sem_b2) = refs
+    (r0a_ref, w1a_ref, w2a_ref, ib_ref, v8_hbm, aux_hbm, bv_hbm,
+     out_ref, v8_vmem, aux_vmem, b1_vmem, b2_vmem, sem_v, sem_a,
+     sem_b1, sem_b2) = refs
     b = block
     i = pl.program_id(0)
     wro = r0a_ref[i] * 128  # 128-aligned record-window offset
@@ -351,26 +352,52 @@ def _expand_kernel_b8(*refs, block: int, chunk: int, ck8: int,
     dma_v.wait()
     dma_a.wait()
 
-    j = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0) + i * b
-    jf = j.astype(jnp.float32)
+    # BLOCK-RELATIVE arithmetic throughout (round 4): all f32-lane
+    # values are clipped relative offsets bounded by +-2^20, so nb and
+    # out_capacity past 2^24 stay exact. CL must exceed every window
+    # width and the block, and survive f32 exactly.
+    CL = jnp.int32(1 << 20)
+    # Absolute output-block start from SMEM (NOT i*b): under output
+    # tiling this invocation covers blocks [tile_start, ...) of the
+    # global output, and everything else in the kernel is already
+    # block-relative.
+    ib = ib_ref[i]
+    jloc = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0)
+    jlocf = jloc.astype(jnp.float32)
     # 1-D row extractions: Mosaic can sublane-broadcast a slice of a
     # 1-D vector but rejects the same broadcast from a 2-D row slice
     # ("Invalid input layout" on vector.broadcast).
-    contrib_row = aux_vmem[0]                # (wr,) f32 lo - S
-    sfix_row = aux_vmem[1]                   # (wr,) f32 record starts
+    c_hi, c_lo = aux_vmem[0], aux_vmem[1]    # (wr,) f32 lo - S halves
+    s_hi, s_lo = aux_vmem[2], aux_vmem[3]    # (wr,) f32 S halves
+
+    def _dec(hi_row, lo_row, t0, t1):
+        return (
+            hi_row[t0:t1].astype(jnp.int32) * jnp.int32(65536)
+            + lo_row[t0:t1].astype(jnp.int32)
+        )
+
     acc = jnp.zeros((ck8, b), jnp.float32)
-    contrib_col = jnp.zeros((b, 1), jnp.float32)
-    start_col = jnp.zeros((b, 1), jnp.float32)
+    c1_col = jnp.zeros((b, 1), jnp.float32)
+    c2_col = jnp.zeros((b, 1), jnp.float32)
+    srel_col = jnp.zeros((b, 1), jnp.float32)
+    d1 = ib - o1
+    d2 = ib - o2
     for t in range(0, wr, chunk):
-        sl = sfix_row[t : t + chunk]
-        cmp_a = (sl[None, :] <= jf).astype(jnp.float32)    # (b, chunk)
+        s_rel = jnp.clip(
+            _dec(s_hi, s_lo, t, t + chunk) - ib, -CL, CL
+        ).astype(jnp.float32)
+        cmp_a = (s_rel[None, :] <= jlocf).astype(jnp.float32)
         if t + chunk < wr:
-            sl_b = sfix_row[t + 1 : t + chunk + 1]
-            cmp_b = (sl_b[None, :] <= jf).astype(jnp.float32)
+            s_rel_b = jnp.clip(
+                _dec(s_hi, s_lo, t + 1, t + chunk + 1) - ib, -CL, CL
+            ).astype(jnp.float32)
+            cmp_b = (s_rel_b[None, :] <= jlocf).astype(jnp.float32)
         else:
-            sl_b = sfix_row[t + 1 : t + chunk]
+            s_rel_b = jnp.clip(
+                _dec(s_hi, s_lo, t + 1, t + chunk) - ib, -CL, CL
+            ).astype(jnp.float32)
             cmp_b = jnp.pad(
-                (sl_b[None, :] <= jf).astype(jnp.float32),
+                (s_rel_b[None, :] <= jlocf).astype(jnp.float32),
                 ((0, 0), (0, 1)),
             )
         onehot = cmp_a - cmp_b
@@ -379,22 +406,28 @@ def _expand_kernel_b8(*refs, block: int, chunk: int, ck8: int,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        contrib_col = contrib_col + jnp.sum(
-            onehot * contrib_row[t : t + chunk][None, :],
-            axis=1, keepdims=True,
-        )
-        start_col = start_col + jnp.sum(
-            onehot * sfix_row[t : t + chunk][None, :],
-            axis=1, keepdims=True,
-        )
+        c = _dec(c_hi, c_lo, t, t + chunk)
+        # c + ib - o{1,2} == (rank of this record's run at the block
+        # start) - window base: in (-b, window) for every record the
+        # onehot can select, so the clip never distorts a selected
+        # value.
+        c1v = jnp.clip(c + d1, -CL, CL).astype(jnp.float32)
+        c2v = jnp.clip(c + d2, -CL, CL).astype(jnp.float32)
+        c1_col = c1_col + jnp.sum(
+            onehot * c1v[None, :], axis=1, keepdims=True)
+        c2_col = c2_col + jnp.sum(
+            onehot * c2v[None, :], axis=1, keepdims=True)
+        srel_col = srel_col + jnp.sum(
+            onehot * s_rel[None, :], axis=1, keepdims=True)
     out_ref[0:ck8, :] = acc
 
     dma_b1.wait()
     dma_b2.wait()
-    rank = j + contrib_col.astype(jnp.int32)
-    is_w1 = start_col.astype(jnp.int32) <= i * b
-    local1 = rank - o1
-    local2 = rank - o2
+    # rank - o1 == jloc + (lo - S + ib - o1); window choice: the run
+    # started at or before the block start iff S - ib <= 0.
+    is_w1 = srel_col.astype(jnp.int32) <= 0
+    local1 = jloc + c1_col.astype(jnp.int32)
+    local2 = jloc + c2_col.astype(jnp.int32)
     accb = jnp.zeros((ckb8, b), jnp.float32)
     iota_ch = jax.lax.broadcasted_iota(jnp.int32, (b, chunk), 1)
     # f32 where + cast: producing bf16 straight from the i1 mask needs
@@ -420,6 +453,14 @@ def _expand_kernel_b8(*refs, block: int, chunk: int, ck8: int,
     out_ref[ck8 : ck8 + ckb8, :] = accb
 
 
+# Per-tile budget for the build-mode kernel's f32 chunk-row output
+# (~32 B per u64 lane per output row — 4x the value width). One
+# monolithic buffer OOM'd HBM at a 60M-row output capacity
+# (16.1G/15.75G, round 4); tiling the output bounds the footprint at
+# any capacity.
+_FUSED_TILE_BYTES = 2 << 30
+
+
 def _expand_gather_b8(S, cols, out_capacity, block, interpret, lo,
                       build_cols):
     """v3 build-mode wrapper; see _expand_kernel_b8."""
@@ -436,13 +477,25 @@ def _expand_gather_b8(S, cols, out_capacity, block, interpret, lo,
     rows8 = _split_rows8(cols)
     ck8 = _round_up(len(rows8), 16)
     is_real = S != jnp.int32(2**31 - 1)
-    # aux f32 rows: [0] lo - S (the rank contribution), [1] S with
-    # sentinels mapped to 2^30 — NOT zero (a zero would make sentinel
-    # records "cover" every slot) and f32-exact (> any out_pad).
-    aux = [
-        jnp.where(is_real, (lo - S).astype(jnp.float32), 0.0),
-        jnp.where(is_real, S.astype(jnp.float32), jnp.float32(2**30)),
-    ]
+    # aux rows carry the i32 quantities (lo - S) and S split into
+    # EXACT hi/lo 16-bit halves riding f32 lanes (hi arithmetic-
+    # shifted keeps the sign; v == hi*65536 + (v & 0xFFFF) for any
+    # two's-complement i32). The kernel reconstructs in i32 and works
+    # BLOCK-RELATIVE, so no absolute rank/start ever needs f32
+    # exactness — this is what lifts the old 2^24 limit on nb and
+    # out_capacity (round 4; the sentinel S = 2^31-1 reconstructs
+    # exactly and clips to "never covers").
+    contrib_i = jnp.where(is_real, lo - S, 0)
+    s_i = jnp.where(is_real, S, jnp.int32(2**31 - 1))
+
+    def _hi(v):
+        return lax.shift_right_arithmetic(
+            v, jnp.int32(16)).astype(jnp.float32)
+
+    def _lo16(v):
+        return (v & jnp.int32(0xFFFF)).astype(jnp.float32)
+
+    aux = [_hi(contrib_i), _lo16(contrib_i), _hi(s_i), _lo16(s_i)]
     out_pad = _round_up(out_capacity, block)
     pad_cols = out_pad + wr + 128 - m
     if pad_cols > 0:
@@ -453,19 +506,27 @@ def _expand_gather_b8(S, cols, out_capacity, block, interpret, lo,
             jnp.concatenate([r, jnp.zeros((pad_cols,), jnp.bfloat16)])
             for r in rows8
         ]
+        sent_hi = float((2**31 - 1) >> 16)
+        sent_lo = float((2**31 - 1) & 0xFFFF)
         aux = [
             jnp.concatenate(
                 [aux[0], jnp.zeros((pad_cols,), jnp.float32)]
             ),
             jnp.concatenate(
-                [aux[1], jnp.full((pad_cols,), 2**30, jnp.float32)]
+                [aux[1], jnp.zeros((pad_cols,), jnp.float32)]
+            ),
+            jnp.concatenate(
+                [aux[2], jnp.full((pad_cols,), sent_hi, jnp.float32)]
+            ),
+            jnp.concatenate(
+                [aux[3], jnp.full((pad_cols,), sent_lo, jnp.float32)]
             ),
         ]
     v8T = jnp.stack(
         rows8 + [jnp.zeros_like(rows8[0])] * (ck8 - len(rows8)), axis=0
     )
     auxT = jnp.stack(
-        aux + [jnp.zeros_like(aux[0])] * 6, axis=0
+        aux + [jnp.zeros_like(aux[0])] * 4, axis=0
     )                                            # (8, m_pad) f32
 
     starts = jnp.arange(out_pad // block, dtype=jnp.int32) * block
@@ -499,45 +560,92 @@ def _expand_gather_b8(S, cols, out_capacity, block, interpret, lo,
     w2a = jnp.clip(w2, 0, omax * 128) // 128
 
     vma = getattr(jax.typeof(v8T), "vma", None)
-    out_shape = (
-        jax.ShapeDtypeStruct((ck8 + ckb8, out_pad), jnp.float32,
-                             vma=vma)
-        if vma is not None
-        else jax.ShapeDtypeStruct((ck8 + ckb8, out_pad), jnp.float32)
-    )
-    with jax.enable_x64(False):
-        out = pl.pallas_call(
-            functools.partial(
-                _expand_kernel_b8, block=block, chunk=chunk, ck8=ck8,
-                ckb8=ckb8, wr=wr, w1w=w1w, w2w=w2w,
-            ),
-            grid=(out_pad // block,),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
-            out_specs=pl.BlockSpec((ck8 + ckb8, block), lambda i: (0, i)),
-            scratch_shapes=[
-                pltpu.VMEM((ck8, wr), jnp.bfloat16),
-                pltpu.VMEM((8, wr), jnp.float32),
-                pltpu.VMEM((ckb8, w1w), jnp.bfloat16),
-                pltpu.VMEM((ckb8, w2w), jnp.bfloat16),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA(()),
-            ],
-            out_shape=out_shape,
-            interpret=interpret,
-        )(r0a, w1a, w2a, v8T, auxT, bv8T)
-    rec_outs = [c[:out_capacity] for c in _merge_rows8(out, k)]
-    build_outs = [
-        c[:out_capacity] for c in _merge_rows8(out[ck8:], kb)
-    ]
+
+    # Output TILING (round 4): the f32 chunk-row output costs ~32 B
+    # per u64 lane per output row; at spec-scale capacities one
+    # monolithic buffer exceeds HBM (fused_build_hbm_bytes). The
+    # kernel is block-relative with absolute block starts from SMEM,
+    # so the SAME compiled kernel covers any output range — run it
+    # per tile and concatenate the merged u64 pieces. Tiles are
+    # serialized by a data dependency (dep rides into the next tile's
+    # SMEM array) so buffer assignment can reuse the f32 space.
+    n_blocks = out_pad // block
+    tile_bytes = (ck8 + ckb8) * 4 * out_pad
+    n_tiles = min(max(1, -(-tile_bytes // _FUSED_TILE_BYTES)), n_blocks)
+    tile_blocks = -(-n_blocks // n_tiles)
+    pieces = []
+    dep = jnp.int32(0)
+    for q in range(0, n_blocks, tile_blocks):
+        qb = min(tile_blocks, n_blocks - q)
+        sl = slice(q, q + qb)
+        ib_arr = (
+            jnp.arange(qb, dtype=jnp.int32) + jnp.int32(q)
+        ) * block + dep
+        out_shape = (
+            jax.ShapeDtypeStruct((ck8 + ckb8, qb * block),
+                                 jnp.float32, vma=vma)
+            if vma is not None
+            else jax.ShapeDtypeStruct((ck8 + ckb8, qb * block),
+                                      jnp.float32)
+        )
+        # x64 scoped off around the pallas_call ONLY: Mosaic fails to
+        # legalize with global x64, but the u64 merge below must see
+        # real 64-bit types or it silently truncates to u32.
+        with jax.enable_x64(False):
+            out = pl.pallas_call(
+                functools.partial(
+                    _expand_kernel_b8, block=block, chunk=chunk,
+                    ck8=ck8, ckb8=ckb8, wr=wr, w1w=w1w, w2w=w2w,
+                ),
+                grid=(qb,),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                ],
+                out_specs=pl.BlockSpec((ck8 + ckb8, block),
+                                       lambda i: (0, i)),
+                scratch_shapes=[
+                    pltpu.VMEM((ck8, wr), jnp.bfloat16),
+                    pltpu.VMEM((8, wr), jnp.float32),
+                    pltpu.VMEM((ckb8, w1w), jnp.bfloat16),
+                    pltpu.VMEM((ckb8, w2w), jnp.bfloat16),
+                    pltpu.SemaphoreType.DMA(()),
+                    pltpu.SemaphoreType.DMA(()),
+                    pltpu.SemaphoreType.DMA(()),
+                    pltpu.SemaphoreType.DMA(()),
+                ],
+                out_shape=out_shape,
+                interpret=interpret,
+            )(r0a[sl], w1a[sl], w2a[sl], ib_arr, v8T, auxT, bv8T)
+        piece = (
+            _merge_rows8(out, k),
+            _merge_rows8(out[ck8:], kb),
+        )
+        # A plain `x * 0` dependency would be algebraically folded to
+        # a constant, severing the ordering; the barrier ties the next
+        # tile's SMEM input to this tile's output un-simplifiably.
+        dep = lax.optimization_barrier(
+            (jnp.int32(0), out[0, 0])
+        )[0]
+        pieces.append(piece)
+    if len(pieces) == 1:
+        rec_full, build_full = pieces[0]
+    else:
+        rec_full = [
+            jnp.concatenate([p[0][t] for p in pieces])
+            for t in range(k)
+        ]
+        build_full = [
+            jnp.concatenate([p[1][t] for p in pieces])
+            for t in range(kb)
+        ]
+    rec_outs = [c[:out_capacity] for c in rec_full]
+    build_outs = [c[:out_capacity] for c in build_full]
     # start_b/rank placeholders (consumed in-kernel only); derived from
     # S so they carry the same vma as the cond's other branch under
     # shard_map.
@@ -589,13 +697,10 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
     build = build_cols is not None
     if build:
         assert lo is not None and len(build_cols) > 0
-        # The caller guards these (ops/join.py build_ok); the rank math
-        # rides f32 and silently corrupts past 2^24 otherwise.
-        assert out_capacity < _F32_EXACT
-        assert build_cols[0].shape[0] < _F32_EXACT
         # v3 path: bf16 8-bit chunk matmuls, 128-aligned record
         # windows, placeholder start_b/rank (consumed in-kernel only —
-        # callers on the build path never read them).
+        # callers on the build path never read them). Rank/start
+        # arithmetic is BLOCK-RELATIVE i32 (round 4) — no 2^24 limit.
         return _expand_gather_b8(
             S, cols, out_capacity, block, interpret, lo, build_cols
         )

@@ -232,12 +232,13 @@ def _kernel_path_ok(build, probe, keys, b1d, p1d, nb, npr,
     pipeline (everything below; CPU tests, f64 columns, empty sides,
     merged domains past int32). Returns (use, interpret).
 
-    The f32-exact (2^24) rank limits of the FUSED-BUILD expand no
-    longer disqualify the whole path — past them the pipeline pins its
-    own gather fallback branch (:func:`_fused_build_ok`). Round-4
-    finding: disqualifying everything here silently dropped config 2's
-    spec-scale joins (>= 2^24 build rows) onto the XLA path, a 3-4x
-    cliff measured at the boundary (results/scale_curve_r4.json)."""
+    Round-4: the old f32-exact (2^24) rank limits are gone entirely —
+    first the gate stopped disqualifying the whole path (they had
+    silently dropped config 2's spec-scale joins onto the XLA path, a
+    3-4x cliff measured at the boundary, results/scale_curve_r4.json),
+    then the fused-build kernel's rank arithmetic went block-relative
+    (expand_pallas._expand_kernel_b8), removing the limit at the
+    source. Only int32 domain bounds remain."""
     use, interpret = cfg.expand_enabled()
     if not use:
         return False, False
@@ -259,14 +260,6 @@ def _kernel_path_ok(build, probe, keys, b1d, p1d, nb, npr,
     return all(_u64_lane_ok(dt) for dt in dts), interpret
 
 
-def _fused_build_ok(nb: int, out_capacity: int) -> bool:
-    """The fused build-side expand rides rank arithmetic on f32 lanes
-    — exact only below 2^24. Past that the kernel path keeps the
-    non-build expand (S rides a u64 lane) and gathers build values by
-    rank instead (the same program as the window-check fallback)."""
-    from distributed_join_tpu.ops.expand_pallas import _F32_EXACT
-
-    return nb < _F32_EXACT and out_capacity < _F32_EXACT
 
 
 def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
@@ -453,16 +446,11 @@ def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
                 bouts2 = [rows_g[:, t] for t in range(len(pack))]
             return outs2[:-1], sb2, rank2, bouts2
 
-        if _fused_build_ok(nb, out_capacity):
-            rec_outs, start_b, _rank, build_outs = lax.cond(
-                build_windows_ok(S, lo_rec, out_capacity,
-                                 block=cfg.block),
-                _kernel, _fallback, None,
-            )
-        else:
-            # Past the f32-exact rank range: pin the gather fallback
-            # (statically — the fused kernel would corrupt ranks).
-            rec_outs, start_b, _rank, build_outs = _fallback(None)
+        rec_outs, start_b, _rank, build_outs = lax.cond(
+            build_windows_ok(S, lo_rec, out_capacity,
+                             block=cfg.block),
+            _kernel, _fallback, None,
+        )
         build_vals_u64 = dict(zip(pack_names, build_outs))
     else:
         rec_outs, start_b = expand_gather(

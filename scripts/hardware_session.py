@@ -52,10 +52,20 @@ def main():
     plat = ["--platform", "cpu", "--n-ranks", "8"] if smoke else (
         ["--n-ranks", str(args.n_ranks)] if args.n_ranks else []
     )
-    n = 8 if smoke else (args.n_ranks or 0)
-    if not smoke:
-        import jax
-        n = args.n_ranks or len(jax.devices())
+    if smoke:
+        n = 8
+    elif args.n_ranks:
+        n = args.n_ranks
+    else:
+        # Count devices in a THROWAWAY subprocess: initializing the
+        # TPU backend here would hold the device lock for this
+        # process's lifetime and every child benchmark would fail to
+        # acquire the chips (review r4).
+        n = int(subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip().splitlines()[-1])
     tag = "smoke" if smoke else f"hw_{n}chips"
     rows = 1_000_000 if smoke else 50_000_000   # per side (2 sides = spec 100M)
     rows -= rows % n
@@ -108,15 +118,15 @@ def main():
           "| measurement | value | artifact |", "|---|---|---|"]
     a2a = records["all_to_all"]
     md.append(f"| all-to-all off-chip bandwidth | "
-              f"{a2a.get('gb_per_sec', a2a)} GB/s | {tag}_all_to_all.json |")
+              f"{a2a.get('aggregate_offchip_gb_per_sec', '?')} GB/s | "
+              f"{tag}_all_to_all.json |")
     for k in ("config2_padded", "config2_ragged", "config2_ppermute",
               "config3_skew", "config3_naive"):
         r = records[k]
         md.append(
             f"| {k} | {r['m_rows_per_sec_per_rank']:.2f} M rows/s/chip "
             f"({r['elapsed_per_join_s']:.3f} s/join, overflow="
-            f"{r['overflow']}) | {tag}_{k.split('_', 1)[0]}_"
-            f"{k.split('_', 1)[1]}.json |")
+            f"{r['overflow']}) | {tag}_{k}.json |")
     r = records["config4_tpch"]
     md.append(f"| config4 TPC-H SF-{sf} | "
               f"{r.get('rows_per_sec', 0) / 1e6:.2f} M rows/s | "

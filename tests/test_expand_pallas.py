@@ -10,6 +10,7 @@ import jax.numpy as jnp
 from distributed_join_tpu.ops.expand_pallas import (
     _merge_rows,
     _split_rows,
+    build_windows_ok,
     expand_gather,
     expand_gather_reference,
 )
@@ -294,3 +295,28 @@ def test_join_level_pallas_path_matches_oracle(monkeypatch):
         ["key", "build_payload", "probe_payload"]).reset_index(drop=True)
     import pandas as pd
     pd.testing.assert_frame_equal(got[want.columns], want)
+
+
+def test_build_path_output_tiling_exact(monkeypatch):
+    """Force the tiled output path (per-tile f32 budget shrunk so the
+    small test splits into several tiles) and require bit-exactness vs
+    the monolithic run — the spec-scale OOM fix must not change a
+    single value (round 4)."""
+    import zlib
+
+    import distributed_join_tpu.ops.expand_pallas as E
+
+    key_specs = [(64, 3), (32, 1), (16, 7)]
+    rng = np.random.default_rng(zlib.crc32(b"tiling"))
+    out_cap = sum(c * p for c, p in key_specs)
+    S, lo, cols, bcols, rank_want, total = _make_join_records(
+        rng, key_specs, out_cap, kb=2
+    )
+    assert bool(build_windows_ok(S, lo, out_cap, block=256))
+    whole = expand_gather(S, cols, out_cap, block=256, interpret=True,
+                          lo=lo, build_cols=bcols)
+    monkeypatch.setattr(E, "_FUSED_TILE_BYTES", 256 * 64)  # few blocks
+    tiled = expand_gather(S, cols, out_cap, block=256, interpret=True,
+                          lo=lo, build_cols=bcols)
+    for a, b in zip(whole[0] + whole[3], tiled[0] + tiled[3]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
