@@ -30,9 +30,31 @@ under BOTH capacity stories and both appear in the one JSON line —
 from __future__ import annotations
 
 import json
+import os
 import sys
+import traceback
 
 import jax
+
+# Backend-init deadline: when the TPU relay is down, jax.devices()
+# HANGS inside PJRT client init (observed round 5) rather than raising
+# the round-4 "UNAVAILABLE" — a watchdog turns either failure mode
+# into the structured error record below.
+_INIT_TIMEOUT_S = float(os.environ.get("DJTPU_BENCH_INIT_TIMEOUT", 300))
+
+
+def _init_devices():
+    import concurrent.futures
+
+    ex = concurrent.futures.ThreadPoolExecutor(1)
+    fut = ex.submit(jax.devices)
+    try:
+        return fut.result(timeout=_INIT_TIMEOUT_S)
+    except concurrent.futures.TimeoutError:
+        raise RuntimeError(
+            f"backend init did not complete within {_INIT_TIMEOUT_S:g}s "
+            "(TPU relay down?)"
+        ) from None
 
 BUILD_NROWS = 10_000_000
 PROBE_NROWS = 10_000_000
@@ -48,7 +70,33 @@ ITERS = 8
 BASELINE_M_ROWS_PER_SEC_PER_CHIP = 125.0
 
 
-def main() -> None:
+def main() -> int:
+    # Backend init (jax.devices()) is the first thing that can fail when
+    # the TPU relay is down.  An outage must still leave a parseable
+    # one-line JSON artifact (VERDICT r4 missing #1), not a bare
+    # traceback with rc=1 — the driver records stdout verbatim.
+    try:
+        return _run()
+    except Exception as exc:  # noqa: BLE001 — any init/runtime failure
+        print(
+            json.dumps(
+                {
+                    "metric": "join throughput",
+                    "value": None,
+                    "unit": "M rows/sec/chip",
+                    "vs_baseline": None,
+                    "error": f"{type(exc).__name__}: {exc}",
+                    "traceback": traceback.format_exc().splitlines()[-3:],
+                }
+            ),
+            flush=True,
+        )
+        # A hung init thread (relay down) would block normal interpreter
+        # exit; the record is already flushed, so leave hard.
+        os._exit(0)
+
+
+def _run() -> int:
     from distributed_join_tpu.parallel.communicator import (
         LocalCommunicator,
         TpuCommunicator,
@@ -57,7 +105,7 @@ def main() -> None:
     from distributed_join_tpu.utils.benchmarking import timed_join_throughput
     from distributed_join_tpu.utils.generators import generate_build_probe_tables
 
-    n_dev = len(jax.devices())
+    n_dev = len(_init_devices())
     comm = LocalCommunicator() if n_dev == 1 else TpuCommunicator(n_ranks=n_dev)
 
     build, probe = generate_build_probe_tables(
@@ -104,6 +152,7 @@ def main() -> None:
             }
         )
     )
+    return 0
 
 
 if __name__ == "__main__":

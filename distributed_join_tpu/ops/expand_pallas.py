@@ -245,9 +245,9 @@ def _merge_rows8(rows_f32: jax.Array, k: int):
     return out
 
 
-def _expand_kernel(r0b_ref, s_hbm, v_hbm, out_ref, s_vmem, v_vmem,
-                   sem_s, sem_v, *, block: int, chunk: int, ck: int,
-                   srow: int):
+def _expand_kernel(r0b_ref, ib_ref, s_hbm, v_hbm, out_ref, s_vmem,
+                   v_vmem, sem_s, sem_v, *, block: int, chunk: int,
+                   ck: int, srow: int):
     """Per-output-block body, record expansion only (the build path
     runs _expand_kernel_b8); see module docstring for the scheme.
 
@@ -279,8 +279,12 @@ def _expand_kernel(r0b_ref, s_hbm, v_hbm, out_ref, s_vmem, v_vmem,
 
     # Global output position of each row in this block, as a COLUMN
     # (broadcasted_iota emits 2-D directly; Mosaic cannot reshape a
-    # 1-D vector into the sublane dimension).
-    j = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0) + i * b
+    # 1-D vector into the sublane dimension). The absolute block start
+    # comes from SMEM, not i*b: under output tiling (ADVICE r4 — the
+    # monolithic (ck, out_pad) f32 buffer OOMs at spec-scale
+    # capacities, same class the build path fixed in round 4) this
+    # invocation covers blocks [tile_start, ...) of the global output.
+    j = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0) + ib_ref[i]
     s_win = s_vmem[...]
     acc = jnp.zeros((ck, b), jnp.float32)
     for t in range(0, 2 * b, chunk):
@@ -469,6 +473,18 @@ def _expand_gather_b8(S, cols, out_capacity, block, interpret, lo,
 
     chunk = _default_chunk(block)
     w1w, w2w = _window_widths(block, chunk)
+    # The kernel clips every block-relative quantity to +-CL = 2^20
+    # (see _expand_kernel_b8).  Those quantities are bounded by a few
+    # blocks plus one window width; `block` is user-configurable
+    # (DJTPU_PALLAS_BLOCK / --kernel-block / cfg.block), so an
+    # oversized block must fail loudly here, not corrupt ranks via a
+    # distorting clip (ADVICE r4).
+    if not 3 * block + max(w1w, w2w) < (1 << 20):
+        raise ValueError(
+            f"kernel block {block} too large: 3*block + window "
+            f"({3 * block + max(w1w, w2w)}) must stay below the "
+            f"2^20 block-relative clip bound"
+        )
     wr = w1w  # record window: b+128 coverage, 128-aligned, chunk-mult
     k = len(cols)
     kb = len(build_cols)
@@ -752,47 +768,83 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
     # Under shard_map with vma checking, the out_shape must carry how
     # the output varies over mesh axes — same as the inputs.
     vma = getattr(jax.typeof(vT), "vma", None)
-    out_shape = (
-        jax.ShapeDtypeStruct((ck, out_pad), jnp.float32, vma=vma)
-        if vma is not None
-        else jax.ShapeDtypeStruct((ck, out_pad), jnp.float32)
-    )
-    # Global x64 breaks Mosaic legalization ("failed to legalize
-    # func.return" — i64 index plumbing); every type here is explicit
-    # i32/f32, so scope x64 off around the kernel. The offsets ride a
-    # plain SMEM input + manual DMA because PrefetchScalarGridSpec
-    # also fails to legalize with this toolchain.
+    # Output TILING (ADVICE r4): same scheme as the build wrapper — a
+    # monolithic (ck, out_pad) f32 buffer exceeds HBM at spec-scale
+    # capacities, and this wrapper serves the lax.cond fallback branch
+    # whose gate now admits out_capacity up to 2^31-2. The kernel
+    # takes absolute block starts from SMEM, so one compiled kernel
+    # covers any output range; tiles serialize through an
+    # optimization_barrier dep so buffer assignment reuses the space.
     chunk = _default_chunk(block)
-    with jax.enable_x64(False):
-        out = pl.pallas_call(
-            functools.partial(
-                _expand_kernel, block=block, chunk=chunk,
-                ck=ck, srow=srow,
-            ),
-            grid=(out_pad // block,),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.SMEM),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
-            out_specs=pl.BlockSpec((ck, block), lambda i: (0, i)),
-            scratch_shapes=[
-                pltpu.VMEM((2 * block,), jnp.int32),
-                pltpu.VMEM((ck, 2 * block), jnp.float32),
-                pltpu.SemaphoreType.DMA(()),
-                pltpu.SemaphoreType.DMA(()),
-            ],
-            out_shape=out_shape,
-            interpret=interpret,
-        )(r0b, S, vT)
-    rec_outs = [c[:out_capacity] for c in _merge_rows(out, k)]
-    if s_u64_lane:
-        start_b = (
-            _merge_rows(out[srow : srow + 3], 1)[0][:out_capacity]
-            .astype(jnp.int32)
+    n_blocks = out_pad // block
+    tile_bytes = ck * 4 * out_pad
+    n_tiles = min(max(1, -(-tile_bytes // _FUSED_TILE_BYTES)), n_blocks)
+    tile_blocks = -(-n_blocks // n_tiles)
+    pieces = []
+    dep = jnp.int32(0)
+    for q in range(0, n_blocks, tile_blocks):
+        qb = min(tile_blocks, n_blocks - q)
+        ib_arr = (
+            jnp.arange(qb, dtype=jnp.int32) + jnp.int32(q)
+        ) * block + dep
+        out_shape = (
+            jax.ShapeDtypeStruct((ck, qb * block), jnp.float32, vma=vma)
+            if vma is not None
+            else jax.ShapeDtypeStruct((ck, qb * block), jnp.float32)
         )
+        # Global x64 breaks Mosaic legalization ("failed to legalize
+        # func.return" — i64 index plumbing); every type here is
+        # explicit i32/f32, so scope x64 off around the kernel. The
+        # offsets ride a plain SMEM input + manual DMA because
+        # PrefetchScalarGridSpec also fails to legalize with this
+        # toolchain.
+        with jax.enable_x64(False):
+            out = pl.pallas_call(
+                functools.partial(
+                    _expand_kernel, block=block, chunk=chunk,
+                    ck=ck, srow=srow,
+                ),
+                grid=(qb,),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec(memory_space=pltpu.SMEM),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                    pl.BlockSpec(memory_space=pl.ANY),
+                ],
+                out_specs=pl.BlockSpec((ck, block), lambda i: (0, i)),
+                scratch_shapes=[
+                    pltpu.VMEM((2 * block,), jnp.int32),
+                    pltpu.VMEM((ck, 2 * block), jnp.float32),
+                    pltpu.SemaphoreType.DMA(()),
+                    pltpu.SemaphoreType.DMA(()),
+                ],
+                out_shape=out_shape,
+                interpret=interpret,
+            )(r0b[q : q + qb], ib_arr, S, vT)
+        # Merge to u64 PER TILE: concatenating the raw f32 pieces
+        # would keep every tile alive at once — the exact monolithic
+        # footprint the tiling exists to avoid.
+        if s_u64_lane:
+            sb_piece = (
+                _merge_rows(out[srow : srow + 3], 1)[0]
+                .astype(jnp.int32)
+            )
+        else:
+            sb_piece = out[srow].astype(jnp.int32)
+        pieces.append((_merge_rows(out, k), sb_piece))
+        dep = lax.optimization_barrier(
+            (jnp.int32(0), out[0, 0])
+        )[0]
+    if len(pieces) == 1:
+        rec_full, sb_full = pieces[0]
     else:
-        start_b = out[srow, :out_capacity].astype(jnp.int32)
+        rec_full = [
+            jnp.concatenate([p[0][t] for p in pieces])
+            for t in range(k)
+        ]
+        sb_full = jnp.concatenate([p[1] for p in pieces])
+    rec_outs = [c[:out_capacity] for c in rec_full]
+    start_b = sb_full[:out_capacity]
     return rec_outs, start_b
 
 

@@ -54,7 +54,10 @@ class HeavyHitters:
     key dtype's max sentinel and are masked by ``slot_valid``."""
 
     keys: jax.Array        # (K,) key dtype
-    counts: jax.Array      # (K,) int64 approximate global counts
+    counts: jax.Array      # (K,) int64 approximate global counts; when
+    #                        sampled detection ran (the default), these
+    #                        are sampled tallies scaled by ``sample`` —
+    #                        ESTIMATES, not exact tallies.
     slot_valid: jax.Array  # (K,) bool
 
 
@@ -136,7 +139,10 @@ def global_heavy_hitters(
         ) % jnp.int64(n)).astype(jnp.int32)
         keys_d = keys[idx]
         valid_d = valid[idx]
-        thr = threshold // sample
+        # A threshold below ``sample`` truncates to 0, which would make
+        # EVERY sampled key (count >= 1) HH-eligible and divert up to k
+        # arbitrary keys to the HH path — clamp to 1 sampled occurrence.
+        thr = jnp.maximum(threshold // sample, 1)
     else:
         sample = 1
         keys_d, valid_d, thr = keys, valid, threshold

@@ -320,3 +320,34 @@ def test_build_path_output_tiling_exact(monkeypatch):
                           lo=lo, build_cols=bcols)
     for a, b in zip(whole[0] + whole[3], tiled[0] + tiled[3]):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_nonbuild_path_output_tiling_exact(monkeypatch):
+    """Same exactness contract for the NON-build wrapper (the lax.cond
+    fallback branch): its gate admits out_capacity up to 2^31-2, so it
+    needs the same output tiling (ADVICE r4) — and tiling must not
+    change a value or a start_b."""
+    import distributed_join_tpu.ops.expand_pallas as E
+
+    rng = np.random.default_rng(7)
+    S, cols, total = _make_records(rng, 900, 2048, 2)
+    whole, whole_sb = expand_gather(S, cols, 2048, block=128,
+                                    interpret=True)
+    monkeypatch.setattr(E, "_FUSED_TILE_BYTES", 128 * 32)  # few blocks
+    tiled, tiled_sb = expand_gather(S, cols, 2048, block=128,
+                                    interpret=True)
+    for a, b in zip(whole, tiled):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(
+        np.asarray(whole_sb)[:total], np.asarray(tiled_sb)[:total]
+    )
+    # Also cover the u64-S-lane start_b branch under tiling (real
+    # trigger is out_capacity >= 2^24 — force it instead).
+    monkeypatch.setattr(E, "_F32_EXACT", 1)
+    tiled64, tiled64_sb = expand_gather(S, cols, 2048, block=128,
+                                        interpret=True)
+    for a, b in zip(whole, tiled64):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(
+        np.asarray(whole_sb)[:total], np.asarray(tiled64_sb)[:total]
+    )
