@@ -105,7 +105,11 @@ def parse_args(argv=None):
     p.add_argument("--skew-threshold", type=float, default=None,
                    help="enable heavy-hitter handling: a key is heavy "
                         "when its global probe count exceeds this "
-                        "fraction of one rank's probe rows")
+                        "fraction of one rank's probe rows. With "
+                        "--zipf-alpha this DEFAULTS ON (0.001, the "
+                        "measured sweep default) with HH capacities "
+                        "pre-sized from alpha; pass 0 to force the "
+                        "naive path")
     p.add_argument("--hh-slots", type=int, default=64,
                    help="static heavy-hitter key slots")
     p.add_argument("--hh-probe-capacity", type=int, default=None,
@@ -222,6 +226,43 @@ def run(args) -> dict:
     build, probe = comm.device_put_sharded((build, probe))
     jax.block_until_ready((build, probe))
 
+    # Skew auto-policy (round 5): a known Zipf workload runs the skew
+    # path by default, with the HH blocks PRE-sized from alpha via the
+    # top-K mass model (parallel/skew.zipf_top_k_mass) — the first run
+    # must not overflow into an auto_retry recompile the way the
+    # generic p_rows/8 defaults did at alpha >= 1.4. Threshold 0.001
+    # is the measured sweep default (results/config3_sweep_skew.json);
+    # --skew-threshold 0 forces the naive path.
+    skew_threshold = args.skew_threshold
+    hh_probe_cap = args.hh_probe_capacity
+    hh_out_cap = args.hh_out_capacity
+    skew_policy = None
+    if skew_threshold is not None and skew_threshold <= 0:
+        skew_threshold = None
+    elif args.zipf_alpha is not None and skew_threshold is None:
+        from distributed_join_tpu.parallel.skew import zipf_top_k_mass
+
+        skew_threshold = 0.001
+        domain = args.rand_max or b_rows
+        f_top = zipf_top_k_mass(args.zipf_alpha, domain, args.hh_slots)
+        p_local = p_rows // n
+        if hh_probe_cap is None:
+            # 1.3x slack over the expected HH mass; never beyond the
+            # rank's own rows (HH probe rows stay local).
+            hh_probe_cap = min(p_local, int(1.3 * f_top * p_local) + 1024)
+        if hh_out_cap is None:
+            # each HH probe row matches ~once against the (unique-key)
+            # build side; 2x covers moderate build duplication.
+            hh_out_cap = min(
+                int(1.3 * p_local), int(2.6 * f_top * p_local) + 1024
+            )
+        skew_policy = {
+            "auto": True,
+            "top_k_mass": round(f_top, 4),
+            "hh_probe_capacity": hh_probe_cap,
+            "hh_out_capacity": hh_out_cap,
+        }
+
     step = make_join_step(
         comm,
         key=join_key,
@@ -233,10 +274,10 @@ def run(args) -> dict:
         over_decomposition=args.over_decomposition_factor,
         shuffle_capacity_factor=args.shuffle_capacity_factor,
         out_capacity_factor=args.out_capacity_factor,
-        skew_threshold=args.skew_threshold,
+        skew_threshold=skew_threshold,
         hh_slots=args.hh_slots,
-        hh_probe_capacity=args.hh_probe_capacity,
-        hh_out_capacity=args.hh_out_capacity,
+        hh_probe_capacity=hh_probe_cap,
+        hh_out_capacity=hh_out_cap,
     )
     iters = args.iterations
 
@@ -264,7 +305,8 @@ def run(args) -> dict:
         "compact_kernel": args.compact_kernel,
         "kernel_block": args.kernel_block,
         "zipf_alpha": args.zipf_alpha,
-        "skew_threshold": args.skew_threshold,
+        "skew_threshold": skew_threshold,
+        "skew_policy": skew_policy,
         "key_columns": args.key_columns,
         "string_payload_bytes": args.string_payload_bytes,
         "string_key_bytes": args.string_key_bytes,

@@ -465,6 +465,43 @@ def _expand_kernel_b8(*refs, block: int, chunk: int, ck8: int,
 _FUSED_TILE_BYTES = 2 << 30
 
 
+def _tiled_output_launch(n_blocks, block, tile_bytes, launch, merge):
+    """Shared output-tiling driver for both expand wrappers: run
+    ``launch(q, qb, ib_arr) -> raw f32 (rows, qb*block)`` once per
+    HBM-budget tile of output blocks, ``merge(out) -> pytree of 1-D
+    arrays`` per tile, and concatenate the merged pieces.
+
+    Two invariants live ONLY here (review r5 — they were hand-copied
+    in both wrappers before):
+    - tile sizing: ceil-divide ``tile_bytes`` into the
+      ``_FUSED_TILE_BYTES`` budget, never more tiles than blocks;
+    - serialization: each tile's absolute block starts ``ib_arr``
+      carry a ``dep`` tied to the previous tile's output through an
+      optimization_barrier — a plain ``x * 0`` would be algebraically
+      folded to a constant, severing the ordering that lets buffer
+      assignment reuse the f32 space across tiles.
+    """
+    n_tiles = min(max(1, -(-tile_bytes // _FUSED_TILE_BYTES)), n_blocks)
+    tile_blocks = -(-n_blocks // n_tiles)
+    pieces = []
+    dep = jnp.int32(0)
+    for q in range(0, n_blocks, tile_blocks):
+        qb = min(tile_blocks, n_blocks - q)
+        ib_arr = (
+            jnp.arange(qb, dtype=jnp.int32) + jnp.int32(q)
+        ) * block + dep
+        out = launch(q, qb, ib_arr)
+        pieces.append(merge(out))
+        dep = lax.optimization_barrier(
+            (jnp.int32(0), out[0, 0])
+        )[0]
+    if len(pieces) == 1:
+        return pieces[0]
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs), *pieces
+    )
+
+
 def _expand_gather_b8(S, cols, out_capacity, block, interpret, lo,
                       build_cols):
     """v3 build-mode wrapper; see _expand_kernel_b8."""
@@ -582,21 +619,10 @@ def _expand_gather_b8(S, cols, out_capacity, block, interpret, lo,
     # monolithic buffer exceeds HBM (fused_build_hbm_bytes). The
     # kernel is block-relative with absolute block starts from SMEM,
     # so the SAME compiled kernel covers any output range — run it
-    # per tile and concatenate the merged u64 pieces. Tiles are
-    # serialized by a data dependency (dep rides into the next tile's
-    # SMEM array) so buffer assignment can reuse the f32 space.
-    n_blocks = out_pad // block
-    tile_bytes = (ck8 + ckb8) * 4 * out_pad
-    n_tiles = min(max(1, -(-tile_bytes // _FUSED_TILE_BYTES)), n_blocks)
-    tile_blocks = -(-n_blocks // n_tiles)
-    pieces = []
-    dep = jnp.int32(0)
-    for q in range(0, n_blocks, tile_blocks):
-        qb = min(tile_blocks, n_blocks - q)
+    # per tile and concatenate the merged u64 pieces
+    # (_tiled_output_launch owns the tile sizing + serialization).
+    def _launch(q, qb, ib_arr):
         sl = slice(q, q + qb)
-        ib_arr = (
-            jnp.arange(qb, dtype=jnp.int32) + jnp.int32(q)
-        ) * block + dep
         out_shape = (
             jax.ShapeDtypeStruct((ck8 + ckb8, qb * block),
                                  jnp.float32, vma=vma)
@@ -605,10 +631,10 @@ def _expand_gather_b8(S, cols, out_capacity, block, interpret, lo,
                                       jnp.float32)
         )
         # x64 scoped off around the pallas_call ONLY: Mosaic fails to
-        # legalize with global x64, but the u64 merge below must see
-        # real 64-bit types or it silently truncates to u32.
+        # legalize with global x64, but the u64 merge must see real
+        # 64-bit types or it silently truncates to u32.
         with jax.enable_x64(False):
-            out = pl.pallas_call(
+            return pl.pallas_call(
                 functools.partial(
                     _expand_kernel_b8, block=block, chunk=chunk,
                     ck8=ck8, ckb8=ckb8, wr=wr, w1w=w1w, w2w=w2w,
@@ -638,28 +664,11 @@ def _expand_gather_b8(S, cols, out_capacity, block, interpret, lo,
                 out_shape=out_shape,
                 interpret=interpret,
             )(r0a[sl], w1a[sl], w2a[sl], ib_arr, v8T, auxT, bv8T)
-        piece = (
-            _merge_rows8(out, k),
-            _merge_rows8(out[ck8:], kb),
-        )
-        # A plain `x * 0` dependency would be algebraically folded to
-        # a constant, severing the ordering; the barrier ties the next
-        # tile's SMEM input to this tile's output un-simplifiably.
-        dep = lax.optimization_barrier(
-            (jnp.int32(0), out[0, 0])
-        )[0]
-        pieces.append(piece)
-    if len(pieces) == 1:
-        rec_full, build_full = pieces[0]
-    else:
-        rec_full = [
-            jnp.concatenate([p[0][t] for p in pieces])
-            for t in range(k)
-        ]
-        build_full = [
-            jnp.concatenate([p[1][t] for p in pieces])
-            for t in range(kb)
-        ]
+
+    rec_full, build_full = _tiled_output_launch(
+        out_pad // block, block, (ck8 + ckb8) * 4 * out_pad, _launch,
+        lambda out: (_merge_rows8(out, k), _merge_rows8(out[ck8:], kb)),
+    )
     rec_outs = [c[:out_capacity] for c in rec_full]
     build_outs = [c[:out_capacity] for c in build_full]
     # start_b/rank placeholders (consumed in-kernel only); derived from
@@ -773,20 +782,13 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
     # capacities, and this wrapper serves the lax.cond fallback branch
     # whose gate now admits out_capacity up to 2^31-2. The kernel
     # takes absolute block starts from SMEM, so one compiled kernel
-    # covers any output range; tiles serialize through an
-    # optimization_barrier dep so buffer assignment reuses the space.
+    # covers any output range (_tiled_output_launch owns the tile
+    # sizing + serialization). Merging to u64 happens PER TILE:
+    # concatenating raw f32 pieces would keep every tile alive at
+    # once — the exact monolithic footprint tiling exists to avoid.
     chunk = _default_chunk(block)
-    n_blocks = out_pad // block
-    tile_bytes = ck * 4 * out_pad
-    n_tiles = min(max(1, -(-tile_bytes // _FUSED_TILE_BYTES)), n_blocks)
-    tile_blocks = -(-n_blocks // n_tiles)
-    pieces = []
-    dep = jnp.int32(0)
-    for q in range(0, n_blocks, tile_blocks):
-        qb = min(tile_blocks, n_blocks - q)
-        ib_arr = (
-            jnp.arange(qb, dtype=jnp.int32) + jnp.int32(q)
-        ) * block + dep
+
+    def _launch(q, qb, ib_arr):
         out_shape = (
             jax.ShapeDtypeStruct((ck, qb * block), jnp.float32, vma=vma)
             if vma is not None
@@ -799,7 +801,7 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
         # PrefetchScalarGridSpec also fails to legalize with this
         # toolchain.
         with jax.enable_x64(False):
-            out = pl.pallas_call(
+            return pl.pallas_call(
                 functools.partial(
                     _expand_kernel, block=block, chunk=chunk,
                     ck=ck, srow=srow,
@@ -821,28 +823,19 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
                 out_shape=out_shape,
                 interpret=interpret,
             )(r0b[q : q + qb], ib_arr, S, vT)
-        # Merge to u64 PER TILE: concatenating the raw f32 pieces
-        # would keep every tile alive at once — the exact monolithic
-        # footprint the tiling exists to avoid.
+
+    def _merge(out):
         if s_u64_lane:
-            sb_piece = (
-                _merge_rows(out[srow : srow + 3], 1)[0]
-                .astype(jnp.int32)
+            sb = _merge_rows(out[srow : srow + 3], 1)[0].astype(
+                jnp.int32
             )
         else:
-            sb_piece = out[srow].astype(jnp.int32)
-        pieces.append((_merge_rows(out, k), sb_piece))
-        dep = lax.optimization_barrier(
-            (jnp.int32(0), out[0, 0])
-        )[0]
-    if len(pieces) == 1:
-        rec_full, sb_full = pieces[0]
-    else:
-        rec_full = [
-            jnp.concatenate([p[0][t] for p in pieces])
-            for t in range(k)
-        ]
-        sb_full = jnp.concatenate([p[1] for p in pieces])
+            sb = out[srow].astype(jnp.int32)
+        return _merge_rows(out, k), sb
+
+    rec_full, sb_full = _tiled_output_launch(
+        out_pad // block, block, ck * 4 * out_pad, _launch, _merge
+    )
     rec_outs = [c[:out_capacity] for c in rec_full]
     start_b = sb_full[:out_capacity]
     return rec_outs, start_b

@@ -1,0 +1,96 @@
+"""Round-5: does over-decomposition beat the merged sort's
+superlinearity at spec scale?
+
+VERDICT r4 weak #1 / next #2: the only named single-chip term left
+between 60 M rows/s (50M+50M, OUT=0.75N) and the 125 M/chip north-star
+derivative is the merged sort's superlinear growth — standalone
+``lax.sort`` went 164 -> 858 ms for 20M -> 100M elements
+(results/scale_curve_r4.json "not_the_sort"), i.e. ~2.6x the per-element
+cost. ROOFLINE §8's last line observes the run-length win pays "when
+data ARRIVES pre-bucketed — which is exactly what the cross-rank
+shuffle provides"; on one chip, ``over_decomposition=k`` manufactures
+the same regime: ONE shared partition sort (hash-bucket, k buckets),
+then k independent joins whose merged sorts are each k-times smaller.
+
+The trade measured here, per join at N=50M+50M on one v5e chip:
+  cost(k) = partition_sort(N) + k * merged_sort(2N/k) + k * fixed
+The partition sort is itself superlinear in N but runs ONCE; the k
+merged sorts ride the shallow end of the curve; ``fixed`` is per-batch
+kernel/launch overhead (measured ~small at 10M in ROOFLINE §7).
+
+Sweeps k = 1/2/4/8/16 under BOTH capacity stories (driver contract
+out_capacity_factor=1.2, and match-sized 0.75N) and writes
+results/kdecomp_sweep_r5.json. Honest-timing protocol: chained
+dependent iterations in one compiled loop (utils/benchmarking).
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_r5_kdecomp.py
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+import jax
+
+from distributed_join_tpu.parallel.communicator import LocalCommunicator
+from distributed_join_tpu.parallel.distributed_join import make_join_step
+from distributed_join_tpu.utils.benchmarking import timed_join_throughput
+from distributed_join_tpu.utils.generators import (
+    generate_build_probe_tables,
+)
+
+N_M = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+KS = [1, 2, 4, 8, 16]
+ITERS = 4
+OUT_FRAC_MATCH = 0.75
+
+
+def main() -> None:
+    n = N_M * 1_000_000
+    comm = LocalCommunicator()
+    build, probe = generate_build_probe_tables(
+        seed=42, build_nrows=n, probe_nrows=n, selectivity=0.3
+    )
+    jax.block_until_ready((build.columns, probe.columns))
+
+    out = {
+        "n_rows_per_side": n,
+        "iters": ITERS,
+        "contract": {},
+        "match_sized": {},
+    }
+    for k in KS:
+        for story, sizing in (
+            ("contract", {}),
+            ("match_sized", {"out_rows_per_rank": int(n * OUT_FRAC_MATCH)}),
+        ):
+            step = make_join_step(
+                comm, key="key", over_decomposition=k, **sizing
+            )
+            per_join, total, overflow = timed_join_throughput(
+                comm, step, build, probe, ITERS
+            )
+            m_rows = 2 * n / per_join / 1e6
+            out[story][str(k)] = {
+                "s_per_join": per_join,
+                "m_rows_per_s": round(m_rows, 2),
+                "matches": int(total),
+                "overflow": bool(overflow),
+            }
+            print(
+                f"k={k:2d} {story:11s}: {per_join*1e3:8.1f} ms "
+                f"-> {m_rows:6.1f} M rows/s"
+                f"{'  OVERFLOW' if overflow else ''}",
+                flush=True,
+            )
+
+    p = pathlib.Path(__file__).resolve().parent.parent / "results" / \
+        f"kdecomp_sweep_{N_M}M_r5.json"
+    p.write_text(json.dumps(out, indent=2))
+    print("wrote", p)
+
+
+if __name__ == "__main__":
+    main()

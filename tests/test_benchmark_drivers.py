@@ -60,6 +60,43 @@ def test_join_driver_over_decomposition_and_dupes():
     assert not record["overflow"]
 
 
+def test_join_driver_zipf_skew_auto_policy():
+    """--zipf-alpha ALONE must run the skew path (threshold defaults
+    ON, HH blocks pre-sized from alpha's top-K mass) with no overflow
+    on the first compile; --skew-threshold 0 must force naive."""
+    argv = ["--build-table-nrows", "65536", "--probe-table-nrows",
+            "65536", "--communicator", "tpu", "--iterations", "1",
+            "--zipf-alpha", "1.5", "--shuffle-capacity-factor", "1.6",
+            "--out-capacity-factor", "3.0"]
+    record = dj_driver.run(dj_driver.parse_args(argv))
+    assert record["skew_threshold"] == 0.001
+    assert record["skew_policy"]["auto"]
+    # alpha=1.5 concentrates ~90% of draws on the top-64 keys
+    assert 0.85 < record["skew_policy"]["top_k_mass"] < 0.95
+    assert not record["overflow"]
+    assert record["matches_per_join"] > 0
+
+    naive = dj_driver.run(dj_driver.parse_args(
+        argv + ["--skew-threshold", "0",
+                "--shuffle-capacity-factor", "4.0"]))
+    assert naive["skew_threshold"] is None
+    assert naive["skew_policy"] is None
+    assert naive["matches_per_join"] == record["matches_per_join"]
+
+
+def test_zipf_top_k_mass_model():
+    from distributed_join_tpu.parallel.skew import zipf_top_k_mass
+
+    # exact tiny case: n=3, k=1, alpha=1 -> 1 / (1 + 1/2 + 1/3)
+    assert abs(zipf_top_k_mass(1.0, 3, 1) - 6 / 11) < 1e-12
+    # monotone in k, bounded by 1, k >= n saturates
+    assert zipf_top_k_mass(1.5, 10**8, 64) < zipf_top_k_mass(
+        1.5, 10**8, 256) < 1.0
+    assert zipf_top_k_mass(1.5, 100, 100) == 1.0
+    # the headline regime: alpha=1.5 over a 1e8 domain, top-64 ~ 90%
+    assert 0.89 < zipf_top_k_mass(1.5, 10**8, 64) < 0.92
+
+
 def test_join_driver_rejects_gpu_backends():
     args = dj_driver.parse_args(["--communicator", "nccl"])
     with pytest.raises(ValueError, match="tpu"):

@@ -242,6 +242,65 @@ def test_batched_join_host_vs_oracle(q3):
     assert stats["build_capacity"] % comm.n_ranks == 0
 
 
+def test_batched_join_overlapped_fetch_consumer():
+    """A consumer that MATERIALIZES outputs (the --fetch-results
+    semantics) runs on the fetch worker in batch order; the oracle
+    total must be unchanged and the new fetch_s/fetch_wait_s phases
+    populated. A consumer exception must surface, not vanish on the
+    worker."""
+    from distributed_join_tpu.parallel.out_of_core import (
+        batched_join_host,
+    )
+    from distributed_join_tpu.utils.tpch_host import (
+        generate_tpch_host_batches,
+        rename_batches,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    ob, lb = generate_tpch_host_batches(
+        seed=7, scale_factor=SF, n_batches=3, chunk_orders=700,
+    )
+    build_b = rename_batches(ob, {"o_orderkey": "key"})
+    probe_b = rename_batches(lb, {"l_orderkey": "key"})
+
+    got = []
+    stats = {}
+
+    def consumer(b, res):
+        # materialize every output column to host, like the driver's
+        # --fetch-results; count valid rows per batch
+        cols = {n: np.asarray(c) for n, c in res.table.columns.items()}
+        valid = np.asarray(res.table.valid)
+        assert all(c.shape[0] == valid.shape[0] for c in cols.values())
+        got.append((b, int(valid.sum())))
+
+    total, overflow = batched_join_host(
+        build_b, probe_b, comm,
+        out_capacity_factor=4.0, shuffle_capacity_factor=4.0,
+        on_batch_result=consumer, stats=stats,
+    )
+    want = len(
+        _host_batches_to_pandas(build_b, "key").merge(
+            _host_batches_to_pandas(probe_b, "key"), on="key"
+        )
+    )
+    assert [b for b, _ in got] == [0, 1, 2]
+    assert sum(c for _, c in got) == total == want > 0
+    assert not overflow
+    assert stats["fetch_s"] > 0
+    assert stats["fetch_wait_s"] >= 0
+
+    def bad_consumer(b, res):
+        raise RuntimeError("consumer boom")
+
+    with pytest.raises(RuntimeError, match="consumer boom"):
+        batched_join_host(
+            build_b, probe_b, comm,
+            out_capacity_factor=4.0, shuffle_capacity_factor=4.0,
+            on_batch_result=bad_consumer,
+        )
+
+
 def test_host_generator_q3_filters_drop_rows():
     from distributed_join_tpu.utils.tpch_host import (
         generate_tpch_host_batches,
