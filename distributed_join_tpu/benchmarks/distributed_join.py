@@ -124,6 +124,14 @@ def parse_args(argv=None):
     p.add_argument("--string-payload-bytes", type=int, default=0,
                    help="attach a fixed-width string payload of this "
                         "many bytes to the build side (config 5)")
+    p.add_argument("--string-payload-columns", type=int, default=1,
+                   help="number of string payload columns (all ship "
+                        "byte-exactly in ragged mode; round 5 lifted "
+                        "the one-column limit)")
+    p.add_argument("--variable-length-strings", action="store_true",
+                   help="render string payload ids without leading "
+                        "zeros so row lengths vary — the regime where "
+                        "the byte-exact ragged wire saves real bytes")
     p.add_argument("--string-key-bytes", type=int, default=0,
                    help="join on a fixed-width STRING key of this many "
                         "bytes (derived from the int key; packed-word "
@@ -135,25 +143,37 @@ def parse_args(argv=None):
 
 
 def _string_wire_accounting(build, shuffle_mode):
-    """Exact vs fixed-width wire bytes for the build side's string
-    payload column (the byte-exact plane exchange runs in ragged mode;
-    parallel/shuffle.shuffle_ragged varwidth)."""
+    """Exact vs fixed-width wire bytes for EVERY byte-exact-eligible
+    string column on the build side (the plane exchange runs in ragged
+    mode; parallel/shuffle.shuffle_ragged varwidth)."""
     import numpy as np
 
     from distributed_join_tpu.parallel.distributed_join import (
-        _varwidth_col,
+        _varwidth_cols,
     )
 
-    name = _varwidth_col(build)
-    if name is None:
+    names = _varwidth_cols(build)
+    if not names:
         return None
-    col = build.columns[name]
-    lens = np.asarray(build.columns[name + "#len"])
-    exact = int(((lens.astype(np.int64) + 3) // 4 * 4).sum())
+    per_col, fixed_total, exact_total = {}, 0, 0
+    for name in names:
+        col = build.columns[name]
+        lens = np.asarray(build.columns[name + "#len"])
+        fixed = int(col.shape[0]) * int(col.shape[1])
+        exact = int(((lens.astype(np.int64) + 3) // 4 * 4).sum())
+        per_col[name] = {
+            "fixed_width_bytes": fixed,
+            "exact_bytes": exact,
+        }
+        fixed_total += fixed
+        exact_total += exact
     return {
-        "column": name,
-        "fixed_width_bytes": int(col.shape[0]) * int(col.shape[1]),
-        "exact_bytes": exact,
+        "columns": per_col,
+        "fixed_width_bytes": fixed_total,
+        "exact_bytes": exact_total,
+        "savings_pct": round(
+            100.0 * (1 - exact_total / fixed_total), 2
+        ) if fixed_total else 0.0,
         "byte_exact_on_wire": shuffle_mode == "ragged",
     }
 
@@ -178,6 +198,12 @@ def run(args) -> dict:
     if b_rows % n or p_rows % n:
         raise SystemExit(f"table nrows must be divisible by n_ranks={n}")
 
+    if args.string_payload_bytes % 4:
+        # The byte-exact ragged wire ships u32 planes: a width not
+        # divisible by 4 would silently fall back to fixed-width
+        # shipping with string_wire_bytes = null — fail loudly instead.
+        raise SystemExit("--string-payload-bytes must be a multiple "
+                         "of 4 (u32-plane byte-exact wire)")
     join_key = "key"
     if args.key_columns > 1 or args.string_payload_bytes > 0:
         if args.zipf_alpha is not None:
@@ -193,6 +219,8 @@ def run(args) -> dict:
             rand_max=args.rand_max,
             selectivity=args.selectivity,
             string_payload_len=args.string_payload_bytes,
+            string_payload_columns=args.string_payload_columns,
+            variable_length_strings=args.variable_length_strings,
             unique_build_keys=not args.duplicate_build_keys,
         )
         join_key = key_names if args.key_columns > 1 else key_names[0]
@@ -309,6 +337,8 @@ def run(args) -> dict:
         "skew_policy": skew_policy,
         "key_columns": args.key_columns,
         "string_payload_bytes": args.string_payload_bytes,
+        "string_payload_columns": args.string_payload_columns,
+        "variable_length_strings": args.variable_length_strings,
         "string_key_bytes": args.string_key_bytes,
         "string_wire_bytes": _string_wire_accounting(build, args.shuffle),
         "matches_per_join": matches,

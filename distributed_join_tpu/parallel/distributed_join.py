@@ -57,23 +57,25 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _varwidth_col(table: Table) -> Optional[str]:
-    """First 2-D uint8 column with a '<name>#len' companion and
-    4-aligned width — the column the ragged shuffle ships byte-exactly
-    (one per table; any further string columns ride row-exact
-    fixed-width)."""
-    for name, c in table.columns.items():
+def _varwidth_cols(table: Table) -> list:
+    """ALL 2-D uint8 columns with a '<name>#len' companion and
+    4-aligned width — the columns the ragged shuffle ships
+    byte-exactly (round 5 lifted the old one-per-table limit: the
+    first rides the partition's order_within; further ones are
+    within-bucket length-sorted by the shuffle itself, see
+    shuffle.shuffle_ragged)."""
+    return [
+        name for name, c in table.columns.items()
         if (c.ndim == 2 and c.dtype == jnp.uint8
-                and c.shape[1] % 4 == 0
-                and name + "#len" in table.columns):
-            return name
-    return None
+            and c.shape[1] % 4 == 0
+            and name + "#len" in table.columns)
+    ]
 
 
 def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
                    mode: str = "padded",
                    compression_bits: Optional[int] = None,
-                   varwidth: Optional[str] = None):
+                   varwidth=None):
     if mode == "ragged":
         # Exact-size exchange: receive buffer = the same total rows the
         # padded layout would flatten to, but wire bytes = actual rows.
@@ -309,18 +311,20 @@ def make_join_step(
             overflow = overflow | res.overflow
         else:
             # Byte-exact string wire (ragged mode): order each bucket
-            # by the string column's length desc so its u32 planes
-            # ship as ragged prefixes (shuffle_ragged's varwidth).
-            vb = _varwidth_col(build_local) if shuffle == "ragged" \
-                else None
-            vp = _varwidth_col(probe_local) if shuffle == "ragged" \
-                else None
+            # by the FIRST string column's length desc so its u32
+            # planes ship as ragged prefixes (shuffle_ragged's
+            # varwidth); further string columns are length-ordered
+            # within the shuffle itself.
+            vb = _varwidth_cols(build_local) if shuffle == "ragged" \
+                else []
+            vp = _varwidth_cols(probe_local) if shuffle == "ragged" \
+                else []
             ptb = radix_hash_partition(
                 build_local, keys_eff, nb,
-                order_within=vb + "#len" if vb else None)
+                order_within=vb[0] + "#len" if vb else None)
             ptp = radix_hash_partition(
                 probe_local, keys_eff, nb,
-                order_within=vp + "#len" if vp else None)
+                order_within=vp[0] + "#len" if vp else None)
             for b in range(k):
                 recv_build, ovf_b = _batch_shuffle(
                     comm, ptb, b, n, b_cap, mode=shuffle,

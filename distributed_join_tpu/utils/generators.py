@@ -140,10 +140,18 @@ def generate_composite_build_probe_tables(
     selectivity: float = 0.3,
     string_payload_len: int = 0,
     unique_build_keys: bool = False,
+    string_payload_columns: int = 1,
+    variable_length_strings: bool = False,
 ):
-    """Config-5 generator: multi-column keys (+ optional fixed-width
-    string payload on the build side). Returns (build, probe,
-    key_names)."""
+    """Config-5 generator: multi-column keys (+ optional string
+    payload column(s) on the build side). Returns (build, probe,
+    key_names).
+
+    ``string_payload_columns``: how many string payload columns to
+    attach (distinct prefixes; round 5 — exercises the multi-column
+    byte-exact varwidth wire). ``variable_length_strings``: render ids
+    without leading zeros so row lengths VARY — required for the
+    byte-exact wire to show real savings."""
     from distributed_join_tpu.utils.strings import LEN_SUFFIX, encode_int_strings
 
     if rand_max is None:
@@ -165,20 +173,36 @@ def generate_composite_build_probe_tables(
     if string_payload_len > 0:
         import numpy as np
 
-        prefix = "itm-"
-        if string_payload_len <= len(prefix):
-            raise ValueError(
-                f"string_payload_len must exceed {len(prefix)} (the "
-                f"{prefix!r} prefix) so the payload has id digits"
-            )
-        sbytes, slens = encode_int_strings(
-            np.asarray(build.columns["build_payload"]),
-            prefix=prefix,
-            digits=string_payload_len - len(prefix),
-        )
         cols = dict(build.columns)
-        cols["build_tag"] = sbytes
-        cols["build_tag" + LEN_SUFFIX] = slens
+        ids = np.asarray(build.columns["build_payload"])
+        for c in range(string_payload_columns):
+            # Column width is string_payload_len regardless of prefix
+            # — the byte-exact wire's u32-plane requirement (width
+            # divisible by 4) is the CALLER's to meet. Scrambling ids
+            # per column decorrelates the length distributions so the
+            # multi-column wire is not trivially re-using one
+            # permutation.
+            prefix = "itm-" if c == 0 else f"tg{c % 10}-"
+            if string_payload_len <= len(prefix):
+                raise ValueError(
+                    f"string_payload_len must exceed {len(prefix)} "
+                    f"(the {prefix!r} prefix) so the payload has id "
+                    "digits"
+                )
+            col_ids = (
+                ids if c == 0
+                else (ids * (2 * c + 1) + c)
+                % (10 ** min(9, string_payload_len - len(prefix)))
+            )
+            sbytes, slens = encode_int_strings(
+                col_ids,
+                prefix=prefix,
+                digits=string_payload_len - len(prefix),
+                pad_digits=not variable_length_strings,
+            )
+            name = "build_tag" if c == 0 else f"build_tag{c}"
+            cols[name] = sbytes
+            cols[name + LEN_SUFFIX] = slens
         build = Table(cols, build.valid)
     return build, probe, key_names
 

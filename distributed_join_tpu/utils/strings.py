@@ -69,9 +69,16 @@ def decode_strings(bytes_2d: np.ndarray, lengths: np.ndarray | None = None
 
 
 def encode_int_strings(ids: np.ndarray, prefix: str = "itm-",
-                       digits: int = 12):
-    """Vectorized '<prefix><zero-padded id>' encoding — generator-scale
-    string payloads without a Python loop over millions of rows."""
+                       digits: int = 12, pad_digits: bool = True):
+    """Vectorized '<prefix><id>' encoding — generator-scale string
+    payloads without a Python loop over millions of rows.
+
+    ``pad_digits``: zero-pad every id to ``digits`` (fixed row length,
+    the historical behavior). With False, ids render WITHOUT leading
+    zeros — row lengths vary with id magnitude, which is what the
+    byte-exact varwidth wire needs to show real savings (a fixed-len
+    column's exact bytes equal its padded bytes). The byte buffer
+    stays ``len(prefix) + digits`` wide either way."""
     ids = np.asarray(ids)
     # Same no-silent-corruption contract as encode_strings: dropping
     # high digits (or floor-division artifacts on negatives — -1 renders
@@ -86,11 +93,28 @@ def encode_int_strings(ids: np.ndarray, prefix: str = "itm-",
     width = len(praw) + digits
     out = np.empty((ids.shape[0], width), dtype=np.uint8)
     out[:, : len(praw)] = np.frombuffer(praw, dtype=np.uint8)
-    for d in range(digits):
-        out[:, len(praw) + d] = (
-            (ids // 10 ** (digits - 1 - d)) % 10 + ord("0")
-        ).astype(np.uint8)
-    lens = np.full((ids.shape[0],), width, dtype=np.int32)
+    if pad_digits:
+        for d in range(digits):
+            out[:, len(praw) + d] = (
+                (ids // 10 ** (digits - 1 - d)) % 10 + ord("0")
+            ).astype(np.uint8)
+        lens = np.full((ids.shape[0],), width, dtype=np.int32)
+        return jnp.asarray(out), jnp.asarray(lens)
+    # Variable length: nd(id) digits, left-aligned after the prefix,
+    # zero bytes beyond len (the canonical fixed-width representation).
+    # Digit count by exact integer comparison against powers of 10 —
+    # float64 log10 mis-rounds near large powers (log10(10^15 - 1)
+    # rounds to exactly 15.0, over-counting; review r5), and a wrong
+    # nd silently corrupts the rendered id.
+    nd = np.ones(ids.shape, dtype=np.int64)
+    for d in range(1, digits):
+        nd += ids >= 10 ** d
+    for p in range(digits):
+        e = nd - 1 - p
+        alive = e >= 0
+        digit = (ids // 10 ** np.clip(e, 0, None)) % 10 + ord("0")
+        out[:, len(praw) + p] = np.where(alive, digit, 0).astype(np.uint8)
+    lens = (len(praw) + nd).astype(np.int32)
     return jnp.asarray(out), jnp.asarray(lens)
 
 

@@ -205,6 +205,79 @@ def test_varwidth_string_wire_matches_padded():
         assert not s2n[i, int(l2n[i]):].any()
 
 
+def test_multi_varwidth_distributed_join_vs_oracle():
+    """Round 5 (VERDICT r4 #5): SEVERAL variable-width columns ride the
+    ragged wire byte-exactly at once — the first via the partition's
+    order_within, each further one via the shuffle's own within-bucket
+    length sort + receiver-side unsort (reconstructed from the received
+    '#len' companion, no extra wire bytes). Two string columns on the
+    build side, one on the probe side, end-to-end vs pandas."""
+    import numpy as np
+    import pandas as pd
+
+    import distributed_join_tpu as dj
+    from distributed_join_tpu.table import Table
+    from distributed_join_tpu.utils.strings import (
+        decode_strings,
+        encode_strings,
+    )
+
+    rng = np.random.default_rng(29)
+    nb_, np_ = 2048, 4096
+    bkeys = rng.integers(0, 600, nb_)
+    pkeys = rng.integers(0, 600, np_)
+    s_of = {k: f"item-{k}" + "x" * int(k % 17) for k in range(600)}
+    t_of = {k: f"t{k % 7}" * int(k % 5) for k in range(600)}  # incl ""
+    u_of = {k: f"uu-{k * 13}"[: 4 + k % 9] for k in range(600)}
+    bs = [s_of[int(k)] for k in bkeys]
+    bt = [t_of[int(k)] for k in bkeys]
+    pu = [u_of[int(k)] for k in pkeys]
+    sby, sbl = encode_strings(bs, 28)
+    tby, tbl_ = encode_strings(bt, 12)
+    uby, ubl = encode_strings(pu, 12)
+    b = Table.from_dense({
+        "key": jnp.asarray(bkeys, jnp.int64),
+        "s": sby, "s#len": sbl,
+        "t": tby, "t#len": tbl_,
+    })
+    p = Table.from_dense({
+        "key": jnp.asarray(pkeys, jnp.int64),
+        "u": uby, "u#len": ubl,
+        "pp": jnp.asarray(pkeys * 7, jnp.int64),
+    })
+    res = dj.distributed_inner_join(
+        b, p, dj.make_communicator("tpu", n_ranks=8),
+        shuffle="ragged", out_capacity_factor=8.0,
+        shuffle_capacity_factor=3.0,
+    )
+    assert not bool(res.overflow)
+    valid = np.asarray(res.table.valid)
+    got = pd.DataFrame({
+        "key": np.asarray(res.table.columns["key"])[valid],
+        "s": decode_strings(np.asarray(res.table.columns["s"])[valid],
+                            np.asarray(res.table.columns["s#len"])[valid]),
+        "t": decode_strings(np.asarray(res.table.columns["t"])[valid],
+                            np.asarray(res.table.columns["t#len"])[valid]),
+        "u": decode_strings(np.asarray(res.table.columns["u"])[valid],
+                            np.asarray(res.table.columns["u#len"])[valid]),
+        "pp": np.asarray(res.table.columns["pp"])[valid],
+    })
+    want = pd.DataFrame({"key": bkeys, "s": bs, "t": bt}).merge(
+        pd.DataFrame({"key": pkeys, "u": pu, "pp": pkeys * 7}), on="key"
+    )
+    assert len(got) == len(want) == int(res.total) > 0
+    order = ["key", "s", "t", "u", "pp"]
+    got_s = got.sort_values(order).reset_index(drop=True)
+    want_s = want.sort_values(order).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got_s[order], want_s[order])
+    # byte-exactness of the fixed-width representation: zeros past len
+    for nm in ("s", "t", "u"):
+        byt = np.asarray(res.table.columns[nm])[valid]
+        ln = np.asarray(res.table.columns[nm + "#len"])[valid]
+        for i in range(len(ln)):
+            assert not byt[i, int(ln[i]):].any()
+
+
 def test_varwidth_distributed_join_strings_vs_oracle():
     """End-to-end: variable-length string payloads ride the ragged
     distributed join byte-exactly and decode to the oracle's strings."""
