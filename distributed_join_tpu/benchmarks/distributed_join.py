@@ -198,12 +198,15 @@ def run(args) -> dict:
     if b_rows % n or p_rows % n:
         raise SystemExit(f"table nrows must be divisible by n_ranks={n}")
 
-    if args.string_payload_bytes % 4:
+    if args.shuffle == "ragged" and args.string_payload_bytes % 4:
         # The byte-exact ragged wire ships u32 planes: a width not
         # divisible by 4 would silently fall back to fixed-width
         # shipping with string_wire_bytes = null — fail loudly instead.
+        # (Padded/ppermute modes ship fixed-width regardless; any
+        # width is fine there.)
         raise SystemExit("--string-payload-bytes must be a multiple "
-                         "of 4 (u32-plane byte-exact wire)")
+                         "of 4 in ragged mode (u32-plane byte-exact "
+                         "wire)")
     join_key = "key"
     if args.key_columns > 1 or args.string_payload_bytes > 0:
         if args.zipf_alpha is not None:

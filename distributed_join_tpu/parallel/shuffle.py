@@ -238,9 +238,9 @@ def shuffle_ragged(
       (round 5; VERDICT r4 weak #5 lifted the one-column limit).
       Under a clamped (overflowing) transfer the dropped rows differ
       between the row exchange (bucket tail) and a resorted column
-      (shortest rows), so per-row alignment of the extra columns is
-      only guaranteed when ``overflow`` is False — the caller retries
-      in that case anyway.
+      (shortest rows), so per-row alignment of the extra columns
+      cannot hold — they are delivered ALL-ZERO whenever ``overflow``
+      fires (never silently misaligned; the flag demands a retry).
     """
     n = comm.n_ranks
     vw = ((varwidth,) if isinstance(varwidth, str)
@@ -280,9 +280,18 @@ def shuffle_ragged(
             comm, col_s, lens_s, offsets, counts, start,
             allowed, out_capacity,
         )
-        out_cols[name] = _receiver_unsort(
+        unsorted = _receiver_unsort(
             comm, raw, out_cols[name + "#len"], start, total_recv
         )
+        # Under a clamped transfer the row exchange drops each
+        # bucket's partition-order tail while this length-sorted
+        # column drops its SHORTEST rows — different row sets, so the
+        # unsort would attach surviving rows to other rows' bytes.
+        # Deliver the column EMPTY on overflow instead (all-zero
+        # bytes): the flag already demands a retry, and a caller
+        # peeking at partial results must never read silently
+        # misaligned strings (review r5).
+        out_cols[name] = jnp.where(overflow, 0, unsorted)
     valid = jnp.arange(out_capacity, dtype=jnp.int32) < total_recv
     return Table(out_cols, valid), overflow
 

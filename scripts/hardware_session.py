@@ -99,8 +99,11 @@ def main():
                 "--hh-probe-capacity", str(rows),
                 "--hh-out-capacity", str(rows)],
         RESULTS / f"{tag}_config3_skew.json")
+    # --skew-threshold 0 forces the naive path (round 5's auto-policy
+    # would otherwise default the skew machinery ON for --zipf-alpha).
     records["config3_naive"] = sh(
-        zipf + ["--shuffle-capacity-factor", "8.0"],
+        zipf + ["--skew-threshold", "0",
+                "--shuffle-capacity-factor", "8.0"],
         RESULTS / f"{tag}_config3_naive.json")
 
     # 5. config 4: TPC-H out-of-core (SF-100 real; SF-1 smoke).
@@ -112,6 +115,49 @@ def main():
     if smoke:
         tp += ["--platform", "cpu"]
     records["config4_tpch"] = sh(tp, RESULTS / f"{tag}_config4_tpch.json")
+
+    # 6. The BENCH protocol (bench.py's dual-capacity one-line JSON) so
+    # a hardware session also produces the driver-comparable headline
+    # number (VERDICT r4 weak #7) instead of leaving it to a separate
+    # manual step. bench.py sizes its mesh from jax.devices().
+    import os
+
+    env = dict(os.environ)
+    if smoke:
+        env.update(
+            PALLAS_AXON_POOL_IPS="",   # skip the TPU relay dial
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=(env.get("XLA_FLAGS", "")
+                       + " --xla_force_host_platform_device_count=8"),
+            DJTPU_BENCH_NROWS="200000",
+            DJTPU_BENCH_SLACK="2.0",
+            DJTPU_BENCH_ITERS="2",
+        )
+    print("== bench.py", flush=True)
+    p = subprocess.run(
+        [sys.executable, str(ROOT / "bench.py")],
+        capture_output=True, text=True, cwd=ROOT, env=env,
+    )
+    json_lines = [ln for ln in p.stdout.splitlines()
+                  if ln.strip().startswith("{")]
+    if not json_lines:
+        raise SystemExit(
+            f"bench.py produced no JSON (rc={p.returncode}):\n"
+            + p.stderr[-2000:]
+        )
+    records["bench"] = json.loads(json_lines[-1])
+    (RESULTS / f"{tag}_bench.json").write_text(
+        json.dumps(records["bench"], indent=2) + "\n"
+    )
+    if records["bench"].get("value") is None:
+        # bench.py degrades outages/errors to a parseable record with
+        # rc 0/1 — but THIS session exists to capture the number, so a
+        # missing value must fail the session like every other stage
+        # (sh() uses check=True).
+        raise SystemExit(
+            "bench.py produced an error record instead of a "
+            f"measurement: {records['bench'].get('error')}"
+        )
 
     # Paste-ready BASELINE.md rows.
     md = [f"# Hardware session ({tag})", "",
@@ -131,6 +177,10 @@ def main():
     md.append(f"| config4 TPC-H SF-{sf} | "
               f"{r.get('rows_per_sec', 0) / 1e6:.2f} M rows/s | "
               f"{tag}_config4_tpch.json |")
+    b = records["bench"]
+    md.append(f"| BENCH protocol (match-sized / contract) | "
+              f"{b.get('value')} / {b.get('value_capacity_contract')} "
+              f"{b.get('unit', '')} | {tag}_bench.json |")
     md.append("")
     md.append("Shuffle-mode decision: compare config2_padded vs _ragged "
               "vs _ppermute elapsed — the fastest mode on real ICI "

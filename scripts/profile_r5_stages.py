@@ -1,0 +1,177 @@
+"""Round-5: fresh stage budget of the fused kernel path at SPEC scale.
+
+VERDICT r4 weak #1: the ~70 M rows/s ceiling argument in ROOFLINE §3-6
+was ablated on the round-3 pipeline at 10M rows; the round-4 fused path
+has a different budget at 50M. This script rebuilds the kernel path's
+stage prefix-programs INCREMENTALLY (the protocol that localized the
+2^24 cliff — fake-stage substitution over-attributes at scale because
+fakes feed degenerate data to data-dependent downstream stages,
+ROOFLINE §7 methodology note):
+
+  S1  merged value-carrying sort exactly as ops/join.py builds it
+      (key + tag keys, one shared build/probe value lane);
+  S2  S1 + run-boundary marks + the fused scan kernel;
+  S3  S2 + both stream compactions (record block + matched-build pack);
+  S4  the full join (sort_merge_inner_join, OUT = 0.75N).
+
+Per-stage in-context cost = successive deltas; the S4-S3 delta is the
+expand kernel + output materialization. Writes
+results/stage_budget_{N}M_r5.json.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_r5_stages.py [N_M]
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_join_tpu.ops import join as J
+from distributed_join_tpu.ops.scan_pallas import join_scans
+from distributed_join_tpu.utils.benchmarking import (
+    consume_all_columns,
+    measure_chained,
+)
+from distributed_join_tpu.utils.generators import (
+    generate_build_probe_tables,
+)
+
+N_M = float(sys.argv[1]) if len(sys.argv) > 1 else 50
+OUT_FRAC = 0.75
+ITERS = 4
+
+
+def _prefix_program(stage: str, out_capacity: int):
+    """stage in {'sort', 'scans', 'compact'} — the kernel path's exact
+    prefix, consuming every live intermediate (ops/join.py:298-420)."""
+    from distributed_join_tpu.ops.compact_pallas import stream_compact
+    from distributed_join_tpu.ops.compact_planes import (
+        plane_stream_compact,
+    )
+    from distributed_join_tpu.ops.kernel_config import resolve
+
+    cfg = resolve(None)
+    # interpret mode rides the kernel config exactly like the join
+    # does (the chip runs compiled); off-TPU the kernels are normally
+    # disabled entirely, so force the interpreter there — this script
+    # profiles the KERNEL path, and its off-TPU runs are syntax checks.
+    use, interp = cfg.expand_enabled()
+    if not use:
+        interp = True
+    compact = (
+        plane_stream_compact if cfg.use_plane_compact(interp)
+        else stream_compact
+    )
+
+    def prog(build, probe):
+        nb, npr = build.capacity, probe.capacity
+        n = nb + npr
+        bvalid, pvalid = build.valid, probe.valid
+        b, p = build.columns["key"], probe.columns["key"]
+        sentinel = J._dtype_sentinel_max(b.dtype)
+        mk = jnp.concatenate([
+            jnp.where(bvalid, b, sentinel),
+            jnp.where(pvalid, p, sentinel),
+        ])
+        tag = jnp.concatenate([
+            jnp.where(bvalid, jnp.int8(0), jnp.int8(2)),
+            jnp.where(pvalid, jnp.int8(1), jnp.int8(2)),
+        ])
+        mv = jnp.concatenate([
+            build.columns["build_payload"], probe.columns["probe_payload"]
+        ])
+        sk, stag, sval = lax.sort((mk, tag, mv), num_keys=2)
+        if stage == "sort":
+            return sk[0] + sk[-1] + sval[0] + stag[0].astype(jnp.int64)
+        iota = jnp.arange(n, dtype=jnp.int32)
+        prev = jnp.concatenate([sk[:1], sk[:-1]])
+        first = (sk != prev) | (iota == 0)
+        sc = join_scans(stag, first, interpret=interp)
+        if stage == "scans":
+            return (
+                sc["cnt"][0].astype(jnp.int64)
+                + sc["start_out"][-1].astype(jnp.int64)
+                + sc["lo_m"][0].astype(jnp.int64)
+                + sc["rec_pos"][-1].astype(jnp.int64)
+                + sc["matched"][0].astype(jnp.int64)
+                + sc["mb_pos"][-1].astype(jnp.int64)
+                + sval[0] + sk[0]
+            )
+        # stage == 'compact': record block + matched-build pack,
+        # exactly the lanes the join compacts (S, key, payload, lo).
+        is_rec = (stag == jnp.int8(1)) & (sc["cnt"] > 0)
+        rec_lanes = [
+            J._to_u64_lane(sc["start_out"]),
+            J._to_u64_lane(sk),
+            J._to_u64_lane(sval),
+            J._to_u64_lane(sc["lo_m"]),
+        ]
+        recs = compact(
+            is_rec, sc["rec_pos"], rec_lanes, out_capacity,
+            interpret=interp,
+        )
+        matched = sc["matched"] != 0
+        pack = compact(
+            matched, sc["mb_pos"], [J._to_u64_lane(sval)], nb,
+            interpret=interp,
+        )
+        acc = jnp.uint64(0)
+        for r in recs:
+            acc = acc + r[0] + r[-1]
+        acc = acc + pack[0][0] + pack[0][-1]
+        return acc.astype(jnp.int64)
+
+    return prog
+
+
+def main() -> None:
+    n = int(N_M * 1_000_000)
+    out_rows = int(n * OUT_FRAC)
+    build, probe = generate_build_probe_tables(
+        seed=42, build_nrows=n, probe_nrows=n, selectivity=0.3
+    )
+    jax.block_until_ready((build.columns, probe.columns))
+
+    def variant(label, prog):
+        def body(i, b, p):
+            bt = type(b)(
+                {nm: (c + i.astype(c.dtype) - i.astype(c.dtype)
+                      if nm == "key" else c)
+                 for nm, c in b.columns.items()}, b.valid)
+            return prog(bt, p)
+        return measure_chained(label, body, build, probe, iters=ITERS)
+
+    out = {"n_rows_per_side": n, "out_rows": out_rows, "iters": ITERS}
+    out["s1_sort"] = variant("S1 sort", _prefix_program("sort", out_rows))
+    out["s2_scans"] = variant(
+        "S2 +scans", _prefix_program("scans", out_rows))
+    out["s3_compact"] = variant(
+        "S3 +compact", _prefix_program("compact", out_rows))
+
+    def full(bt, pt):
+        res = J.sort_merge_inner_join(bt, pt, "key", out_rows)
+        return (consume_all_columns(res.table) + res.total).astype(
+            jnp.int64)
+
+    out["s4_full"] = variant("S4 full join", full)
+    out["deltas_s"] = {
+        "sort": out["s1_sort"],
+        "scans": out["s2_scans"] - out["s1_sort"],
+        "compact": out["s3_compact"] - out["s2_scans"],
+        "expand_and_outputs": out["s4_full"] - out["s3_compact"],
+    }
+    out["m_rows_per_s_full"] = 2 * n / out["s4_full"] / 1e6
+    print(json.dumps(out["deltas_s"], indent=2))
+    p = pathlib.Path(__file__).resolve().parent.parent / "results" / \
+        f"stage_budget_{N_M}M_r5.json"
+    p.write_text(json.dumps(out, indent=2))
+    print("wrote", p)
+
+
+if __name__ == "__main__":
+    main()
