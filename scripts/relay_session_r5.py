@@ -1,0 +1,144 @@
+"""Round-5 chip session: every measurement this round owes the record,
+in judge-priority order, resumable.
+
+The axon TPU relay was down for most of round 5; this script exists so
+that WHENEVER the relay returns, one command captures everything:
+
+  1. bench.py               -> results/bench_r5_chip.json
+     (VERDICT r4 missing #1: BENCH_r04 was rc=1 — the official record)
+  2. config 4 SF-100 rerun  -> results/config4_tpch_sf100_chip_r5.json
+     (missing #2: the 2.52 M rows/s artifact predates every r4 fix)
+     + a --fetch-results variant (next #3: overlapped D2H consumer)
+  3. k-sweep 50M            -> results/kdecomp_sweep_50M_r5.json
+     (next #2a: over-decomposition vs merged-sort superlinearity)
+  4. stage budget 50M       -> results/stage_budget_50M_r5.json
+     (next #2b: fresh ablation at spec scale)
+  5. config 3 spec-scale with the round-5 skew auto-policy
+                            -> results/config3_auto_policy_chip_r5.json
+  6. config 2 rerun         -> results/config2_100Mrows_chip_r5.json
+
+Each step is skipped when its artifact already exists (delete to
+re-measure); a step failure logs and CONTINUES so one flaky stage
+cannot cost the whole session if the relay drops mid-way — priority
+order means the most important artifacts land first.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/relay_session_r5.py
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+RESULTS = ROOT / "results"
+
+
+def step(name, artifact, argv, timeout_s=7200):
+    out = RESULTS / artifact
+    if out.exists():
+        print(f"== {name}: {artifact} exists, skipping", flush=True)
+        return True
+    print(f"== {name}: {' '.join(argv)}", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(argv, cwd=ROOT, timeout=timeout_s,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        print(f"!! {name} timed out after {timeout_s}s", flush=True)
+        return False
+    print(p.stdout[-3000:], flush=True)
+    if p.returncode != 0:
+        print(f"!! {name} rc={p.returncode}\n{p.stderr[-3000:]}",
+              flush=True)
+        return False
+    print(f"== {name} done in {time.time() - t0:.0f}s", flush=True)
+    return True
+
+
+def main() -> None:
+    py = sys.executable
+    ok = {}
+
+    # 1. The official BENCH record. bench.py prints one JSON line;
+    # keep a copy the round can cite even before the driver's own
+    # end-of-round capture.
+    bench_art = RESULTS / "bench_r5_chip.json"
+    if bench_art.exists():
+        print("== bench: exists, skipping", flush=True)
+        ok["bench"] = True
+    else:
+        p = subprocess.run([py, str(ROOT / "bench.py")], cwd=ROOT,
+                           capture_output=True, text=True, timeout=7200)
+        lines = [ln for ln in p.stdout.splitlines()
+                 if ln.strip().startswith("{")]
+        print(p.stdout[-2000:], flush=True)
+        ok["bench"] = bool(lines) and p.returncode == 0
+        if lines:
+            rec = json.loads(lines[-1])
+            bench_art.write_text(json.dumps(rec, indent=2) + "\n")
+            ok["bench"] = ok["bench"] and rec.get("value") is not None
+
+    # 2. Config 4: SF-100 out-of-core rerun with the r4 kernels + the
+    # r5 overlapped fetch. Both variants: device-artifact (comparable
+    # with the stale r3 number) and --fetch-results (consumer
+    # semantics with the new phase split).
+    tp = [py, "-m", "distributed_join_tpu.benchmarks.tpch_join",
+          "--scale-factor", "100", "--host-generator",
+          "--batches", "24"]
+    ok["config4"] = step(
+        "config4 SF-100", "config4_tpch_sf100_chip_r5.json",
+        tp + ["--json-output",
+              "results/config4_tpch_sf100_chip_r5.json"],
+        timeout_s=10800)
+    ok["config4_fetch"] = step(
+        "config4 SF-100 +fetch", "config4_tpch_sf100_chip_fetch_r5.json",
+        tp + ["--fetch-results", "--json-output",
+              "results/config4_tpch_sf100_chip_fetch_r5.json"],
+        timeout_s=10800)
+
+    # 3. Over-decomposition k-sweep at 50M+50M (writes its own artifact).
+    ok["kdecomp"] = step(
+        "k-sweep 50M", "kdecomp_sweep_50M_r5.json",
+        [py, str(ROOT / "scripts" / "profile_r5_kdecomp.py"), "50"],
+        timeout_s=10800)
+
+    # 4. Fresh stage budget at 50M (writes its own artifact).
+    ok["stages"] = step(
+        "stage budget 50M", "stage_budget_50M_r5.json",
+        [py, str(ROOT / "scripts" / "profile_r5_stages.py"), "50"],
+        timeout_s=10800)
+
+    # 5. Config 3 at spec scale under the r5 auto-policy: --zipf-alpha
+    # alone, single chip (the 8-rank axis is hardware-blocked).
+    ok["config3"] = step(
+        "config3 auto-policy", "config3_auto_policy_chip_r5.json",
+        [py, "-m", "distributed_join_tpu.benchmarks.distributed_join",
+         "--communicator", "local",
+         "--build-table-nrows", "50000000",
+         "--probe-table-nrows", "50000000",
+         "--zipf-alpha", "1.5", "--iterations", "4",
+         "--json-output", "results/config3_auto_policy_chip_r5.json"],
+        timeout_s=10800)
+
+    # 6. Config 2 rerun (post-r5 tree; r4's number predates the shared
+    # tiling driver and non-build tiling).
+    ok["config2"] = step(
+        "config2 100M", "config2_100Mrows_chip_r5.json",
+        [py, "-m", "distributed_join_tpu.benchmarks.distributed_join",
+         "--communicator", "local",
+         "--build-table-nrows", "50000000",
+         "--probe-table-nrows", "50000000", "--iterations", "4",
+         "--json-output", "results/config2_100Mrows_chip_r5.json"],
+        timeout_s=10800)
+
+    print(json.dumps(ok, indent=2), flush=True)
+    if not all(ok.values()):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
