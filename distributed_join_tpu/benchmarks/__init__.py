@@ -29,6 +29,63 @@ def report(headline: str, record: dict, json_output: str | None) -> None:
             json.dump(record, f, indent=2)
 
 
+def run_guarded(run, args, benchmark: str) -> int:
+    """Drive a benchmark's ``run(args)`` under the failure-semantics
+    contract every driver shares (docs/FAILURE_SEMANTICS.md): any
+    failure still leaves a machine-readable one-line JSON record on
+    stdout (and in ``--json-output`` when given) instead of a bare
+    traceback. A :class:`..parallel.bootstrap.BootstrapError` — an
+    environment outage, not a benchmark result — exits 0 with its full
+    per-attempt record embedded, mirroring bench.py; every other
+    failure keeps a nonzero rc so rc-checking automation still sees a
+    regressed benchmark.
+    """
+    import json
+    import os
+    import sys
+    import traceback
+
+    from distributed_join_tpu.parallel.bootstrap import BootstrapError
+
+    try:
+        run(args)
+        return 0
+    # SystemExit (argparse/flag validation) propagates untouched: it is
+    # not an Exception, and it is not a runtime failure record.
+    except Exception as exc:
+        is_bootstrap = isinstance(exc, BootstrapError)
+        record = {
+            "benchmark": benchmark,
+            "error": f"{type(exc).__name__}: {exc}",
+            "failure": (exc.record() if is_bootstrap else {
+                "error": type(exc).__name__,
+                "message": str(exc),
+                "traceback":
+                    traceback.format_exc().splitlines()[-3:],
+            }),
+        }
+        line = json.dumps(record)
+        print(line, flush=True)
+        json_output = getattr(args, "json_output", None)
+        if json_output:
+            try:
+                with open(json_output, "w") as f:
+                    json.dump(record, f, indent=2)
+            except OSError as io_exc:
+                print(f"note: could not write {json_output}: {io_exc}",
+                      file=sys.stderr)
+        if is_bootstrap:
+            # Hard exit, as in bench.py: a hung handshake leaves a
+            # watchdog worker thread stuck inside jax.distributed
+            # .initialize, and concurrent.futures' atexit hook would
+            # join it forever on a normal return — the record above is
+            # already flushed, so leave now.
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(0)
+        raise
+
+
 def add_platform_arg(parser) -> None:
     """The shared ``--platform`` flag (one definition for all drivers)."""
     parser.add_argument(

@@ -108,7 +108,9 @@ def run(args) -> dict:
 
 
 def main(argv=None):
-    run(parse_args(argv))
+    from distributed_join_tpu.benchmarks import run_guarded
+
+    return run_guarded(run, parse_args(argv), benchmark="all_to_all")
 
 
 if __name__ == "__main__":

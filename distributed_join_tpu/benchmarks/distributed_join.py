@@ -99,6 +99,14 @@ def parse_args(argv=None):
     p.add_argument("--kernel-block", type=int, default=None,
                    help="Pallas EXPAND kernel block size override")
     p.add_argument("--out-capacity-factor", type=float, default=1.2)
+    p.add_argument("--auto-retry", type=int, default=0,
+                   help="on overflow, escalate capacities (the "
+                        "faults.CapacityLadder policy: compression "
+                        "bits widen first, then capacities double "
+                        "with the skew blocks jumping to full local "
+                        "probe coverage) and re-time, up to this many "
+                        "recompiles; the escalation trail lands in "
+                        "the JSON record under 'retry'")
     p.add_argument("--zipf-alpha", type=float, default=None,
                    help="draw probe keys Zipf(alpha) instead of the "
                         "generator's hit/miss mix (BASELINE config 3)")
@@ -281,9 +289,16 @@ def run(args) -> dict:
             # 1.3x slack over the expected HH mass; never beyond the
             # rank's own rows (HH probe rows stay local).
             hh_probe_cap = min(p_local, int(1.3 * f_top * p_local) + 1024)
-        if hh_out_cap is None:
-            # each HH probe row matches ~once against the (unique-key)
-            # build side; 2x covers moderate build duplication.
+        if hh_out_cap is None and not args.duplicate_build_keys:
+            # each HH probe row matches ~once against the unique-key
+            # build side; 2x covers moderate build duplication. Under
+            # --duplicate-build-keys heavy keys repeat on the BUILD
+            # side too and the per-probe-row match count is unbounded
+            # by this model — fall back to the generic capacity
+            # default (p_local/4 in make_join_step) instead of an
+            # undersized policy value that would trigger the very
+            # auto_retry recompile the policy exists to avoid
+            # (ADVICE r5).
             hh_out_cap = min(
                 int(1.3 * p_local), int(2.6 * f_top * p_local) + 1024
             )
@@ -292,29 +307,66 @@ def run(args) -> dict:
             "top_k_mass": round(f_top, 4),
             "hh_probe_capacity": hh_probe_cap,
             "hh_out_capacity": hh_out_cap,
+            # None here means nothing (flag or policy) sized the HH
+            # out block, so the generic default (p_local/4 in
+            # make_join_step) will — an explicit --hh-out-capacity
+            # under --duplicate-build-keys is NOT a fallback.
+            "hh_out_generic_fallback": hh_out_cap is None,
         }
 
-    step = make_join_step(
-        comm,
-        key=join_key,
-        shuffle=args.shuffle,
+    from distributed_join_tpu.parallel.distributed_join import (
+        HH_BUILD_SLOTS_PER_HH,
+    )
+    from distributed_join_tpu.parallel.faults import CapacityLadder
+
+    skew_on = skew_threshold is not None
+    # Resolve the HH defaults here (same resolution as
+    # distributed_inner_join) so --auto-retry escalation can enlarge
+    # them; the resolved values equal make_join_step's own defaults,
+    # so the first program is unchanged.
+    ladder = CapacityLadder(
+        shuffle_capacity_factor=args.shuffle_capacity_factor,
+        out_capacity_factor=args.out_capacity_factor,
         compression_bits=(
             args.compression_bits if args.compression else None
         ),
+        skew=skew_on,
+        hh_build_capacity=(
+            args.hh_slots * HH_BUILD_SLOTS_PER_HH if skew_on else None
+        ),
+        hh_probe_capacity=(
+            (hh_probe_cap or max(p_rows // (8 * n), 1024))
+            if skew_on else None
+        ),
+        hh_out_capacity=(
+            (hh_out_cap or max(p_rows // (4 * n), 1024))
+            if skew_on else None
+        ),
+        local_probe_rows=p_rows // n,
+    )
+    fixed_opts = dict(
+        key=join_key,
+        shuffle=args.shuffle,
         kernel_config=_kernel_config_from_args(args),
         over_decomposition=args.over_decomposition_factor,
-        shuffle_capacity_factor=args.shuffle_capacity_factor,
-        out_capacity_factor=args.out_capacity_factor,
         skew_threshold=skew_threshold,
         hh_slots=args.hh_slots,
-        hh_probe_capacity=hh_probe_cap,
-        hh_out_capacity=hh_out_cap,
     )
     iters = args.iterations
 
-    sec_per_join, matches, overflow = timed_join_throughput(
-        comm, step, build, probe, iters, key=join_key
-    )
+    # The failure-semantics escape hatch (docs/FAILURE_SEMANTICS.md) at
+    # the driver layer: same CapacityLadder policy as
+    # distributed_inner_join, with each rung re-timed so the reported
+    # throughput belongs to the sizing that produced it.
+    for attempt in range(args.auto_retry + 1):
+        step = make_join_step(comm, **fixed_opts, **ladder.sizing())
+        sec_per_join, matches, overflow = timed_join_throughput(
+            comm, step, build, probe, iters, key=join_key
+        )
+        ladder.note(bool(overflow))
+        if not overflow or attempt == args.auto_retry:
+            break
+        ladder.escalate()
 
     rows = b_rows + p_rows
     rows_per_sec = rows / sec_per_join
@@ -346,6 +398,7 @@ def run(args) -> dict:
         "string_wire_bytes": _string_wire_accounting(build, args.shuffle),
         "matches_per_join": matches,
         "overflow": overflow,
+        "retry": ladder.report().as_record(),
         "elapsed_per_join_s": sec_per_join,
         "rows_per_sec": rows_per_sec,
         "m_rows_per_sec_per_rank": rows_per_sec / 1e6 / n,
@@ -404,8 +457,11 @@ def _kernel_config_from_args(args):
 
 
 def main(argv=None):
-    run(parse_args(argv))
+    from distributed_join_tpu.benchmarks import run_guarded
+
+    return run_guarded(run, parse_args(argv),
+                       benchmark="distributed_join")
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

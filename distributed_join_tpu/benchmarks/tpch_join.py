@@ -61,6 +61,19 @@ def parse_args(argv=None):
                         "fetch thread overlapped with the next batch's "
                         "compute; the record gains fetched_bytes plus "
                         "fetch_s (hidden) / fetch_wait_s (unhidden)")
+    p.add_argument("--manifest", default=None,
+                   help="per-batch progress manifest file for the "
+                        "batched paths: a killed run re-invoked with "
+                        "the same flags resumes from the first "
+                        "incomplete batch (bit-exact total; "
+                        "docs/FAILURE_SEMANTICS.md)")
+    p.add_argument("--batch-retries", type=int, default=0,
+                   help="per-batch dispatch retries before a batch "
+                        "counts as failed (batched paths)")
+    p.add_argument("--continue-on-batch-failure", action="store_true",
+                   help="degrade gracefully: record failed batch ids "
+                        "and report partial totals instead of "
+                        "crashing the whole out-of-core run")
     p.add_argument("--over-decomposition-factor", type=int, default=1)
     p.add_argument("--shuffle-capacity-factor", type=float, default=1.6)
     p.add_argument("--out-capacity-factor", type=float, default=1.5)
@@ -90,6 +103,14 @@ def _make_consumer(args):
 
 
 def run(args) -> dict:
+    if ((args.manifest or args.batch_retries
+         or args.continue_on_batch_failure)
+            and args.batches <= 1 and not args.host_generator):
+        raise SystemExit(
+            "--manifest/--batch-retries/--continue-on-batch-failure "
+            "apply to the batched paths; add --batches > 1 or "
+            "--host-generator"
+        )
     if args.fetch_results and args.batches <= 1 and not args.host_generator:
         # The single-shot path times chained in-loop iterations whose
         # outputs never leave the device; silently dropping the flag
@@ -135,6 +156,11 @@ def run(args) -> dict:
             out_capacity_factor=args.out_capacity_factor,
             stats=stats,
             on_batch_result=consumer,
+            manifest_path=args.manifest,
+            batch_retries=args.batch_retries,
+            on_batch_failure=("continue"
+                              if args.continue_on_batch_failure
+                              else "raise"),
         )
         sec = stats["elapsed_s"]
         record_extra = {
@@ -150,6 +176,9 @@ def run(args) -> dict:
             "fetch_wait_s": stats["fetch_wait_s"],
             "fetch_results": args.fetch_results,
             "fetched_bytes": fetched["bytes"] if consumer else None,
+            "manifest": args.manifest,
+            "resumed_batches": stats["resumed_batches"],
+            "failed_batches": stats["failed_batches"],
         }
         return _report(args, comm, orders_rows, lineitem_rows, rows,
                        total, overflow, sec, record_extra)
@@ -180,18 +209,26 @@ def run(args) -> dict:
             out_capacity_factor=args.out_capacity_factor,
             stats=stats,
             on_batch_result=consumer,
+            manifest_path=args.manifest,
+            batch_retries=args.batch_retries,
+            on_batch_failure=("continue"
+                              if args.continue_on_batch_failure
+                              else "raise"),
         )
         sec = stats["elapsed_s"]
         matches = total
+        extra_batched = {
+            "manifest": args.manifest,
+            "resumed_batches": stats["resumed_batches"],
+            "failed_batches": stats["failed_batches"],
+        }
         if consumer is not None:
-            extra_batched = {
+            extra_batched.update({
                 "fetch_results": True,
                 "fetched_bytes": fetched["bytes"],
                 "fetch_s": stats["fetch_s"],
                 "fetch_wait_s": stats["fetch_wait_s"],
-            }
-        else:
-            extra_batched = {}
+            })
     else:
         build = build.pad_to(build.capacity + (-build.capacity) % n)
         probe = probe.pad_to(probe.capacity + (-probe.capacity) % n)
@@ -245,8 +282,12 @@ def _report(args, comm, orders_rows, lineitem_rows, rows,
 
 
 def main(argv=None):
-    run(parse_args(argv))
+    from distributed_join_tpu.benchmarks import run_guarded
+
+    return run_guarded(run, parse_args(argv), benchmark="tpch_join")
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""Version compatibility seams for the jax APIs this framework uses.
+
+The framework is written against the current jax API surface
+(``jax.shard_map``, ``jax.typeof``/vma, ``lax.pvary``); deployment
+environments pin older toolchains where those names live elsewhere or
+do not exist yet. Every call site goes through this module so the
+fallback story is in ONE place:
+
+- :func:`shard_map` — ``jax.shard_map`` when present, else the
+  ``jax.experimental.shard_map`` original (same keyword signature for
+  the subset used here: ``mesh`` / ``in_specs`` / ``out_specs``).
+- :func:`typeof` — ``jax.typeof`` when present, else the abstract
+  aval. Callers only ever probe ``getattr(typeof(x), "vma", None)``;
+  pre-vma toolchains have no ``vma`` attribute on avals, so the probe
+  degrades to None exactly as on a non-shard_map trace.
+- :func:`pvary` — identity on toolchains without ``lax.pvary``
+  (those also do not enforce varying-manual-axes, so identity is
+  correct, not merely tolerated).
+- :func:`enable_x64` — the scoped x64 context manager:
+  ``jax.enable_x64`` when present, else the
+  ``jax.experimental.enable_x64`` original (same semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6
+    _shard_map = jax.shard_map
+    _legacy = False
+except AttributeError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _legacy = True
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs):
+    if _legacy:
+        # The legacy replication checker mis-tracks psum'd loop
+        # carries through lax.scan/fori_loop (the chained-iteration
+        # timing protocol) — jax's own guidance is check_rep=False;
+        # the modern vma path keeps full checking.
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs)
+
+
+def enable_x64(new_val: bool = True):
+    """Scoped x64-mode context manager (the Pallas wrappers trace
+    their kernels under ``enable_x64(False)``)."""
+    cm = getattr(jax, "enable_x64", None)
+    if cm is not None:
+        return cm(new_val)
+    from jax.experimental import enable_x64 as _enable_x64
+
+    return _enable_x64(new_val)
+
+
+def typeof(x):
+    """``jax.typeof`` with a pre-vma fallback; only meant for
+    ``getattr(typeof(x), "vma", None)`` probes."""
+    tf = getattr(jax, "typeof", None)
+    if tf is not None:
+        return tf(x)
+    return jax.core.get_aval(x)
+
+
+def pvary(x, axis_name):
+    """``lax.pvary`` where it exists (idempotent — already-varying
+    inputs pass through); identity on toolchains without vma
+    tracking."""
+    from jax import lax
+
+    pv = getattr(lax, "pvary", None)
+    if pv is None:
+        return x
+    vma = getattr(typeof(x), "vma", None) or frozenset()
+    if axis_name in vma:
+        return x
+    return pv(x, axis_name)

@@ -37,6 +37,8 @@ from __future__ import annotations
 import functools
 
 import jax
+
+from distributed_join_tpu import compat
 import jax.numpy as jnp
 from jax import lax
 
@@ -208,13 +210,13 @@ def plane_compact_stacked(stacked: jax.Array, mask: jax.Array,
     ins3d = full.reshape(P2, nblocks * RB, 128)
 
     out_rows = _round_up(capacity, 1024) // 128 + RS + 8
-    vma = getattr(jax.typeof(ins3d), "vma", None)
+    vma = getattr(compat.typeof(ins3d), "vma", None)
     out_sds = (
         jax.ShapeDtypeStruct((P, out_rows, 128), jnp.uint32, vma=vma)
         if vma is not None else
         jax.ShapeDtypeStruct((P, out_rows, 128), jnp.uint32)
     )
-    with jax.enable_x64(False):
+    with compat.enable_x64(False):
         out = pl.pallas_call(
             functools.partial(
                 _compact_kernel, block=block, nplanes=P

@@ -40,6 +40,8 @@ from __future__ import annotations
 import functools
 
 import jax
+
+from distributed_join_tpu import compat
 import jax.numpy as jnp
 from jax import lax
 
@@ -242,7 +244,7 @@ def expand_pull(S: jax.Array, cols, out_capacity: int,
         args = [rbase, roff, bb, boff, rec3d, b3d]
 
     nout = nrec + nbuild
-    vma = getattr(jax.typeof(rec3d), "vma", None)
+    vma = getattr(compat.typeof(rec3d), "vma", None)
     out_sds = (
         jax.ShapeDtypeStruct((nout, out_pad // 128, 128), jnp.uint32,
                              vma=vma)
@@ -253,7 +255,7 @@ def expand_pull(S: jax.Array, cols, out_capacity: int,
     if build_cols is not None:
         scratch.append(pltpu.VMEM((nbuild, RW, 128), jnp.uint32))
     scratch.append(pltpu.SemaphoreType.DMA((2,)))
-    with jax.enable_x64(False):
+    with compat.enable_x64(False):
         out = pl.pallas_call(
             functools.partial(
                 _expand_kernel, block=block, nrec=nrec, nbuild=nbuild

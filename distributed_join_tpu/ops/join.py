@@ -71,6 +71,8 @@ import warnings
 from typing import Optional, Sequence
 
 import jax
+
+from distributed_join_tpu import compat
 import jax.numpy as jnp
 from jax import lax
 
@@ -113,6 +115,11 @@ class JoinResult:
     table: Table          # static capacity; .valid marks real result rows
     total: jax.Array      # true number of matches (may exceed capacity)
     overflow: jax.Array   # bool: total > capacity, rows were truncated
+    # Results returned by parallel.distributed_join.distributed_inner_join
+    # additionally carry a host-side `retry_report` attribute
+    # (parallel/faults.RetryReport: the auto_retry escalation trail).
+    # It is NOT a pytree field — JoinResult traces through shard_map,
+    # and the report only exists outside the compiled program.
 
 
 def _to_u64_lane(c: jax.Array):
@@ -165,7 +172,7 @@ def _expand_records(S, recs: dict, out_capacity: int, j, cfg):
     """
     use_pallas, interpret = cfg.expand_enabled()
     if use_pallas and interpret and getattr(
-        jax.typeof(S), "vma", None
+        compat.typeof(S), "vma", None
     ):
         # The Mosaic lowering works under shard_map on real TPU
         # (compile-checked: tpu_custom_call in the mesh module); only
@@ -243,7 +250,7 @@ def _kernel_path_ok(build, probe, keys, b1d, p1d, nb, npr,
     if not use:
         return False, False
     if interpret and getattr(
-        jax.typeof(build.columns[keys[0]]), "vma", None
+        compat.typeof(build.columns[keys[0]]), "vma", None
     ):
         # shard_map's interpreter trips on pallas_call vma checks; the
         # CPU test mesh runs the XLA pipeline instead (real-TPU

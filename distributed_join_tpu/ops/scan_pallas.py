@@ -50,6 +50,8 @@ or their prefix counts, so int32 is exact up to 2^31 rows per shard.
 from __future__ import annotations
 
 import jax
+
+from distributed_join_tpu import compat
 import jax.numpy as jnp
 
 from distributed_join_tpu.ops.expand_pallas import _round_up
@@ -243,7 +245,7 @@ def join_scans(tag: jax.Array, first: jax.Array,
 
     spec = pl.BlockSpec((8, L), lambda i: (i, 0))
     rspec = pl.BlockSpec((8, L), lambda i: (nblocks - 1 - i, 0))
-    vma = getattr(jax.typeof(tag2), "vma", None)
+    vma = getattr(compat.typeof(tag2), "vma", None)
 
     def _shape():
         if vma is not None:
@@ -252,7 +254,7 @@ def join_scans(tag: jax.Array, first: jax.Array,
             )
         return jax.ShapeDtypeStruct((n_pad // L, L), jnp.int32)
 
-    with jax.enable_x64(False):
+    with compat.enable_x64(False):
         matched2 = pl.pallas_call(
             _scan_r_kernel,
             grid=(nblocks,),

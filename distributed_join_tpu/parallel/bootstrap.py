@@ -33,12 +33,111 @@ backend — verified working in this environment (2 procs x 4 devices).
 from __future__ import annotations
 
 import os
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 ENV_COORDINATOR = "DJTPU_COORDINATOR"
 ENV_NUM_PROCESSES = "DJTPU_NUM_PROCESSES"
 ENV_PROCESS_ID = "DJTPU_PROCESS_ID"
 ENV_CPU_DEVICES = "DJTPU_CPU_DEVICES_PER_PROCESS"
+# Failure-semantics knobs (docs/FAILURE_SEMANTICS.md): overall
+# handshake deadline, attempt count, and first-retry backoff.
+ENV_BOOTSTRAP_DEADLINE = "DJTPU_BOOTSTRAP_DEADLINE"
+ENV_BOOTSTRAP_RETRIES = "DJTPU_BOOTSTRAP_RETRIES"
+ENV_BOOTSTRAP_BACKOFF = "DJTPU_BOOTSTRAP_BACKOFF"
+
+DEFAULT_DEADLINE_S = 300.0
+DEFAULT_RETRIES = 3
+DEFAULT_BACKOFF_S = 2.0
+
+
+class BootstrapError(RuntimeError):
+    """The distributed handshake (or backend init) failed or hung —
+    an environment outage, not a join/benchmark result. Carries the
+    full per-attempt trail so every driver can emit a machine-readable
+    failure record instead of a bare traceback (generalizes bench.py's
+    round-5 ad-hoc ``_BackendInitError``)."""
+
+    def __init__(self, message: str, *, phase: str = "bootstrap",
+                 attempts=None, deadline_s: Optional[float] = None,
+                 coordinator: Optional[str] = None):
+        super().__init__(message)
+        self.phase = phase
+        self.attempts = attempts or []
+        self.deadline_s = deadline_s
+        self.coordinator = coordinator
+
+    def record(self) -> dict:
+        """The JSON-shaped failure record drivers embed in their
+        output (docs/FAILURE_SEMANTICS.md "Bootstrap failures")."""
+        return {
+            "error": "BootstrapError",
+            "phase": self.phase,
+            "message": str(self),
+            "coordinator": self.coordinator,
+            "deadline_s": self.deadline_s,
+            "attempts": self.attempts,
+        }
+
+
+def call_with_deadline(fn: Callable, deadline_s: float,
+                       what: str = "backend init"):
+    """Run ``fn()`` under a watchdog thread and turn BOTH failure modes
+    of a dead environment — an exception (round 4's "UNAVAILABLE") and
+    a hang inside PJRT client init (observed round 5) — into a
+    structured :class:`BootstrapError`. The caller decides whether a
+    timed-out worker thread forces a hard exit (a hung init thread
+    blocks normal interpreter shutdown; see bench.py)."""
+    import concurrent.futures
+
+    ex = concurrent.futures.ThreadPoolExecutor(1)
+    fut = ex.submit(fn)
+    try:
+        return fut.result(timeout=deadline_s)
+    except concurrent.futures.TimeoutError:
+        raise BootstrapError(
+            f"{what} did not complete within {deadline_s:g}s "
+            "(TPU relay down?)",
+            phase=what, deadline_s=deadline_s,
+            attempts=[{"attempt": 0, "elapsed_s": deadline_s,
+                       "error": f"timeout after {deadline_s:g}s"}],
+        ) from None
+    except Exception as exc:
+        raise BootstrapError(
+            f"{what} failed: {type(exc).__name__}: {exc}",
+            phase=what, deadline_s=deadline_s,
+            attempts=[{"attempt": 0, "elapsed_s": None,
+                       "error": f"{type(exc).__name__}: {exc}"}],
+        ) from exc
+
+
+def _connect(coordinator_address: str, num_processes: int,
+             process_id: int) -> None:
+    """The raw handshake — one ``jax.distributed.initialize`` attempt.
+    Split out so :func:`initialize`'s retry loop (and tests) can
+    substitute it."""
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except Exception:
+        # jax sets its global client/service state BEFORE the TCP
+        # connect; left in place, every retry would die instantly with
+        # "distributed.initialize should only be called once" instead
+        # of re-attempting the handshake. Best-effort teardown (the
+        # half-initialized client may itself fail to shut down). Only
+        # reachable on toolchains where a failed handshake raises —
+        # this environment's XLA LOG(FATAL)s on a connect timeout,
+        # which no in-process retry can survive.
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        raise
 
 
 def initialize(
@@ -46,14 +145,45 @@ def initialize(
     num_processes: int,
     process_id: int,
     cpu_devices_per_process: Optional[int] = None,
+    *,
+    deadline_s: Optional[float] = None,
+    max_retries: Optional[int] = None,
+    backoff_s: Optional[float] = None,
+    connect: Optional[Callable] = None,
+    sleep: Callable[[float], None] = time.sleep,
 ) -> None:
     """Join the distributed runtime. Call BEFORE any other jax use —
     like ``MPI_Init``, this must precede every collective/device call.
 
     ``cpu_devices_per_process`` switches to the virtual-CPU data plane
     (gloo): multi-host semantics without TPU hardware.
+
+    Failure semantics: the TCP/DCN handshake replaces the reference's
+    ``MPI_Bcast`` of the NCCL id and fails in its ways — a coordinator
+    that is not up yet (workers race it at launch), a transient
+    connect refusal, or a hung endpoint. Attempts retry with
+    exponential backoff (``max_retries`` attempts, first retry after
+    ``backoff_s``, doubling) under an overall ``deadline_s``; the env
+    knobs ``DJTPU_BOOTSTRAP_DEADLINE`` / ``DJTPU_BOOTSTRAP_RETRIES`` /
+    ``DJTPU_BOOTSTRAP_BACKOFF`` configure launched processes.
+    Exhaustion raises :class:`BootstrapError` carrying the full
+    per-attempt trail, which drivers embed as a machine-readable
+    failure record in their JSON output. ``connect``/``sleep`` are
+    injectable for tests.
     """
     import jax
+
+    from distributed_join_tpu.parallel.faults import retry_with_backoff
+
+    deadline_s = (float(os.environ.get(ENV_BOOTSTRAP_DEADLINE,
+                                       DEFAULT_DEADLINE_S))
+                  if deadline_s is None else deadline_s)
+    max_retries = (int(os.environ.get(ENV_BOOTSTRAP_RETRIES,
+                                      DEFAULT_RETRIES))
+                   if max_retries is None else max_retries)
+    backoff_s = (float(os.environ.get(ENV_BOOTSTRAP_BACKOFF,
+                                      DEFAULT_BACKOFF_S))
+                 if backoff_s is None else backoff_s)
 
     # Record the identity for process_id()/is_coordinator() even when
     # this is called directly (one invocation per host) rather than via
@@ -78,11 +208,45 @@ def initialize(
         jax.config.update("jax_platforms", "cpu")
         # Cross-process CPU collectives need an explicit transport.
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+
+    do_connect = connect if connect is not None else _connect
+    t0 = time.monotonic()
+
+    def _bounded_connect():
+        # retry_with_backoff's deadline check only runs BETWEEN
+        # attempts; a hung endpoint (TCP accepted, handshake never
+        # completing) must be bounded too, so each attempt runs under
+        # call_with_deadline's watchdog holding the REMAINING
+        # deadline (its timed-out worker thread stays hung — the
+        # caller decides whether to force a hard exit). INVARIANT: a
+        # timed-out attempt burned that whole remainder, so the retry
+        # loop's deadline check stops before launching another attempt
+        # — a hung handshake is never retried (a retry would race the
+        # still-blocked worker thread on jax's global state).
+        remaining = max(0.0, deadline_s - (time.monotonic() - t0))
+        return call_with_deadline(
+            lambda: do_connect(coordinator_address, num_processes,
+                               process_id),
+            remaining, what="handshake",
+        )
+
+    try:
+        _, attempts = retry_with_backoff(
+            _bounded_connect,
+            max_attempts=max(1, max_retries),
+            backoff_s=backoff_s,
+            deadline_s=deadline_s,
+            sleep=sleep,
+        )
+    except BootstrapError as exc:
+        # Every connect outcome — hang or error — reaches here wrapped
+        # by call_with_deadline; fill in the handshake identity, the
+        # CONFIGURED deadline (not the last attempt's remainder), and
+        # the retry loop's full per-attempt trail.
+        exc.coordinator = exc.coordinator or coordinator_address
+        exc.deadline_s = deadline_s
+        exc.attempts = getattr(exc, "_retry_attempts", None) or exc.attempts
+        raise
 
 
 def maybe_initialize_from_env() -> bool:

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_join_tpu import compat
 from distributed_join_tpu.parallel.mesh import RANK_AXIS, make_mesh
 
 
@@ -209,11 +210,7 @@ class TpuCommunicator(Communicator):
         return lax.axis_index(self.axis_name)
 
     def pvary(self, x):
-        # Idempotent: lax.pvary rejects already-varying inputs.
-        vma = getattr(jax.typeof(x), "vma", None) or frozenset()
-        if self.axis_name in vma:
-            return x
-        return lax.pvary(x, self.axis_name)
+        return compat.pvary(x, self.axis_name)
 
     def ragged_all_to_all(self, operand, output, input_offsets,
                           send_sizes, output_offsets, recv_sizes):
@@ -270,7 +267,7 @@ class TpuCommunicator(Communicator):
                 lambda rep: P() if rep else shard_spec,
                 sharded_out,
             )
-        mapped = jax.shard_map(
+        mapped = compat.shard_map(
             fn, mesh=self.mesh, in_specs=shard_spec, out_specs=out_specs
         )
         return jax.jit(mapped)

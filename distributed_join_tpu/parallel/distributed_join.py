@@ -387,11 +387,20 @@ def distributed_inner_join(
     function once with :func:`make_distributed_join` instead.
 
     ``auto_retry``: on overflow (a static capacity too small for the
-    data), recompile with doubled shuffle/output capacity factors up to
-    this many times. The reference sizes receive buffers exactly and
-    can't overflow (SURVEY.md §2); static shapes can, so they get an
-    escape hatch instead of a wrong answer.
+    data), recompile with escalated capacities up to this many times —
+    the policy lives in :class:`..faults.CapacityLadder` (compression
+    bits widen first, then every capacity doubles, with the skew
+    capacities jumping to full local probe coverage). The reference
+    sizes receive buffers exactly and can't overflow (SURVEY.md §2);
+    static shapes can, so they get an escape hatch instead of a wrong
+    answer. The returned result carries the full escalation trail as a
+    host-side ``retry_report`` attribute (:class:`..faults.RetryReport`
+    — which capacities doubled, why, per attempt), which the benchmark
+    drivers embed in their JSON records.
     """
+    from distributed_join_tpu.parallel import faults
+    from distributed_join_tpu.parallel.faults import CapacityLadder
+
     n = comm.n_ranks
 
     build = build.pad_to(_round_up(build.capacity, n))
@@ -416,45 +425,43 @@ def distributed_inner_join(
         hh_out_cap = hh_out_cap or max(probe.capacity // (4 * n), 1024)
     out_rows = opts.pop("out_rows_per_rank", None)
     comp_bits = opts.pop("compression_bits", None)
+    # The escalation policy — compression bits widen first (the cheap
+    # axis), then every capacity doubles with the skew capacities
+    # jumping straight to full local probe coverage — lives in
+    # CapacityLadder so drivers escalate identically and the
+    # decisions survive as a RetryReport.
+    ladder = CapacityLadder(
+        shuffle_capacity_factor=shuffle_f,
+        out_capacity_factor=out_f,
+        out_rows_per_rank=out_rows,
+        compression_bits=comp_bits,
+        skew=skew_on,
+        hh_build_capacity=hh_build_cap,
+        hh_probe_capacity=hh_probe_cap,
+        hh_out_capacity=hh_out_cap,
+        local_probe_rows=probe.capacity // n,
+    )
     for attempt in range(auto_retry + 1):
-        fn = make_distributed_join(
-            comm, key=key,
-            shuffle_capacity_factor=shuffle_f,
-            out_capacity_factor=out_f,
-            out_rows_per_rank=out_rows,
-            hh_build_capacity=hh_build_cap,
-            hh_probe_capacity=hh_probe_cap,
-            hh_out_capacity=hh_out_cap,
-            compression_bits=comp_bits,
-            **opts,
-        )
+        fn = make_distributed_join(comm, key=key, **ladder.sizing(),
+                                   **opts)
+        if faults.plan_validation_enabled():
+            # The violation record is process-global; drop leftovers
+            # from earlier unchecked programs so what check() raises
+            # below belongs to THIS attempt.
+            faults.clear_plan_violations()
         res = fn(build, probe)
-        if attempt == auto_retry or not bool(res.overflow):
+        overflow = bool(res.overflow)
+        if faults.plan_validation_enabled():
+            # The flag fetch above sequenced the validation callbacks;
+            # surface a recorded inconsistency as the loud error it is
+            # rather than retrying a corrupted-metadata exchange.
+            faults.check_plan_violations()
+        ladder.note(overflow)
+        if attempt == auto_retry or not overflow:
+            # retry_report is host-side metadata, not a pytree field:
+            # JoinResult traces through shard_map, and the report only
+            # exists outside the compiled program.
+            object.__setattr__(res, "retry_report", ladder.report())
             return res
-        if comp_bits is not None and comp_bits < 32:
-            # The flag can't distinguish a codec-width overflow from a
-            # capacity overflow, so the ladder widens the CHEAP axis
-            # first: bits-only recompiles (at most 3: 4->8->16->32)
-            # before any buffer grows — otherwise a pure bits overflow
-            # would inflate every shuffle/out/HH buffer up to 8x for
-            # nothing (review r4). Size auto_retry accordingly when
-            # compressing.
-            comp_bits = min(comp_bits * 2, 32)
-            continue
-        # Double every capacity a retry can relieve — out_rows_per_rank
-        # supersedes out_capacity_factor when set, so it must scale too.
-        shuffle_f *= 2.0
-        out_f *= 2.0
-        if out_rows is not None:
-            out_rows *= 2
-        if skew_on:
-            # The HH defaults are sized for the common mild-skew case
-            # (probe/8); one retry must still cover ANY skew — Zipf
-            # alpha>=1.4 puts ~90% of probe rows in the HH set — so
-            # the skew capacities jump straight to full local probe
-            # coverage rather than creeping by doublings.
-            p_local = probe.capacity // n
-            hh_build_cap *= 2
-            hh_probe_cap = max(hh_probe_cap * 2, p_local)
-            hh_out_cap = max(hh_out_cap * 2, p_local)
+        ladder.escalate()
     raise AssertionError("unreachable")

@@ -143,11 +143,38 @@ def batched_join_host(
     warmup: bool = True,
     stats: Optional[dict] = None,
     on_batch_result: Optional[Callable] = None,
+    manifest_path: Optional[str] = None,
+    batch_retries: int = 0,
+    batch_retry_backoff_s: float = 1.0,
+    on_batch_failure: str = "raise",
     **join_opts,
 ) -> Tuple[int, bool]:
     """Join pre-binned HOST batches (lists of numpy column dicts, e.g.
     from :func:`..utils.tpch_host.generate_tpch_host_batches`) with
     one-batch-ahead H2D staging; returns (total_matches, any_overflow).
+
+    Failure semantics (docs/FAILURE_SEMANTICS.md):
+
+    - ``manifest_path``: a per-batch progress manifest
+      (:class:`..faults.JoinManifest`) written atomically after each
+      batch's exact total is known. A killed run re-invoked with the
+      same arguments resumes from the first incomplete batch — batches
+      are key-disjoint, so the resumed sum is bit-exact with the
+      uninterrupted run. A manifest written by a DIFFERENT batching
+      (row counts, capacities, key, rank count) is refused.
+    - ``batch_retries``: per-batch dispatch retries before a batch is
+      declared failed (transient launch/collective failures), with
+      exponential backoff starting at ``batch_retry_backoff_s`` —
+      back-to-back re-dispatches would burn the whole budget inside a
+      still-live transient outage.
+    - ``on_batch_failure``: "raise" (default) propagates a batch's
+      final failure; "continue" degrades gracefully — the batch id is
+      recorded in ``stats['failed_batches']`` (and the manifest's
+      failure log) and the join returns PARTIAL totals over the
+      batches that did complete, instead of crashing an hours-long
+      out-of-core run on one bad batch.
+    - ``stats`` additionally receives ``resumed_batches`` (ids skipped
+      via the manifest) and ``failed_batches``.
 
     This is the out-of-core hot path (VERDICT r1 weak #5: the r1 loop
     was fully serial). The pipeline, per loop iteration:
@@ -189,9 +216,18 @@ def batched_join_host(
     from distributed_join_tpu.parallel.distributed_join import (
         make_distributed_join,
     )
+    from distributed_join_tpu.parallel.faults import (
+        JoinManifest,
+        batch_config_fingerprint,
+    )
 
     if len(build_batches) != len(probe_batches):
         raise ValueError("build/probe batch counts differ")
+    if on_batch_failure not in ("raise", "continue"):
+        raise ValueError(
+            f"on_batch_failure must be 'raise' or 'continue', "
+            f"got {on_batch_failure!r}"
+        )
     n_batches = len(build_batches)
     n = comm.n_ranks
 
@@ -200,6 +236,39 @@ def batched_join_host(
         return max(-(-c // n) * n, n)
 
     bcap, pcap = _cap(build_batches), _cap(probe_batches)
+
+    manifest = None
+    completed: dict = {}
+    if manifest_path is not None:
+        manifest = JoinManifest(
+            manifest_path,
+            batch_config_fingerprint(build_batches, probe_batches,
+                                     n, key, bcap, pcap),
+        )
+        # An overflowed batch's recorded TOTAL is exact, but its
+        # materialized rows were truncated — and the natural resume
+        # after an overflowing run is "re-invoke with bigger
+        # capacities against the same manifest" (join sizing options
+        # are deliberately NOT in the fingerprint). So overflowed
+        # entries count as incomplete and re-run; record_batch
+        # overwrites them.
+        completed = {b: v for b, v in manifest.completed.items()
+                     if not v["overflow"]}
+        if completed and on_batch_result is not None:
+            import warnings
+
+            warnings.warn(
+                "resuming from a manifest: on_batch_result will not "
+                f"be called for already-completed batches "
+                f"{sorted(completed)} — the consumer's stream covers "
+                "only batches run in THIS invocation, though the "
+                "returned total covers all of them",
+                stacklevel=2,
+            )
+    # Batches still to run, in order; capacities stay computed over ALL
+    # batches so a resumed run compiles the identical program.
+    pending = [b for b in range(n_batches) if b not in completed]
+    failed: set = set()
 
     # fetch_s: time actually spent pulling results (on the fetch
     # worker when a consumer is installed — HIDDEN behind compute);
@@ -235,67 +304,194 @@ def batched_join_host(
         on_batch_result(b, res)
         phase["fetch_s"] += time.perf_counter() - tf
 
-    nxt = None
-    if warmup:
-        nxt = stage(0)
-        int(fn(*nxt).total)  # compile + run, result discarded; the
-        # staged inputs are reused as the measured loop's first batch
+    # Per-batch remaining FAILED-attempt budget: one pool of
+    # batch_retries + 1, shared between the warmup dispatch and the
+    # measured loop so neither double-charges (or double-logs).
+    # Successes are free — the measured loop re-dispatching a batch
+    # warmup already ran clean is by design, not a retry.
+    tries_left: dict = {}
 
-    # Warmup staged batch 0 before t0: reset the phase counters so
-    # the breakdown covers exactly the [t0, end) window it is reported
-    # against (otherwise pad_s/put_s over-count by one batch).
+    def _dispatch(b, bt, pt):
+        """fn(bt, pt) under batch ``b``'s failure budget, with
+        exponential backoff between attempts (faults.retry_with_backoff
+        — the same transient-failure loop as the bootstrap handshake);
+        returns the JoinResult or None when the batch is abandoned
+        (on_batch_failure='continue', budget exhausted). Failures are
+        appended to the manifest's forensic log."""
+        from distributed_join_tpu.parallel.faults import (
+            retry_with_backoff,
+        )
+
+        last = None
+        res = None
+        tries_left.setdefault(b, batch_retries + 1)
+        budget = tries_left[b]
+        if budget > 0:
+            try:
+                res, attempts = retry_with_backoff(
+                    lambda: fn(bt, pt), max_attempts=budget,
+                    backoff_s=batch_retry_backoff_s,
+                )
+            except Exception as exc:  # noqa: BLE001 - retry seam
+                last = exc
+                attempts = getattr(exc, "_retry_attempts", [])
+            # Only FAILED attempts charge the budget + forensic log.
+            fails = [a for a in attempts if a["error"] is not None]
+            tries_left[b] -= len(fails)
+            if manifest is not None:
+                base = batch_retries + 1 - budget
+                for k, a in enumerate(fails):
+                    manifest.record_failure(b, a["error"], base + k)
+            if res is not None:
+                return res
+        if on_batch_failure == "continue":
+            failed.add(b)
+            return None
+        # last=None (budget pre-exhausted, e.g. by warmup) only
+        # happens under 'continue', which returned above.
+        raise last
+
+    def _settle(i):
+        """Force pending[i]'s total to host (the device sync) and
+        persist its manifest record. A failure HERE (result fetch) is
+        a batch failure too — same degradation contract as dispatch."""
+        if totals[i] is None or isinstance(totals[i], int):
+            return
+        b = pending[i]
+        try:
+            totals[i] = int(totals[i])
+            overflows[i] = bool(overflows[i])
+        except Exception as exc:  # noqa: BLE001 - degradation seam
+            if manifest is not None:
+                manifest.record_failure(
+                    b, f"{type(exc).__name__}: {exc}", batch_retries)
+            if on_batch_failure != "continue":
+                raise
+            totals[i], overflows[i] = None, None
+            failed.add(b)
+            return
+        if manifest is not None:
+            manifest.record_batch(b, totals[i], overflows[i])
+
+    nxt = None
+    if warmup and pending:
+        nxt = stage(pending[0])
+        # Compile + run under the same per-batch retry/degradation
+        # contract as the measured loop (a transient failure here must
+        # not crash a run that opted into batch_retries / 'continue');
+        # result discarded, the staged inputs are reused as the
+        # measured loop's first batch. The attempt budget is SHARED
+        # with the measured loop: a batch warmup exhausts is failed
+        # here and not re-dispatched.
+        res = _dispatch(pending[0], *nxt)
+        if res is not None:
+            try:
+                int(res.total)
+            except Exception as exc:  # noqa: BLE001 - degradation seam
+                # Same contract as _settle: an async device failure
+                # that only surfaces at the scalar fetch is a batch
+                # failure, not a run crash, when the caller opted into
+                # 'continue'. The measured loop re-dispatches the
+                # batch and clears it from `failed` on recovery.
+                if manifest is not None:
+                    manifest.record_failure(
+                        pending[0], f"{type(exc).__name__}: {exc}",
+                        batch_retries)
+                if on_batch_failure != "continue":
+                    raise
+                failed.add(pending[0])
+
+    # Warmup staged the first pending batch before t0: reset the phase
+    # counters so the breakdown covers exactly the [t0, end) window it
+    # is reported against (otherwise pad_s/put_s over-count by one
+    # batch).
     for k_ in phase:
         phase[k_] = 0.0
     t0 = time.perf_counter()
-    fut = (pool.submit(lambda: nxt) if nxt is not None
-           else pool.submit(stage, 0))
+    fut = None
+    if pending:
+        fut = (pool.submit(lambda: nxt) if nxt is not None
+               else pool.submit(stage, pending[0]))
+    # All three lists are positionally aligned with `pending`;
+    # totals[i] is a device scalar until _settle(i) fetches it, None
+    # for a failed/abandoned batch.
     totals, overflows, fetch_futs = [], [], []
     try:
-        for b in range(n_batches):
+        for i, b in enumerate(pending):
             bt, pt = fut.result()
             td = time.perf_counter()
-            res = fn(bt, pt)
+            res = _dispatch(b, bt, pt)
             phase["dispatch_s"] += time.perf_counter() - td
-            totals.append(res.total)
-            overflows.append(res.overflow)
-            if on_batch_result is not None:
-                fetch_futs.append(fetch_pool.submit(_fetch, b, res))
-            if b + 1 < n_batches:
-                # Stage b+1 on the worker thread, overlapping both
-                # batch b's device work and the backpressure wait.
-                fut = pool.submit(stage, b + 1)
-                if b >= 1:
-                    # Backpressure (see docstring): b-1 must be done
-                    # before a third batch's buffers exist.
+            if res is not None:
+                # A batch marked failed at the warmup FETCH (dispatch
+                # succeeded, async failure at the scalar sync) that
+                # this dispatch just recovered must not stay in the
+                # failure record — its total is counted.
+                failed.discard(b)
+            totals.append(res.total if res is not None else None)
+            overflows.append(res.overflow if res is not None else None)
+            fetch_futs.append(
+                fetch_pool.submit(_fetch, b, res)
+                if (on_batch_result is not None and res is not None)
+                else None
+            )
+            if i + 1 < len(pending):
+                # Stage the next batch on the worker thread,
+                # overlapping both this batch's device work and the
+                # backpressure wait.
+                fut = pool.submit(stage, pending[i + 1])
+                if i >= 1:
+                    # Backpressure (see docstring): batch i-1 must be
+                    # done before a third batch's buffers exist.
                     tf = time.perf_counter()
-                    if fetch_futs:
-                        # In-order consumption: b-1's consumer must
-                        # have returned before b+1 dispatches.
-                        fetch_futs[b - 1].result()
+                    if fetch_futs[i - 1] is not None:
+                        # In-order consumption: i-1's consumer must
+                        # have returned before i+1 dispatches.
+                        fetch_futs[i - 1].result()
                     # The DEVICE sync cannot be delegated to the
                     # consumer — one that merely reduces (or keeps
-                    # device references) returns before b-1's join
+                    # device references) returns before i-1's join
                     # finished, which would let the staging worker
                     # race ahead and OOM (review r5). A scalar fetch,
                     # not block_until_ready — the only sync that also
-                    # holds under this environment's RPC relay.
-                    totals[b - 1] = int(totals[b - 1])
+                    # holds under this environment's RPC relay. The
+                    # manifest record rides the same sync point, so
+                    # durability costs no extra synchronization.
+                    _settle(i - 1)
                     phase["fetch_wait_s"] += time.perf_counter() - tf
         tf = time.perf_counter()
         for f in fetch_futs:
-            f.result()  # drain (+ surface consumer exceptions)
-        total = sum(int(t) for t in totals)
-        overflow = any(bool(o) for o in overflows)
+            if f is not None:
+                f.result()  # drain (+ surface consumer exceptions)
+        for i in range(len(pending)):
+            _settle(i)
+        total = sum(t for t in totals if t is not None)
+        overflow = any(bool(o) for o in overflows if o is not None)
         phase["fetch_wait_s"] += time.perf_counter() - tf
     finally:
         # Also on error: an orphaned worker would hang the interpreter
         # at exit via ThreadPoolExecutor's atexit join.
         pool.shutdown(wait=False, cancel_futures=True)
         fetch_pool.shutdown(wait=False, cancel_futures=True)
+    # Fold in the batches a prior (killed) run already completed —
+    # totals only: overflowed entries were filtered back into
+    # `pending` above, so `completed` carries no overflow.
+    total += sum(v["total"] for v in completed.values())
+    if failed and stats is None:
+        import warnings
+
+        warnings.warn(
+            f"on_batch_failure='continue': batches {sorted(failed)} "
+            "were abandoned and the returned total is PARTIAL — pass "
+            "a stats dict to receive failed_batches programmatically",
+            stacklevel=2,
+        )
     if stats is not None:
         stats["elapsed_s"] = time.perf_counter() - t0
         stats["build_capacity"] = bcap
         stats["probe_capacity"] = pcap
+        stats["resumed_batches"] = sorted(completed)
+        stats["failed_batches"] = sorted(failed)
         stats.update(phase)
     return total, overflow
 
@@ -309,10 +505,19 @@ def keyrange_batched_join(
     on_batch_result: Optional[Callable] = None,
     warmup: bool = True,
     stats: Optional[dict] = None,
+    manifest_path: Optional[str] = None,
+    batch_retries: int = 0,
+    batch_retry_backoff_s: float = 1.0,
+    on_batch_failure: str = "raise",
     **join_opts,
 ) -> Tuple[int, bool]:
     """Join arbitrarily large host-resident tables in ``n_batches``
     device-sized pieces; returns (total_matches, any_overflow).
+    ``manifest_path``/``batch_retries``/``on_batch_failure`` are the
+    checkpoint/resume + per-batch recovery knobs of
+    :func:`batched_join_host` (binning is deterministic — the same
+    tables and ``n_batches`` always rebuild the same batches, which is
+    what makes resuming against the manifest sound).
 
     ``on_batch_result(batch_index, JoinResult)`` can materialize or
     reduce each batch's output; it runs on a dedicated fetch worker
@@ -355,5 +560,8 @@ def keyrange_batched_join(
     return batched_join_host(
         _bin(hb, bb), _bin(hp, pb), comm, key=key,
         warmup=warmup, stats=stats, on_batch_result=on_batch_result,
+        manifest_path=manifest_path, batch_retries=batch_retries,
+        batch_retry_backoff_s=batch_retry_backoff_s,
+        on_batch_failure=on_batch_failure,
         **join_opts,
     )

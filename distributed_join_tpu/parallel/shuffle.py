@@ -166,11 +166,12 @@ def ragged_plan(comm: Communicator, counts: jax.Array, out_capacity: int,
     exceeds it — exactly the padded mode's condition — even though the
     pooled buffer could still hold the rows. Rows are still
     transferred whenever they fit (no behavior change on the data
-    path); only the flag is conservative, so ``auto_retry`` fires
-    under the same conditions in every shuffle mode instead of one
-    mode silently accepting a layout another would reject.
+    path, extra varwidth columns included — ADVICE r5); only the flag
+    is conservative, so ``auto_retry`` fires under the same conditions
+    in every shuffle mode instead of one mode silently accepting a
+    layout another would reject.
     """
-    send_sizes, recv_sizes, output_offsets, total_recv, overflow, _ = \
+    send_sizes, recv_sizes, output_offsets, total_recv, overflow, _, _ = \
         _ragged_plan_matrices(comm, counts, out_capacity,
                               capacity_per_bucket)
     return send_sizes, recv_sizes, output_offsets, total_recv, overflow
@@ -179,7 +180,12 @@ def ragged_plan(comm: Communicator, counts: jax.Array, out_capacity: int,
 def _ragged_plan_matrices(comm, counts, out_capacity,
                           capacity_per_bucket=None):
     """ragged_plan + the full (start, allowed) matrices the
-    variable-width plane exchange needs."""
+    variable-width plane exchange needs, + the receiver-local ACTUAL
+    row-clamp flag (distinct from the possibly-conservative overflow
+    flag: a ``capacity_per_bucket`` trip fires the flag without
+    clamping a single row, and data-destructive recovery like the
+    extra-varwidth zeroing must key on the clamp, not the flag —
+    ADVICE r5)."""
     n = comm.n_ranks
     me = comm.axis_index()
     # Full count matrix: M[j, i] = rows rank j sends to rank i.
@@ -188,7 +194,8 @@ def _ragged_plan_matrices(comm, counts, out_capacity,
     # senders in rank order. start[j, i] = exclusive prefix down col i.
     start = jnp.cumsum(M, axis=0) - M
     allowed = jnp.clip(out_capacity - start, 0, M)
-    overflow = jnp.any(allowed[:, me] < M[:, me])
+    row_clamped = jnp.any(allowed[:, me] < M[:, me])
+    overflow = row_clamped
     if capacity_per_bucket is not None:
         overflow = overflow | jnp.any(M > capacity_per_bucket)
     send_sizes = comm.pvary(allowed[me, :].astype(jnp.int32))
@@ -196,7 +203,8 @@ def _ragged_plan_matrices(comm, counts, out_capacity,
     output_offsets = comm.pvary(start[me, :].astype(jnp.int32))
     total_recv = jnp.sum(recv_sizes)
     return (send_sizes, recv_sizes, output_offsets, total_recv,
-            comm.pvary(overflow), (start, allowed))
+            comm.pvary(overflow), (start, allowed),
+            comm.pvary(row_clamped))
 
 
 def shuffle_ragged(
@@ -236,11 +244,19 @@ def shuffle_ragged(
       the received "#len" companion — the same stable
       (bucket, len desc) sort on both sides, no extra wire bytes
       (round 5; VERDICT r4 weak #5 lifted the one-column limit).
-      Under a clamped (overflowing) transfer the dropped rows differ
+      Under an ACTUALLY clamped transfer the dropped rows differ
       between the row exchange (bucket tail) and a resorted column
       (shortest rows), so per-row alignment of the extra columns
-      cannot hold — they are delivered ALL-ZERO whenever ``overflow``
-      fires (never silently misaligned; the flag demands a retry).
+      cannot hold — they are delivered ALL-ZERO on the clamping
+      receiver (never silently misaligned; the flag demands a retry).
+      A flag-only trip of the conservative ``capacity_per_bucket``
+      contract clamps nothing, so the columns arrive intact
+      (ADVICE r5: zeroing on the flag destroyed correctly delivered
+      data).
+
+    Debug mode (``faults.validate_plans()`` / ``DJTPU_VALIDATE_PLANS``
+    at trace time): the transfer plan is cross-rank validated before
+    the exchange — see :func:`..faults.validate_ragged_plan`.
     """
     n = comm.n_ranks
     vw = ((varwidth,) if isinstance(varwidth, str)
@@ -248,10 +264,20 @@ def shuffle_ragged(
     counts = pt.counts[bucket_start : bucket_start + n].astype(jnp.int32)
     offsets = pt.offsets[bucket_start : bucket_start + n].astype(jnp.int32)
     (send_sizes, recv_sizes, output_offsets, total_recv, overflow,
-     (start, allowed)) = _ragged_plan_matrices(
+     (start, allowed), row_clamped) = _ragged_plan_matrices(
         comm, counts, out_capacity,
         capacity_per_bucket=capacity_per_bucket,
     )
+    from distributed_join_tpu.parallel import faults
+
+    if faults.plan_validation_enabled():
+        # Inconsistent vectors silently corrupt (emulation) or hang
+        # (TPU hardware op); the validation token must stay live in an
+        # output or XLA dead-code-eliminates the check.
+        tok = faults.validate_ragged_plan(
+            comm, send_sizes, recv_sizes, output_offsets, out_capacity,
+        )
+        overflow = overflow | comm.pvary(tok > 0)
     # One gather per column materializes the bucket-sorted layout the
     # input offsets point into (no padding, unlike to_padded). The
     # varwidth columns go LAST: the extra ones need their received
@@ -283,15 +309,18 @@ def shuffle_ragged(
         unsorted = _receiver_unsort(
             comm, raw, out_cols[name + "#len"], start, total_recv
         )
-        # Under a clamped transfer the row exchange drops each
-        # bucket's partition-order tail while this length-sorted
-        # column drops its SHORTEST rows — different row sets, so the
-        # unsort would attach surviving rows to other rows' bytes.
-        # Deliver the column EMPTY on overflow instead (all-zero
-        # bytes): the flag already demands a retry, and a caller
-        # peeking at partial results must never read silently
-        # misaligned strings (review r5).
-        out_cols[name] = jnp.where(overflow, 0, unsorted)
+        # Under an actual clamp the row exchange drops each bucket's
+        # partition-order tail while this length-sorted column drops
+        # its SHORTEST rows — different row sets, so the unsort would
+        # attach surviving rows to other rows' bytes. Deliver the
+        # column EMPTY on this receiver instead (all-zero bytes): the
+        # flag already demands a retry, and a caller peeking at
+        # partial results must never read silently misaligned strings
+        # (review r5). Keyed on the receiver-local ROW CLAMP, not the
+        # overflow flag: a conservative capacity_per_bucket trip
+        # clamps nothing and must leave delivered data intact
+        # (ADVICE r5 / ragged_plan's contract).
+        out_cols[name] = jnp.where(row_clamped, 0, unsorted)
     valid = jnp.arange(out_capacity, dtype=jnp.int32) < total_recv
     return Table(out_cols, valid), overflow
 
@@ -300,10 +329,25 @@ def varwidth_sort_plan(pt: PartitionedTable, names) -> dict:
     """Length-sorted layouts for every varwidth column BEYOND the
     first: {name: (col[perm], lens[perm])} with perm the within-bucket
     length-descending permutation. Batch-independent (the permutation
-    covers all k*n buckets at once), so the sort + gather happen ONCE
-    per join step here and memoize on the PartitionedTable — the
-    per-batch shuffle_ragged calls reuse them instead of re-sorting
-    and re-gathering k times (review r5)."""
+    covers all k*n buckets at once), so the sort happens ONCE per join
+    step here and memoizes on the PartitionedTable — the per-batch
+    shuffle_ragged calls reuse it instead of re-sorting k times
+    (review r5).
+
+    Cache lifetime contract (ADVICE r5): entries memoize on the
+    PartitionedTable instance via ``object.__setattr__`` and key on
+    nothing else, so a ``pt`` must not outlive the data its ``order``/
+    ``source`` refer to — in practice, the trace (or eager call
+    sequence) it was built in; ``radix_hash_partition`` returns a
+    fresh ``pt`` per step, which upholds this. What is cached also
+    differs by caller mode: under TRACING the gathered wide column is
+    cached too (a trace-local intermediate the k per-batch shuffles
+    share — composing bucket order with the length permutation gathers
+    it once instead of twice per use); for EAGER callers only the
+    cheap int32 composed permutation and sorted lengths are cached and
+    the wide gather re-runs per call, because caching it would pin a
+    full-width sorted copy of every extra string column in memory for
+    the pt's lifetime."""
     names = tuple(names or ())[1:]
     if not names:
         return {}
@@ -311,18 +355,23 @@ def varwidth_sort_plan(pt: PartitionedTable, names) -> dict:
     if cache is None:
         cache = {}
         object.__setattr__(pt, "_varwidth_sort_cache", cache)
+    out = {}
     for name in names:
-        if name not in cache:
-            # Compose bucket-order with the length permutation so the
-            # WIDE byte column is gathered once, straight into its
-            # length-sorted layout (pt.table would gather it twice).
+        ent = cache.get(name)
+        if ent is None:
             lens_sorted = pt.source.columns[name + "#len"][pt.order]
             perm = _within_bucket_len_order(pt.offsets, lens_sorted)
             order2 = pt.order[perm]
-            cache[name] = (
-                pt.source.columns[name][order2], lens_sorted[perm]
-            )
-    return cache
+            lens2 = lens_sorted[perm]
+            tracing = isinstance(order2, jax.core.Tracer)
+            col2 = pt.source.columns[name][order2] if tracing else None
+            cache[name] = (order2, lens2, col2)
+            ent = cache[name]
+        order2, lens2, col2 = ent
+        if col2 is None:
+            col2 = pt.source.columns[name][order2]
+        out[name] = (col2, lens2)
+    return out
 
 
 def _within_bucket_len_order(all_offsets, lens):

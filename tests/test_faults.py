@@ -1,0 +1,524 @@
+"""Failure-semantics layer (parallel/faults.py) on the 8-virtual-device
+CPU mesh: injected faults drive every branch of the auto_retry ladder
+(capacity doubling, skew-capacity jump, compression bits-widening),
+ragged-plan validation catches rank-inconsistent plans, bootstrap
+retries with backoff into a structured BootstrapError, and the
+out-of-core batch loop retries, degrades, and resumes bit-exactly from
+its on-disk manifest.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import distributed_join_tpu as dj
+from distributed_join_tpu.parallel import bootstrap, faults
+from distributed_join_tpu.parallel.faults import (
+    CapacityLadder,
+    FaultInjectedError,
+    FaultInjectingCommunicator,
+    FaultPlan,
+    JoinManifest,
+    ManifestMismatchError,
+    retry_with_backoff,
+)
+from distributed_join_tpu.parallel.out_of_core import keyrange_batched_join
+from distributed_join_tpu.utils.generators import (
+    generate_build_probe_tables,
+)
+
+pytestmark = pytest.mark.faults
+
+
+def _comm8(plan=None):
+    inner = dj.make_communicator("tpu", n_ranks=8)
+    if plan is None:
+        return inner
+    return FaultInjectingCommunicator(inner, plan)
+
+
+def _small_tables(seed=11, build=512, probe=1024, rand_max=256):
+    return generate_build_probe_tables(
+        seed=seed, build_nrows=build, probe_nrows=probe,
+        rand_max=rand_max, selectivity=0.5,
+    )
+
+
+def _oracle(build, probe):
+    return len(build.to_pandas().merge(probe.to_pandas(), on="key"))
+
+
+# -- the auto_retry ladder, branch by branch --------------------------
+
+
+def test_injected_overflow_drives_capacity_doubling():
+    """Two squeezed programs force two escalations; the final attempt
+    runs clean and the result matches the oracle — the ladder's
+    capacity-doubling branch, driven deterministically on CPU."""
+    b, p = _small_tables()
+    comm = _comm8(FaultPlan(overflow_programs=2))
+    res = dj.distributed_inner_join(
+        b, p, comm, auto_retry=3, out_capacity_factor=3.0,
+    )
+    assert not bool(res.overflow)
+    assert int(res.total) == _oracle(b, p)
+    rep = res.retry_report
+    assert rep.n_attempts == 3 and rep.resolved
+    acts = [a.action for a in rep.attempts]
+    assert acts == ["initial", "double_capacities", "double_capacities"]
+    assert [a.overflow for a in rep.attempts] == [True, True, False]
+    f0 = rep.attempts[0].shuffle_capacity_factor
+    assert rep.attempts[1].shuffle_capacity_factor == 2 * f0
+    assert rep.attempts[2].shuffle_capacity_factor == 4 * f0
+    assert rep.attempts[2].out_capacity_factor == \
+        4 * rep.attempts[0].out_capacity_factor
+    # machine-readable form drivers embed
+    rec = rep.as_record()
+    assert rec["n_attempts"] == 3 and rec["resolved"]
+    json.dumps(rec)  # JSON-serializable by construction
+
+
+def test_injected_overflow_widens_compression_bits_first():
+    """With compression on, the ladder must widen the CHEAP axis first:
+    bits-only recompiles, no buffer growth, until bits hit 32."""
+    b, p = _small_tables(seed=7)
+    comm = _comm8(FaultPlan(overflow_programs=2))
+    res = dj.distributed_inner_join(
+        b, p, comm, auto_retry=4, out_capacity_factor=3.0,
+        shuffle_capacity_factor=2.5, compression_bits=8,
+    )
+    assert not bool(res.overflow)
+    assert int(res.total) == _oracle(b, p)
+    rep = res.retry_report
+    assert [a.action for a in rep.attempts] == [
+        "initial", "widen_compression_bits", "widen_compression_bits",
+    ]
+    assert [a.compression_bits for a in rep.attempts] == [8, 16, 32]
+    # buffers must not grow while bits can still widen
+    assert rep.attempts[2].shuffle_capacity_factor == \
+        rep.attempts[0].shuffle_capacity_factor
+    assert rep.attempts[2].out_capacity_factor == \
+        rep.attempts[0].out_capacity_factor
+
+
+def test_injected_overflow_jumps_skew_capacities():
+    """With the skew path on, one escalation must jump the HH blocks to
+    full local probe coverage — one retry covers ANY skew."""
+    b, p = _small_tables(seed=9, build=512, probe=2048, rand_max=128)
+    comm = _comm8(FaultPlan(overflow_programs=1))
+    res = dj.distributed_inner_join(
+        b, p, comm, auto_retry=1, out_capacity_factor=4.0,
+        shuffle_capacity_factor=4.0, skew_threshold=0.05,
+    )
+    assert not bool(res.overflow)
+    assert int(res.total) == _oracle(b, p)
+    rep = res.retry_report
+    assert rep.n_attempts == 2 and rep.resolved
+    a0, a1 = rep.attempts
+    assert a1.action == "double_capacities"
+    p_local = 2048 // 8
+    assert a1.hh_build_capacity == 2 * a0.hh_build_capacity
+    assert a1.hh_probe_capacity == max(2 * a0.hh_probe_capacity, p_local)
+    assert a1.hh_out_capacity == max(2 * a0.hh_out_capacity, p_local)
+    assert a1.hh_probe_capacity >= p_local
+    assert a1.hh_out_capacity >= p_local
+
+
+def test_clean_run_reports_single_attempt_and_null_record():
+    b, p = _small_tables(seed=13)
+    res = dj.distributed_inner_join(
+        b, p, _comm8(), auto_retry=2, out_capacity_factor=3.0,
+    )
+    rep = res.retry_report
+    assert rep.n_attempts == 1 and rep.resolved
+    assert rep.as_record() is None  # drivers emit "retry": null
+
+
+def test_capacity_ladder_policy_unit():
+    """Policy unit-check without any compiles: bits widen to 32 before
+    any capacity doubles; out_rows_per_rank doubles with the factors."""
+    ladder = CapacityLadder(
+        shuffle_capacity_factor=1.0, out_capacity_factor=1.0,
+        out_rows_per_rank=100, compression_bits=8,
+    )
+    assert ladder.escalate() == "widen_compression_bits"
+    assert ladder.escalate() == "widen_compression_bits"
+    assert ladder.sizing()["compression_bits"] == 32
+    assert ladder.sizing()["shuffle_capacity_factor"] == 1.0
+    assert ladder.escalate() == "double_capacities"
+    s = ladder.sizing()
+    assert s["shuffle_capacity_factor"] == 2.0
+    assert s["out_rows_per_rank"] == 200
+
+
+# -- fault-injected dispatch failures ---------------------------------
+
+
+def test_fault_injected_dispatch_failure_raises():
+    b, p = _small_tables(seed=17)
+    comm = _comm8(FaultPlan(fail_dispatches=1))
+    with pytest.raises(FaultInjectedError, match="injected dispatch"):
+        dj.distributed_inner_join(b, p, comm, out_capacity_factor=3.0)
+
+
+# -- ragged-plan validation -------------------------------------------
+
+
+def _ragged_shuffle_total(comm, table, out_capacity):
+    from distributed_join_tpu.ops.partition import radix_hash_partition
+    from distributed_join_tpu.parallel.shuffle import shuffle_ragged
+
+    def run(t):
+        pt = radix_hash_partition(t, ["key"], comm.n_ranks)
+        got, ovf = shuffle_ragged(comm, pt, out_capacity)
+        return got.valid.sum()[None], ovf[None]
+
+    nvalid, ovf = comm.spmd(run)(table)
+    return int(jnp.sum(nvalid)), bool(jnp.any(ovf))
+
+
+def test_plan_validation_passes_consistent_plan():
+    b, _ = _small_tables(seed=19, build=1024, probe=8)
+    comm = _comm8()
+    with faults.validate_plans():
+        n, ovf = _ragged_shuffle_total(comm, b, 4 * 1024 // 8)
+    faults.check_plan_violations()  # no violations recorded
+    assert n == 1024 and not ovf
+
+
+def test_plan_validation_tolerates_clamped_plan():
+    """A plain capacity overflow is a CONSISTENT plan: offsets are the
+    unclamped prefix starts, so squeezed-out senders carry
+    start > out_capacity with send == 0 — validation must not turn a
+    recoverable overflow (auto_retry's whole job) into a phantom
+    corrupted-plan error."""
+    b, _ = _small_tables(seed=19, build=1024, probe=8)
+    comm = _comm8()
+    with faults.validate_plans():
+        n, ovf = _ragged_shuffle_total(comm, b, 16)  # hard clamp
+    faults.check_plan_violations()  # nothing recorded
+    assert ovf, "the clamp must still flag overflow"
+
+
+def test_plan_validation_catches_rank_inconsistent_counts():
+    """A corrupted count gather gives every rank a different transfer
+    plan — exactly the silent-corruption/hang precursor on hardware;
+    validation must record the violation, trip the overflow flag, and
+    raise loudly at the check point."""
+    b, _ = _small_tables(seed=23, build=1024, probe=8)
+    comm = _comm8(FaultPlan(corrupt_plan_gathers=1, seed=3))
+    with faults.validate_plans():
+        # (the callback also warns, but from the backend's callback
+        # thread — not asserted here)
+        _, ovf = _ragged_shuffle_total(comm, b, 4 * 1024 // 8)
+    assert ovf, "a corrupted plan must read as 'do not trust this'"
+    with pytest.raises(faults.PlanValidationError,
+                       match="ragged plan inconsistent"):
+        faults.check_plan_violations()
+    faults.check_plan_violations()  # cleared by the raise
+
+
+def test_plan_validation_raises_through_distributed_inner_join():
+    """The orchestrator surfaces recorded violations after each
+    attempt instead of retrying a corrupted-metadata exchange."""
+    b, p = _small_tables(seed=37)
+    comm = _comm8(FaultPlan(corrupt_plan_gathers=1, seed=1))
+    with faults.validate_plans():
+        with pytest.raises(faults.PlanValidationError):
+            dj.distributed_inner_join(
+                b, p, comm, shuffle="ragged", auto_retry=2,
+                out_capacity_factor=3.0,
+            )
+
+
+def test_plan_validation_off_by_default():
+    assert not faults.plan_validation_enabled()
+    with faults.validate_plans():
+        assert faults.plan_validation_enabled()
+    assert not faults.plan_validation_enabled()
+
+
+# -- bootstrap retry / backoff ----------------------------------------
+
+
+def test_retry_with_backoff_schedule_and_trail():
+    calls, delays = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionRefusedError("coordinator not up")
+        return "ok"
+
+    result, attempts = retry_with_backoff(
+        flaky, max_attempts=4, backoff_s=1.0, backoff_factor=2.0,
+        sleep=delays.append,
+    )
+    assert result == "ok" and len(calls) == 3
+    assert delays == [1.0, 2.0]
+    assert [a["error"] is None for a in attempts] == [False, False, True]
+
+
+def test_retry_with_backoff_respects_deadline():
+    t = {"now": 0.0}
+
+    def clock():
+        return t["now"]
+
+    def sleep(s):
+        t["now"] += s
+
+    def always_down():
+        t["now"] += 5.0
+        raise ConnectionRefusedError("down")
+
+    with pytest.raises(ConnectionRefusedError):
+        retry_with_backoff(
+            always_down, max_attempts=100, backoff_s=1.0,
+            deadline_s=12.0, sleep=sleep, clock=clock,
+        )
+    # deadline stopped it long before 100 attempts
+    assert t["now"] < 30.0
+
+
+def test_bootstrap_initialize_retries_then_succeeds(monkeypatch):
+    # pre-touch the env through monkeypatch so initialize's direct
+    # writes are reverted at teardown
+    monkeypatch.setenv(bootstrap.ENV_NUM_PROCESSES, "sentinel")
+    monkeypatch.setenv(bootstrap.ENV_PROCESS_ID, "sentinel")
+    calls = []
+
+    def connect(addr, nproc, pid):
+        calls.append((addr, nproc, pid))
+        if len(calls) < 2:
+            raise RuntimeError("UNAVAILABLE: coordinator not up")
+
+    bootstrap.initialize(
+        "host:1234", 2, 1, connect=connect, sleep=lambda s: None,
+        max_retries=3, backoff_s=0.01,
+    )
+    assert calls == [("host:1234", 2, 1)] * 2
+
+
+def test_bootstrap_error_is_structured(monkeypatch):
+    monkeypatch.setenv(bootstrap.ENV_NUM_PROCESSES, "sentinel")
+    monkeypatch.setenv(bootstrap.ENV_PROCESS_ID, "sentinel")
+
+    def connect(addr, nproc, pid):
+        raise ConnectionRefusedError("nobody listening")
+
+    with pytest.raises(bootstrap.BootstrapError) as ei:
+        bootstrap.initialize(
+            "downhost:9", 2, 0, connect=connect,
+            sleep=lambda s: None, max_retries=3, backoff_s=0.01,
+            deadline_s=60.0,
+        )
+    rec = ei.value.record()
+    assert rec["error"] == "BootstrapError"
+    assert rec["phase"] == "handshake"
+    assert rec["coordinator"] == "downhost:9"
+    assert rec["deadline_s"] == 60.0
+    assert len(rec["attempts"]) == 3
+    assert all("nobody listening" in a["error"]
+               for a in rec["attempts"])
+    json.dumps(rec)
+
+
+def test_call_with_deadline_times_out():
+    import threading
+
+    release = threading.Event()
+    try:
+        with pytest.raises(bootstrap.BootstrapError, match="0.2s"):
+            bootstrap.call_with_deadline(
+                release.wait, 0.2, what="backend init"
+            )
+    finally:
+        release.set()  # un-hang the watchdog's worker thread
+
+
+# -- out-of-core: retry, degradation, manifest resume -----------------
+
+_OOC_OPTS = dict(out_capacity_factor=3.0, shuffle_capacity_factor=3.0)
+
+
+@pytest.fixture(scope="module")
+def ooc_tables():
+    return _small_tables(seed=29, build=1500, probe=3000, rand_max=700)
+
+
+@pytest.fixture(scope="module")
+def ooc_reference(ooc_tables):
+    """Uninterrupted run: the ground truth total plus per-batch totals
+    (via the consumer) the failure scenarios are checked against."""
+    b, p = ooc_tables
+    per_batch = {}
+    total, overflow = keyrange_batched_join(
+        b, p, _comm8(), n_batches=4, warmup=False,
+        on_batch_result=lambda i, res: per_batch.__setitem__(
+            i, int(res.total)),
+        **_OOC_OPTS,
+    )
+    assert not overflow
+    assert total == _oracle(b, p)
+    assert sum(per_batch.values()) == total
+    return total, per_batch
+
+
+def test_batch_retry_recovers_transient_dispatch_failure(
+        ooc_tables, ooc_reference):
+    b, p = ooc_tables
+    total0, _ = ooc_reference
+    comm = _comm8(FaultPlan(fail_dispatches=1))
+    stats = {}
+    total, overflow = keyrange_batched_join(
+        b, p, comm, n_batches=4, warmup=False, batch_retries=1,
+        stats=stats, **_OOC_OPTS,
+    )
+    assert total == total0 and not overflow
+    assert stats["failed_batches"] == []
+
+
+def test_graceful_degradation_reports_partial_totals(
+        ooc_tables, ooc_reference):
+    b, p = ooc_tables
+    total0, per_batch = ooc_reference
+    # batch 0's dispatch fails twice (initial + its one retry) -> the
+    # batch is abandoned; everything after runs clean.
+    comm = _comm8(FaultPlan(fail_dispatches=2))
+    stats = {}
+    total, overflow = keyrange_batched_join(
+        b, p, comm, n_batches=4, warmup=False, batch_retries=1,
+        on_batch_failure="continue", stats=stats, **_OOC_OPTS,
+    )
+    assert stats["failed_batches"] == [0]
+    assert total == total0 - per_batch[0]
+
+
+def test_killed_run_resumes_bit_exact_from_manifest(
+        tmp_path, ooc_tables, ooc_reference):
+    """The acceptance contract: kill an out-of-core run mid-way, rerun
+    with the same arguments, and the resumed run must skip completed
+    batches and reproduce the uninterrupted total bit-exactly."""
+    b, p = ooc_tables
+    total0, per_batch = ooc_reference
+    manifest_path = str(tmp_path / "join_manifest.json")
+
+    # Run 1: a persistent outage kills the run after two dispatches —
+    # batch 0 completed AND settled (its total fetched at the
+    # backpressure sync), batch 1 computed but never persisted.
+    comm = _comm8(FaultPlan(fail_after_dispatches=2))
+    with pytest.raises(FaultInjectedError, match="persistent outage"):
+        keyrange_batched_join(
+            b, p, comm, n_batches=4, warmup=False,
+            manifest_path=manifest_path, **_OOC_OPTS,
+        )
+    data = json.load(open(manifest_path))
+    assert set(data["batches"]) == {"0"}
+    assert data["batches"]["0"]["total"] == per_batch[0]
+    assert data["failures"], "the injected failure must be logged"
+
+    # Run 2: same arguments, healthy communicator — resumes from the
+    # first incomplete batch.
+    seen = []
+    stats = {}
+    total, overflow = keyrange_batched_join(
+        b, p, _comm8(), n_batches=4, warmup=False,
+        manifest_path=manifest_path, stats=stats,
+        on_batch_result=lambda i, res: seen.append(i),
+        **_OOC_OPTS,
+    )
+    assert total == total0 and not overflow
+    assert stats["resumed_batches"] == [0]
+    assert seen == [1, 2, 3], "completed batch 0 must not re-run"
+    # the manifest now covers every batch
+    data = json.load(open(manifest_path))
+    assert set(data["batches"]) == {"0", "1", "2", "3"}
+    assert sum(v["total"] for v in data["batches"].values()) == total0
+
+
+def test_fully_completed_manifest_skips_all_work(
+        tmp_path, ooc_tables, ooc_reference):
+    b, p = ooc_tables
+    total0, _ = ooc_reference
+    manifest_path = str(tmp_path / "m.json")
+    keyrange_batched_join(
+        b, p, _comm8(), n_batches=4, warmup=False,
+        manifest_path=manifest_path, **_OOC_OPTS,
+    )
+    # a communicator whose EVERY dispatch fails: only manifest replay
+    # can produce the total
+    comm = _comm8(FaultPlan(fail_after_dispatches=0))
+    total, overflow = keyrange_batched_join(
+        b, p, comm, n_batches=4, warmup=False,
+        manifest_path=manifest_path, **_OOC_OPTS,
+    )
+    assert total == total0 and not overflow
+
+
+def test_overflowed_manifest_batches_rerun_on_resume(
+        tmp_path, ooc_tables, ooc_reference):
+    """A batch recorded with overflow=true counts as incomplete on
+    resume: its total is exact but its materialized rows were
+    truncated, and the natural recovery — re-invoke with bigger
+    capacities against the same manifest (sizing is deliberately not
+    in the fingerprint) — must re-run exactly those batches and
+    overwrite their entries."""
+    b, p = ooc_tables
+    total0, _ = ooc_reference
+    manifest_path = str(tmp_path / "m.json")
+
+    # Run 1: a tiny per-rank output block overflows every batch; the
+    # recorded totals are still exact.
+    total, overflow = keyrange_batched_join(
+        b, p, _comm8(), n_batches=4, warmup=False,
+        manifest_path=manifest_path, out_rows_per_rank=8,
+        shuffle_capacity_factor=3.0,
+    )
+    assert total == total0 and overflow
+    data = json.load(open(manifest_path))
+    assert all(v["overflow"] for v in data["batches"].values())
+
+    # Run 2: same manifest, healthy sizing — every overflowed batch
+    # re-runs (nothing is "resumed") and the entries come back clean.
+    stats = {}
+    total, overflow = keyrange_batched_join(
+        b, p, _comm8(), n_batches=4, warmup=False,
+        manifest_path=manifest_path, stats=stats, **_OOC_OPTS,
+    )
+    assert total == total0 and not overflow
+    assert stats["resumed_batches"] == []
+    data = json.load(open(manifest_path))
+    assert not any(v["overflow"] for v in data["batches"].values())
+
+    # Run 3: now-clean manifest resumes everything.
+    stats = {}
+    total, overflow = keyrange_batched_join(
+        b, p, _comm8(), n_batches=4, warmup=False,
+        manifest_path=manifest_path, stats=stats, **_OOC_OPTS,
+    )
+    assert total == total0 and stats["resumed_batches"] == [0, 1, 2, 3]
+
+
+def test_manifest_refuses_mismatched_config(tmp_path, ooc_tables):
+    b, p = ooc_tables
+    manifest_path = str(tmp_path / "m.json")
+    JoinManifest(manifest_path, {"n_batches": 999})
+    with pytest.raises(ManifestMismatchError, match="different"):
+        keyrange_batched_join(
+            b, p, _comm8(), n_batches=4, warmup=False,
+            manifest_path=manifest_path, **_OOC_OPTS,
+        )
+
+
+def test_manifest_atomic_roundtrip(tmp_path):
+    path = str(tmp_path / "m.json")
+    m = JoinManifest(path, {"n_batches": 2})
+    m.record_batch(0, 123, False)
+    m.record_failure(1, "FaultInjectedError: boom", 0)
+    m2 = JoinManifest(path, {"n_batches": 2})
+    assert m2.completed == {0: {"total": 123, "overflow": False}}
+    assert m2.failures[0]["batch"] == 1

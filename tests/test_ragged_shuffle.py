@@ -278,6 +278,75 @@ def test_multi_varwidth_distributed_join_vs_oracle():
             assert not byt[i, int(ln[i]):].any()
 
 
+def test_multi_varwidth_overflow_zeroes_extra_columns_only_on_clamp():
+    """The overflow branch of the multi-varwidth path (ADVICE r5):
+
+    - an ACTUAL row clamp (pooled capacity too small) must deliver the
+      extra varwidth column all-zero with the flag raised — under a
+      clamp the row exchange and the length-resorted column drop
+      DIFFERENT rows, so alignment cannot hold and zero is the only
+      non-misleading content;
+    - a flag-only trip of the conservative capacity_per_bucket
+      contract clamps nothing and must leave the extra column's
+      delivered bytes INTACT (ragged_plan's contract: only the flag is
+      conservative — zeroing here destroyed correctly delivered data).
+    """
+    import numpy as np
+
+    import distributed_join_tpu as dj
+    from distributed_join_tpu.table import Table
+    from distributed_join_tpu.utils.strings import encode_strings
+
+    rng = np.random.default_rng(31)
+    n_rows = 2048
+    keys = rng.integers(0, 512, n_rows)
+    sv = [f"aa-{int(k)}" + "y" * int(k % 11) for k in keys]
+    tv = [f"b{int(k) % 9}" * int(k % 5) for k in keys]
+    sby, sbl = encode_strings(sv, 20)
+    tby, tbl_ = encode_strings(tv, 12)
+    t = Table.from_dense({
+        "key": jnp.asarray(keys, jnp.int64),
+        "s": sby, "s#len": sbl,
+        "t": tby, "t#len": tbl_,
+    })
+    comm = dj.make_communicator("tpu", n_ranks=8)
+
+    def run(out_cap, cap_per_bucket=None):
+        def step(tt):
+            pt = radix_hash_partition(tt, ["key"], 8,
+                                      order_within="s#len")
+            got, ovf = shuffle_ragged(
+                comm, pt, out_cap, capacity_per_bucket=cap_per_bucket,
+                varwidth=("s", "t"))
+            return (got.columns["t"], got.columns["t#len"],
+                    got.valid, ovf[None])
+        return comm.spmd(
+            step, sharded_out=(False, False, False, False)
+        )(t)
+
+    # 1) actual clamp: every rank receives ~256 rows into 64 slots
+    tcol, _, _, ovf = run(out_cap=64)
+    assert bool(jnp.any(ovf)), "tiny pooled capacity must clamp + flag"
+    assert not np.asarray(tcol).any(), \
+        "extra varwidth column must arrive all-zero on a real clamp"
+
+    # 2) flag-only trip: pooled buffer holds everything, one bucket
+    # exceeds the per-bucket contract -> flag fires, data intact
+    base = run(out_cap=n_rows)
+    conservative = run(out_cap=n_rows, cap_per_bucket=2)
+    assert not bool(jnp.any(base[3]))
+    assert bool(jnp.any(conservative[3])), \
+        "per-bucket contract must still flag"
+    np.testing.assert_array_equal(
+        np.asarray(base[0]), np.asarray(conservative[0]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(base[1]), np.asarray(conservative[1]),
+    )
+    assert np.asarray(base[0])[np.asarray(base[2])].any(), \
+        "sanity: the extra column carries real bytes"
+
+
 def test_varwidth_distributed_join_strings_vs_oracle():
     """End-to-end: variable-length string payloads ride the ragged
     distributed join byte-exactly and decode to the oracle's strings."""
