@@ -25,6 +25,13 @@ under BOTH capacity stories and both appear in the one JSON line —
 - ``value_capacity_contract``: output block sized by the flag driver's
   general contract, ``out_capacity_factor`` (1.2) x probe rows — what a
   user who does NOT know the match count pays.
+
+Observability: ``--telemetry [DIR]`` / ``--trace`` activate the shared
+telemetry session (docs/OBSERVABILITY.md); the record carries
+``schema_version``/``rank`` always, and the session summary under
+``"telemetry"`` only when a session is active (key present iff
+telemetry is on — the same presence contract as ``benchmarks.report``).
+Flagless invocation changes nothing else about the record or the run.
 """
 
 from __future__ import annotations
@@ -77,7 +84,7 @@ ITERS = int(os.environ.get("DJTPU_BENCH_ITERS", 8))
 BASELINE_M_ROWS_PER_SEC_PER_CHIP = 125.0
 
 
-def main() -> int:
+def main(argv=None) -> int:
     # Backend init (jax.devices()) is the first thing that can fail when
     # the TPU relay is down.  An outage must still leave a parseable
     # one-line JSON artifact (VERDICT r4 missing #1), not a bare
@@ -85,31 +92,44 @@ def main() -> int:
     # OTHER failure (overflow assert, a code bug) also leaves the
     # record but keeps rc=1: a regressed benchmark must not read as a
     # clean pass to rc-checking automation.
+    import argparse
+
+    from distributed_join_tpu import telemetry
+    from distributed_join_tpu.benchmarks import (
+        add_telemetry_args,
+        stamp_record,
+    )
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    add_telemetry_args(p)
+    args = p.parse_args(argv)
+    telemetry.configure_from_args(args)
     try:
         return _run()
     except Exception as exc:  # noqa: BLE001 — record, then re-signal
         from distributed_join_tpu.parallel.bootstrap import BootstrapError
 
         is_outage = isinstance(exc, BootstrapError)
-        print(
-            json.dumps(
-                {
-                    "metric": "join throughput",
-                    "value": None,
-                    "unit": "M rows/sec/chip",
-                    "vs_baseline": None,
-                    "error": f"{type(exc).__name__}: {exc}",
-                    "bootstrap": exc.record() if is_outage else None,
-                    "traceback": traceback.format_exc().splitlines()[-3:],
-                }
-            ),
-            flush=True,
-        )
+        record = stamp_record({
+            "metric": "join throughput",
+            "value": None,
+            "unit": "M rows/sec/chip",
+            "vs_baseline": None,
+            "error": f"{type(exc).__name__}: {exc}",
+            "bootstrap": exc.record() if is_outage else None,
+            "traceback": traceback.format_exc().splitlines()[-3:],
+        })
+        print(json.dumps(record), flush=True)
         # A hung init thread (relay down) would block normal interpreter
-        # exit; the record is already flushed, so leave hard. Only an
-        # environment outage exits 0: a regressed benchmark must not
-        # read as a clean pass to rc-checking automation.
+        # exit; the record is already flushed, so leave hard (after
+        # flushing the telemetry files — finally won't run past
+        # os._exit). Only an environment outage exits 0: a regressed
+        # benchmark must not read as a clean pass to rc-checking
+        # automation.
+        telemetry.finalize()
         os._exit(0 if is_outage else 1)
+    finally:
+        telemetry.finalize()
 
 
 def _run() -> int:
@@ -121,7 +141,14 @@ def _run() -> int:
     from distributed_join_tpu.utils.benchmarking import timed_join_throughput
     from distributed_join_tpu.utils.generators import generate_build_probe_tables
 
+    from distributed_join_tpu import telemetry
+
     n_dev = len(_init_devices())
+    # Rank was env-resolved at configure time; rebind now that the
+    # backend is authoritative. --trace: the XLA device profile can
+    # only start once the backend is up (the line above).
+    telemetry.refresh_rank()
+    telemetry.maybe_start_xla_trace()
     comm = LocalCommunicator() if n_dev == 1 else TpuCommunicator(n_ranks=n_dev)
 
     build, probe = generate_build_probe_tables(
@@ -187,27 +214,26 @@ def _run() -> int:
     # (distributed_join.DEFAULT_OUT_CAPACITY_FACTOR over probe rows) —
     # no match-count oracle.
     m_rows_contract, retry_contract = measure()
-    print(
-        json.dumps(
-            {
-                "metric": "join throughput",
-                "value": round(m_rows_per_chip, 3),
-                "unit": "M rows/sec/chip",
-                "vs_baseline": round(
-                    m_rows_per_chip / BASELINE_M_ROWS_PER_SEC_PER_CHIP, 4
-                ),
-                "value_capacity_contract": round(m_rows_contract, 3),
-                "out_rows": {
-                    "match_sized": int(EXPECTED_MATCHES * OUT_SLACK),
-                    "contract": "out_capacity_factor=1.2 x probe rows",
-                },
-                "retry": {
-                    "match_sized": retry_match,
-                    "capacity_contract": retry_contract,
-                },
-            }
-        )
-    )
+    from distributed_join_tpu.benchmarks import stamp_record
+
+    record = stamp_record({
+        "metric": "join throughput",
+        "value": round(m_rows_per_chip, 3),
+        "unit": "M rows/sec/chip",
+        "vs_baseline": round(
+            m_rows_per_chip / BASELINE_M_ROWS_PER_SEC_PER_CHIP, 4
+        ),
+        "value_capacity_contract": round(m_rows_contract, 3),
+        "out_rows": {
+            "match_sized": int(EXPECTED_MATCHES * OUT_SLACK),
+            "contract": "out_capacity_factor=1.2 x probe rows",
+        },
+        "retry": {
+            "match_sized": retry_match,
+            "capacity_contract": retry_contract,
+        },
+    })
+    print(json.dumps(record))
     return 0
 
 

@@ -10,16 +10,43 @@ and is installed as a console script (pyproject.toml); the repo-root
 
 from __future__ import annotations
 
+# Version of the driver/bench JSON record layout. Bumped to 2 when the
+# telemetry subsystem added the (optional) "telemetry" block plus the
+# always-present "schema_version"/"rank" fields — downstream BENCH
+# parsers key on schema_version instead of guessing from key presence.
+SCHEMA_VERSION = 2
+
+
+def stamp_record(record: dict) -> dict:
+    """THE one place the record-layout stamp lives (SCHEMA_VERSION
+    changes must not chase copies): ``schema_version`` + ``rank``
+    always, and — iff a telemetry session is active — its summary
+    under ``"telemetry"`` (key presence IS the signal; never null).
+    Mutates and returns ``record``. Used by :func:`report`,
+    :func:`run_guarded`'s failure records, and bench.py."""
+    from distributed_join_tpu import telemetry
+    from distributed_join_tpu.parallel.bootstrap import process_id
+
+    record.setdefault("schema_version", SCHEMA_VERSION)
+    record.setdefault("rank", process_id())
+    if telemetry.enabled():
+        record.setdefault("telemetry", telemetry.summary())
+    return record
+
 
 def report(headline: str, record: dict, json_output: str | None) -> None:
     """Rank-0-only result reporting, shared by every driver: a
     reference-shaped stdout line, the JSON record, and the optional
     ``--json-output`` file (the reference prints from MPI rank 0,
-    SURVEY.md §3.1 final step)."""
+    SURVEY.md §3.1 final step).
+
+    Every record gets :func:`stamp_record`'s layout stamp (mutated in
+    place, so the dict ``run()`` returns carries it on every rank)."""
     import json
 
     from distributed_join_tpu.parallel.bootstrap import is_coordinator
 
+    stamp_record(record)
     if not is_coordinator():
         return
     print(headline)
@@ -45,8 +72,14 @@ def run_guarded(run, args, benchmark: str) -> int:
     import sys
     import traceback
 
+    from distributed_join_tpu import telemetry
     from distributed_join_tpu.parallel.bootstrap import BootstrapError
 
+    # --telemetry[=DIR]/--trace (add_telemetry_args) activate the one
+    # observability session here, so every driver shares the wiring;
+    # the XLA device profile for --trace starts later, in
+    # apply_platform, after platform/bootstrap selection.
+    telemetry.configure_from_args(args)
     try:
         run(args)
         return 0
@@ -54,7 +87,7 @@ def run_guarded(run, args, benchmark: str) -> int:
     # not an Exception, and it is not a runtime failure record.
     except Exception as exc:
         is_bootstrap = isinstance(exc, BootstrapError)
-        record = {
+        record = stamp_record({
             "benchmark": benchmark,
             "error": f"{type(exc).__name__}: {exc}",
             "failure": (exc.record() if is_bootstrap else {
@@ -63,7 +96,7 @@ def run_guarded(run, args, benchmark: str) -> int:
                 "traceback":
                     traceback.format_exc().splitlines()[-3:],
             }),
-        }
+        })
         line = json.dumps(record)
         print(line, flush=True)
         json_output = getattr(args, "json_output", None)
@@ -79,11 +112,17 @@ def run_guarded(run, args, benchmark: str) -> int:
             # watchdog worker thread stuck inside jax.distributed
             # .initialize, and concurrent.futures' atexit hook would
             # join it forever on a normal return — the record above is
-            # already flushed, so leave now.
+            # already flushed. os._exit skips the finally below, so
+            # flush the telemetry files first.
+            telemetry.finalize()
             sys.stdout.flush()
             sys.stderr.flush()
             os._exit(0)
         raise
+    finally:
+        # Write the Chrome trace / summary even on failure — a run
+        # that died is exactly the run whose trace you want.
+        telemetry.finalize()
 
 
 def add_platform_arg(parser) -> None:
@@ -94,6 +133,59 @@ def add_platform_arg(parser) -> None:
         help="cpu forces the virtual-device host backend "
              "(multi-rank runs on a 1-chip machine)",
     )
+
+
+def add_telemetry_args(parser) -> None:
+    """The shared telemetry flags (one definition for all drivers;
+    docs/OBSERVABILITY.md). ``run_guarded`` consumes them."""
+    parser.add_argument(
+        "--telemetry", nargs="?", const="telemetry", default=None,
+        metavar="DIR",
+        help="activate the telemetry session: JSONL event log + "
+             "Perfetto-loadable Chrome trace per rank under DIR "
+             "(default ./telemetry), device-side join counters "
+             "embedded in the JSON record. Off = the exact seed hot "
+             "path (no aux outputs, no recompiles)",
+    )
+    parser.add_argument(
+        "--trace", action="store_true",
+        help="additionally capture a full XLA device profile under "
+             "DIR/xla (open with TensorBoard/XProf; span names line "
+             "up via TraceAnnotation). Implies --telemetry",
+    )
+
+
+def collect_join_metrics(comm, build, probe, join_opts: dict,
+                         attempt: int = 0):
+    """Driver seam: run ONE metrics-instrumented join step on the real
+    inputs and fold its device counters into the telemetry session.
+
+    The drivers' TIMED loop stays the seed program (chained iterations,
+    loop-shifted keys — see utils/benchmarking.timed_join_throughput);
+    instrumenting it would both perturb the measurement and make the
+    counters K-fold sums over shifted keys. One separate single-step
+    program after the timed region costs one extra compile but yields
+    per-join counters on the UNshifted tables — directly comparable to
+    a pandas oracle (the acceptance contract in tests/
+    test_telemetry.py). No-op (None) when telemetry is off."""
+    from distributed_join_tpu import telemetry
+
+    if not telemetry.enabled():
+        return None
+    from distributed_join_tpu.parallel.distributed_join import (
+        JOIN_METRICS_SHARDED_OUT,
+        make_join_step,
+    )
+
+    with telemetry.span("collect_metrics") as sp:
+        step = make_join_step(
+            comm, with_metrics=True,
+            metrics_static={"retry_attempt_max": attempt}, **join_opts)
+        fn = comm.spmd(step, sharded_out=JOIN_METRICS_SHARDED_OUT)
+        res, metrics = fn(build, probe)
+        d = telemetry.emit_metrics(metrics)
+        sp.sync_on(res.total)
+    return d
 
 
 def apply_platform(platform: str | None, n_ranks: int | None) -> None:
@@ -110,13 +202,30 @@ def apply_platform(platform: str | None, n_ranks: int | None) -> None:
     ``--platform`` is ignored: the handshake must happen before any
     device use, exactly here.
     """
+    from distributed_join_tpu import telemetry
     from distributed_join_tpu.parallel.bootstrap import (
         maybe_initialize_from_env,
     )
 
+    def _start_trace():
+        # The telemetry session was configured before the handshake
+        # (run_guarded), when only the env-fallback rank was visible —
+        # rebind to the authoritative rank first, then start the
+        # --trace XLA profile (the profiler initializes a backend, so
+        # it can only start HERE — after the platform decision /
+        # multi-host handshake every driver routes through this
+        # function for). SUCCESS paths only: after a failed bootstrap,
+        # starting the profiler would re-initialize the backend
+        # against the same dead relay and hang where run_guarded
+        # expects the BootstrapError record.
+        telemetry.refresh_rank()
+        telemetry.maybe_start_xla_trace()
+
     if maybe_initialize_from_env():
+        _start_trace()
         return
     if platform in (None, "", "default"):
+        _start_trace()
         return
     import os
 
@@ -127,6 +236,8 @@ def apply_platform(platform: str | None, n_ranks: int | None) -> None:
         if "xla_force_host_platform_device_count" not in flags:
             count = max(8, n_ranks or 0)
             os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count={count}"
+                f"{flags} "
+                f"--xla_force_host_platform_device_count={count}"
             ).strip()
     jax.config.update("jax_platforms", platform)
+    _start_trace()

@@ -26,6 +26,7 @@ from jax import lax
 
 from distributed_join_tpu.benchmarks import (
     add_platform_arg,
+    add_telemetry_args,
     apply_platform,
     report,
 )
@@ -44,6 +45,7 @@ def parse_args(argv=None):
                    help="chained exchanges in the timed compiled loop")
     p.add_argument("--json-output", default=None)
     add_platform_arg(p)
+    add_telemetry_args(p)
     return p.parse_args(argv)
 
 
@@ -83,7 +85,7 @@ def run(args) -> dict:
     def fetch(res):
         state["checksum"] = float(res)
 
-    sec = measure(lambda: fn(x), fetch, iters)
+    sec = measure(lambda: fn(x), fetch, iters, name="all_to_all")
 
     bytes_per_rank = elems * 4
     egress = bytes_per_rank * (n - 1) / n

@@ -25,13 +25,16 @@ from __future__ import annotations
 
 import argparse
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
 
 from distributed_join_tpu.benchmarks import (
     add_platform_arg,
+    add_telemetry_args,
     apply_platform,
+    collect_join_metrics,
     report,
 )
 from distributed_join_tpu.parallel.communicator import make_communicator
@@ -147,6 +150,7 @@ def parse_args(argv=None):
     p.add_argument("--json-output", default=None,
                    help="also write the result record to this file")
     add_platform_arg(p)
+    add_telemetry_args(p)
     return p.parse_args(argv)
 
 
@@ -200,6 +204,7 @@ def run(args) -> dict:
 
     comm = make_communicator(args.communicator, n_ranks=args.n_ranks)
     n = comm.n_ranks
+    gen_t0 = time.perf_counter()
     key_dtype = DTYPES[args.key_type]
     payload_dtype = DTYPES[args.payload_type]
     b_rows, p_rows = args.build_table_nrows, args.probe_table_nrows
@@ -264,6 +269,11 @@ def run(args) -> dict:
             build, probe, join_key, args.string_key_bytes)
     build, probe = comm.device_put_sharded((build, probe))
     jax.block_until_ready((build, probe))
+    from distributed_join_tpu import telemetry
+
+    telemetry.span_complete("generate", gen_t0,
+                            time.perf_counter() - gen_t0,
+                            build_nrows=b_rows, probe_nrows=p_rows)
 
     # Skew auto-policy (round 5): a known Zipf workload runs the skew
     # path by default, with the HH blocks PRE-sized from alpha via the
@@ -367,6 +377,15 @@ def run(args) -> dict:
         if not overflow or attempt == args.auto_retry:
             break
         ladder.escalate()
+
+    # --telemetry: one extra single-step program on the unshifted
+    # inputs collects the device counters (rows shuffled, wire bytes,
+    # match count...) AFTER the timed region, leaving the timed
+    # program the exact seed hot path; embedded in the record by
+    # report() under telemetry.metrics.
+    collect_join_metrics(comm, build, probe,
+                         dict(fixed_opts, **ladder.sizing()),
+                         attempt=attempt)
 
     rows = b_rows + p_rows
     rows_per_sec = rows / sec_per_join

@@ -24,7 +24,9 @@ import jax
 
 from distributed_join_tpu.benchmarks import (
     add_platform_arg,
+    add_telemetry_args,
     apply_platform,
+    collect_join_metrics,
     report,
 )
 from distributed_join_tpu.parallel.communicator import make_communicator
@@ -79,6 +81,7 @@ def parse_args(argv=None):
     p.add_argument("--out-capacity-factor", type=float, default=1.5)
     p.add_argument("--json-output", default=None)
     add_platform_arg(p)
+    add_telemetry_args(p)
     return p.parse_args(argv)
 
 
@@ -183,11 +186,14 @@ def run(args) -> dict:
         return _report(args, comm, orders_rows, lineitem_rows, rows,
                        total, overflow, sec, record_extra)
 
-    orders, lineitem = generate_tpch_join_tables(
-        seed=42, scale_factor=args.scale_factor
-    )
-    if args.q3_filters:
-        orders, lineitem = q3_filter(orders, lineitem)
+    from distributed_join_tpu import telemetry
+
+    with telemetry.span("generate", scale_factor=args.scale_factor):
+        orders, lineitem = generate_tpch_join_tables(
+            seed=42, scale_factor=args.scale_factor
+        )
+        if args.q3_filters:
+            orders, lineitem = q3_filter(orders, lineitem)
     build = orders.rename({"o_orderkey": "key"})
     probe = lineitem.rename({"l_orderkey": "key"})
     # Count real rows (filters mask rows in place), so batched and
@@ -234,16 +240,20 @@ def run(args) -> dict:
         probe = probe.pad_to(probe.capacity + (-probe.capacity) % n)
         build, probe = comm.device_put_sharded((build, probe))
         jax.block_until_ready((build, probe))
-        step = make_join_step(
-            comm,
+        join_opts = dict(
             key="key",
             over_decomposition=args.over_decomposition_factor,
             shuffle_capacity_factor=args.shuffle_capacity_factor,
             out_capacity_factor=args.out_capacity_factor,
         )
+        step = make_join_step(comm, **join_opts)
         sec, matches, overflow = timed_join_throughput(
             comm, step, build, probe, args.iterations,
         )
+        # --telemetry: device counters from one untimed single-step
+        # program (see benchmarks.collect_join_metrics); the timed
+        # loop above stays the seed program.
+        collect_join_metrics(comm, build, probe, join_opts)
 
     # Valid-row counts (post-filter), same semantics as the host path.
     return _report(args, comm, int(orders.num_valid()),

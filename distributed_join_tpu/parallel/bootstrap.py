@@ -212,6 +212,19 @@ def initialize(
     do_connect = connect if connect is not None else _connect
     t0 = time.monotonic()
 
+    def _on_retry(attempt, exc, delay):
+        # Telemetry is a no-op without a session; with one, the
+        # handshake's backoff trail streams into the event log as it
+        # happens (docs/OBSERVABILITY.md) — the same per-attempt data
+        # BootstrapError.record() carries on exhaustion.
+        from distributed_join_tpu import telemetry
+
+        telemetry.event(
+            "bootstrap_retry", attempt=attempt,
+            coordinator=coordinator_address, backoff_s=delay,
+            error=f"{type(exc).__name__}: {exc}",
+        )
+
     def _bounded_connect():
         # retry_with_backoff's deadline check only runs BETWEEN
         # attempts; a hung endpoint (TCP accepted, handshake never
@@ -230,6 +243,8 @@ def initialize(
             remaining, what="handshake",
         )
 
+    from distributed_join_tpu import telemetry
+
     try:
         _, attempts = retry_with_backoff(
             _bounded_connect,
@@ -237,7 +252,11 @@ def initialize(
             backoff_s=backoff_s,
             deadline_s=deadline_s,
             sleep=sleep,
+            on_retry=_on_retry,
         )
+        telemetry.event("bootstrap_ok",
+                        coordinator=coordinator_address,
+                        process_id=process_id, attempts=len(attempts))
     except BootstrapError as exc:
         # Every connect outcome — hang or error — reaches here wrapped
         # by call_with_deadline; fill in the handshake identity, the
@@ -246,6 +265,7 @@ def initialize(
         exc.coordinator = exc.coordinator or coordinator_address
         exc.deadline_s = deadline_s
         exc.attempts = getattr(exc, "_retry_attempts", None) or exc.attempts
+        telemetry.event("bootstrap_failed", **exc.record())
         raise
 
 

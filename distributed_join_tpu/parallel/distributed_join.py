@@ -36,6 +36,7 @@ from typing import Optional, Sequence
 
 import jax.numpy as jnp
 
+from distributed_join_tpu import telemetry
 from distributed_join_tpu.ops.join import JoinResult, sort_merge_inner_join
 from distributed_join_tpu.ops.partition import radix_hash_partition
 from distributed_join_tpu.parallel.communicator import Communicator
@@ -51,6 +52,13 @@ DEFAULT_SHUFFLE_CAPACITY_FACTOR = 1.6
 DEFAULT_OUT_CAPACITY_FACTOR = 1.2
 DEFAULT_HH_SLOTS = 64
 HH_BUILD_SLOTS_PER_HH = 32  # default hh_build_capacity = slots * this
+
+# The one sharded_out spec for a JoinResult: table row-sharded, the
+# psummed total/overflow replicated.
+JOIN_SHARDED_OUT = JoinResult(table=False, total=True, overflow=True)
+# The metrics-emitting step returns (JoinResult, Metrics); the Metrics
+# block is replicated by construction (one in-program all_gather).
+JOIN_METRICS_SHARDED_OUT = (JOIN_SHARDED_OUT, True)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -75,7 +83,7 @@ def _varwidth_cols(table: Table) -> list:
 def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
                    mode: str = "padded",
                    compression_bits: Optional[int] = None,
-                   varwidth=None):
+                   varwidth=None, tape=None):
     if mode == "ragged":
         # Exact-size exchange: receive buffer = the same total rows the
         # padded layout would flatten to, but wire bytes = actual rows.
@@ -83,7 +91,7 @@ def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
         # mode: auto_retry fires under identical conditions.
         return shuffle_ragged(
             comm, pt, n_ranks * capacity, bucket_start=batch * n_ranks,
-            capacity_per_bucket=capacity, varwidth=varwidth,
+            capacity_per_bucket=capacity, varwidth=varwidth, tape=tape,
         )
     padded, counts, overflow, _ = pt.to_padded(
         capacity, bucket_start=batch * n_ranks, n_buckets=n_ranks
@@ -92,10 +100,11 @@ def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
     if compression_bits is not None:
         table, _, c_ovf = shuffle_padded_compressed(
             comm, padded, counts, capacity, bits=compression_bits,
-            via=via,
+            via=via, tape=tape,
         )
         return table, overflow | c_ovf
-    table, _ = shuffle_padded(comm, padded, counts, capacity, via=via)
+    table, _ = shuffle_padded(comm, padded, counts, capacity, via=via,
+                              tape=tape)
     return table, overflow
 
 
@@ -116,6 +125,8 @@ def make_join_step(
     shuffle: str = "padded",
     compression_bits: Optional[int] = None,
     kernel_config=None,
+    with_metrics: bool = False,
+    metrics_static: Optional[dict] = None,
 ):
     """The raw per-rank join step (partition -> shuffle -> local join).
 
@@ -175,6 +186,21 @@ def make_join_step(
     probe rows in the top keys) overflows and is caught by the flag /
     ``auto_retry`` doubling; size them explicitly for known-heavy
     workloads.
+
+    Telemetry (docs/OBSERVABILITY.md): ``with_metrics=True`` makes the
+    step return ``(JoinResult, telemetry.Metrics)`` — device-side
+    counters (rows partitioned/shuffled, wire bytes, per-bucket
+    overflow margin, match count) accumulated on a
+    :class:`~..telemetry.metrics.MetricsTape` and cross-rank gathered
+    once at step end; ``metrics_static`` merges caller-known constants
+    (e.g. the retry attempt index) into the same vector. The default
+    (``False``) compiles the exact seed program — no aux output, no
+    extra collective (tests/test_telemetry.py locks the treedef and
+    program count). Stage spans (`partition`/`shuffle`/`join`) are
+    emitted whenever a telemetry session is active; inside this traced
+    step they time TRACING and carry the pipeline structure into the
+    Chrome trace, while their ``jax.named_scope`` lines the same names
+    up against real device timings in an XLA profile.
     """
     n = comm.n_ranks
     k = over_decomposition
@@ -195,7 +221,11 @@ def make_join_step(
 
     keys = [key] if isinstance(key, str) else list(key)
 
-    def step(build_local: Table, probe_local: Table) -> JoinResult:
+    def step(build_local: Table, probe_local: Table):
+        tape = telemetry.MetricsTape() if with_metrics else None
+        if tape is not None:
+            for mname, mval in (metrics_static or {}).items():
+                tape.add(mname, int(mval))
         for kname in keys:
             bdt = build_local.columns[kname].dtype
             pdt = probe_local.columns[kname].dtype
@@ -243,56 +273,63 @@ def make_join_step(
             from distributed_join_tpu.ops.hashing import hash_columns
             from distributed_join_tpu.parallel import skew
 
-            # Detect/mark heavy hitters on the uint64 key-tuple hash:
-            # classification only needs to be CONSISTENT across sides
-            # and ranks (hash collisions merely over-classify a key as
-            # heavy, which stays correct — the HH join matches on the
-            # real composite key).
-            bh = hash_columns([build_local.columns[k] for k in keys_eff])
-            ph = hash_columns([probe_local.columns[k] for k in keys_eff])
-            hh = skew.global_heavy_hitters(
-                comm,
-                ph,
-                probe_local.valid,
-                hh_slots,
-                threshold=jnp.int32(int(skew_threshold * p_rows)),
-            )
-            is_hh_b = skew.mark_heavy(bh, hh)
-            is_hh_p = skew.mark_heavy(ph, hh)
-            hh_build, ovf_hb = skew.broadcast_heavy_build(
-                comm, build_local, is_hh_b,
-                hh_build_capacity or hh_slots * HH_BUILD_SLOTS_PER_HH,
-                kernel_config=kernel_config,
-            )
-            # HH probe rows stay local, COMPACTED into a right-sized
-            # block first (round-3 VERDICT #2: narrowing validity on
-            # the full-capacity arrays made the HH join re-sort all
-            # p_rows to join a typically-tiny subset — the whole HH
-            # path then cost ~90% of the join even with zero heavy
-            # keys). Overflowing the block raises the flag;
-            # auto_retry doubles it like every other capacity.
-            hh_probe_cap = _round_up(
-                hh_probe_capacity or max(p_rows // 8, 1024), 8
-            )
-            hh_probe, _, ovf_hp = skew.extract_prefix(
-                probe_local, probe_local.valid & is_hh_p, hh_probe_cap,
-                kernel_config=kernel_config,
-            )
-            hh_res = sort_merge_inner_join(
-                hh_build, hh_probe, keys_eff,
-                hh_out_capacity or max(p_rows // 4, 1024),
-                build_payload=bpay, probe_payload=ppay,
-                kernel_config=kernel_config,
-                _internal=sk_names,
-            )
-            parts.append(hh_res.table)
-            total = total + hh_res.total.astype(jnp.int64)
-            overflow = overflow | ovf_hb | ovf_hp | hh_res.overflow
-            # The normal path sees neither side's HH rows.
-            build_local = Table(build_local.columns,
-                                build_local.valid & ~is_hh_b)
-            probe_local = Table(probe_local.columns,
-                                probe_local.valid & ~is_hh_p)
+            with telemetry.span("skew"):
+                # Detect/mark heavy hitters on the uint64 key-tuple
+                # hash: classification only needs to be CONSISTENT
+                # across sides and ranks (hash collisions merely
+                # over-classify a key as heavy, which stays correct —
+                # the HH join matches on the real composite key).
+                bh = hash_columns(
+                    [build_local.columns[k] for k in keys_eff])
+                ph = hash_columns(
+                    [probe_local.columns[k] for k in keys_eff])
+                hh = skew.global_heavy_hitters(
+                    comm,
+                    ph,
+                    probe_local.valid,
+                    hh_slots,
+                    threshold=jnp.int32(int(skew_threshold * p_rows)),
+                )
+                is_hh_b = skew.mark_heavy(bh, hh)
+                is_hh_p = skew.mark_heavy(ph, hh)
+                hh_build, ovf_hb = skew.broadcast_heavy_build(
+                    comm, build_local, is_hh_b,
+                    hh_build_capacity or hh_slots * HH_BUILD_SLOTS_PER_HH,
+                    kernel_config=kernel_config,
+                )
+                # HH probe rows stay local, COMPACTED into a
+                # right-sized block first (round-3 VERDICT #2:
+                # narrowing validity on the full-capacity arrays made
+                # the HH join re-sort all p_rows to join a
+                # typically-tiny subset — the whole HH path then cost
+                # ~90% of the join even with zero heavy keys).
+                # Overflowing the block raises the flag; auto_retry
+                # doubles it like every other capacity.
+                hh_probe_cap = _round_up(
+                    hh_probe_capacity or max(p_rows // 8, 1024), 8
+                )
+                hh_probe, _, ovf_hp = skew.extract_prefix(
+                    probe_local, probe_local.valid & is_hh_p,
+                    hh_probe_cap, kernel_config=kernel_config,
+                )
+                hh_res = sort_merge_inner_join(
+                    hh_build, hh_probe, keys_eff,
+                    hh_out_capacity or max(p_rows // 4, 1024),
+                    build_payload=bpay, probe_payload=ppay,
+                    kernel_config=kernel_config,
+                    _internal=sk_names,
+                )
+                parts.append(hh_res.table)
+                total = total + hh_res.total.astype(jnp.int64)
+                overflow = overflow | ovf_hb | ovf_hp | hh_res.overflow
+                if tape is not None:
+                    tape.add("skew.hh_matches",
+                             hh_res.total.astype(jnp.int64))
+                # The normal path sees neither side's HH rows.
+                build_local = Table(build_local.columns,
+                                    build_local.valid & ~is_hh_b)
+                probe_local = Table(probe_local.columns,
+                                    probe_local.valid & ~is_hh_p)
 
         if nb == 1:
             # Single rank, single batch: the partition is one all-rows
@@ -300,12 +337,13 @@ def make_join_step(
             # permutations. Skip them entirely (the join handles masked
             # validity natively); this is the reference's 1-rank path,
             # which also partitions into nranks=1 buckets and joins.
-            res = sort_merge_inner_join(
-                build_local, probe_local, keys_eff, out_cap,
-                build_payload=bpay, probe_payload=ppay,
-                kernel_config=kernel_config,
-                _internal=sk_names,
-            )
+            with telemetry.span("join"):
+                res = sort_merge_inner_join(
+                    build_local, probe_local, keys_eff, out_cap,
+                    build_payload=bpay, probe_payload=ppay,
+                    kernel_config=kernel_config,
+                    _internal=sk_names,
+                )
             parts.append(res.table)
             total = total + res.total.astype(jnp.int64)
             overflow = overflow | res.overflow
@@ -319,25 +357,43 @@ def make_join_step(
                 else []
             vp = _varwidth_cols(probe_local) if shuffle == "ragged" \
                 else []
-            ptb = radix_hash_partition(
-                build_local, keys_eff, nb,
-                order_within=vb[0] + "#len" if vb else None)
-            ptp = radix_hash_partition(
-                probe_local, keys_eff, nb,
-                order_within=vp[0] + "#len" if vp else None)
+            with telemetry.span("partition"):
+                ptb = radix_hash_partition(
+                    build_local, keys_eff, nb,
+                    order_within=vb[0] + "#len" if vb else None)
+                ptp = radix_hash_partition(
+                    probe_local, keys_eff, nb,
+                    order_within=vp[0] + "#len" if vp else None)
+            tb = tape.scoped("build") if tape is not None else None
+            tp = tape.scoped("probe") if tape is not None else None
+            if tape is not None:
+                for t, pt, cap in ((tb, ptb, b_cap), (tp, ptp, p_cap)):
+                    t.add("rows_partitioned",
+                          jnp.sum(pt.counts.astype(jnp.int64)))
+                    # Tightest per-(sender, destination)-bucket
+                    # headroom under the shuffle capacity contract —
+                    # how close this sizing came to an overflow.
+                    t.record_min(
+                        "overflow_margin_min",
+                        jnp.int64(cap)
+                        - jnp.max(pt.counts).astype(jnp.int64))
             for b in range(k):
-                recv_build, ovf_b = _batch_shuffle(
-                    comm, ptb, b, n, b_cap, mode=shuffle,
-                    compression_bits=compression_bits, varwidth=vb)
-                recv_probe, ovf_p = _batch_shuffle(
-                    comm, ptp, b, n, p_cap, mode=shuffle,
-                    compression_bits=compression_bits, varwidth=vp)
-                res = sort_merge_inner_join(
-                    recv_build, recv_probe, keys_eff, out_cap,
-                    build_payload=bpay, probe_payload=ppay,
-                    kernel_config=kernel_config,
-                    _internal=sk_names,
-                )
+                with telemetry.span("shuffle", batch=b):
+                    recv_build, ovf_b = _batch_shuffle(
+                        comm, ptb, b, n, b_cap, mode=shuffle,
+                        compression_bits=compression_bits, varwidth=vb,
+                        tape=tb)
+                    recv_probe, ovf_p = _batch_shuffle(
+                        comm, ptp, b, n, p_cap, mode=shuffle,
+                        compression_bits=compression_bits, varwidth=vp,
+                        tape=tp)
+                with telemetry.span("join", batch=b):
+                    res = sort_merge_inner_join(
+                        recv_build, recv_probe, keys_eff, out_cap,
+                        build_payload=bpay, probe_payload=ppay,
+                        kernel_config=kernel_config,
+                        _internal=sk_names,
+                    )
                 parts.append(res.table)
                 total = total + res.total.astype(jnp.int64)
                 overflow = overflow | ovf_b | ovf_p | res.overflow
@@ -354,24 +410,49 @@ def make_join_step(
             )
 
             out = rebuild_string_keys(out, str_spec, keys)
+        if tape is not None:
+            # Local (pre-psum) match count: the gathered per-rank
+            # vector sums to the global total, giving per-rank match
+            # distribution for free.
+            tape.add("matches", total)
+            metrics = tape.gathered(comm)
         total = comm.psum(total)
         overflow = comm.psum(overflow.astype(jnp.int32)) > 0
-        return JoinResult(out, total=total, overflow=overflow)
+        result = JoinResult(out, total=total, overflow=overflow)
+        return (result, metrics) if with_metrics else result
 
     return step
 
 
-def make_distributed_join(comm: Communicator, **opts):
+def make_distributed_join(comm: Communicator, with_metrics=None, **opts):
     """Compile a distributed inner join over ``comm``'s ranks.
 
     Returns a jitted ``fn(build: Table, probe: Table) -> JoinResult``
     taking row-sharded global Tables (capacity divisible by n_ranks) and
     returning a row-sharded result Table plus a replicated global match
     count and overflow flag. See :func:`make_join_step` for options.
+
+    ``with_metrics=None`` (default) resolves from the global telemetry
+    state: with a session active the compiled program additionally
+    emits the device-metrics block and the result carries it as a
+    host-side ``res.telemetry`` attribute (a ``telemetry.Metrics``;
+    like ``retry_report``, not a pytree field — the call signature and
+    the JoinResult pytree are unchanged either way). With telemetry
+    off this is exactly the seed program.
     """
-    step = make_join_step(comm, **opts)
-    sharded_out = JoinResult(table=False, total=True, overflow=True)
-    return comm.spmd(step, sharded_out=sharded_out)
+    if with_metrics is None:
+        with_metrics = telemetry.enabled()
+    step = make_join_step(comm, with_metrics=with_metrics, **opts)
+    if not with_metrics:
+        return comm.spmd(step, sharded_out=JOIN_SHARDED_OUT)
+    compiled = comm.spmd(step, sharded_out=JOIN_METRICS_SHARDED_OUT)
+
+    def fn(build: Table, probe: Table) -> JoinResult:
+        res, metrics = compiled(build, probe)
+        object.__setattr__(res, "telemetry", metrics)
+        return res
+
+    return fn
 
 
 def distributed_inner_join(
@@ -442,8 +523,10 @@ def distributed_inner_join(
         local_probe_rows=probe.capacity // n,
     )
     for attempt in range(auto_retry + 1):
-        fn = make_distributed_join(comm, key=key, **ladder.sizing(),
-                                   **opts)
+        fn = make_distributed_join(comm, key=key,
+                                   metrics_static={
+                                       "retry_attempt_max": attempt},
+                                   **ladder.sizing(), **opts)
         if faults.plan_validation_enabled():
             # The violation record is process-global; drop leftovers
             # from earlier unchecked programs so what check() raises
@@ -462,6 +545,10 @@ def distributed_inner_join(
             # JoinResult traces through shard_map, and the report only
             # exists outside the compiled program.
             object.__setattr__(res, "retry_report", ladder.report())
+            # Fold the device metrics of the FINAL attempt into the
+            # telemetry session (one host fetch, after the retry loop
+            # settled — the flag fetch above already synced).
+            telemetry.emit_metrics(getattr(res, "telemetry", None))
             return res
         ladder.escalate()
     raise AssertionError("unreachable")

@@ -33,6 +33,12 @@ merely documents. This module makes them *exercisable* and *recoverable*:
   makes ``out_of_core.batched_join_host`` resumable: a killed SF-100
   run restarts from the first incomplete batch and reproduces the
   uninterrupted total bit-exactly.
+
+When a telemetry session is active (docs/OBSERVABILITY.md), ladder
+attempts and manifest writes also stream into the session's event log
+(``retry_attempt`` / ``manifest_batch`` / ``manifest_failure``), so
+the retry trail survives even a run killed before its RetryReport
+could be assembled.
 """
 
 from __future__ import annotations
@@ -181,10 +187,20 @@ class FaultInjectingCommunicator(Communicator):
 
         def wrapped(*args):
             out = fn(*args)
-            if inject_overflow and isinstance(out, JoinResult):
-                out = dataclasses.replace(
-                    out, overflow=out.overflow | jnp.bool_(True)
-                )
+            if inject_overflow:
+                if isinstance(out, JoinResult):
+                    out = dataclasses.replace(
+                        out, overflow=out.overflow | jnp.bool_(True)
+                    )
+                elif (isinstance(out, tuple) and out
+                      and isinstance(out[0], JoinResult)):
+                    # The telemetry-instrumented step returns
+                    # (JoinResult, Metrics); the squeeze must look the
+                    # same to the ladder either way.
+                    out = (dataclasses.replace(
+                        out[0],
+                        overflow=out[0].overflow | jnp.bool_(True),
+                    ),) + out[1:]
             return out
 
         compiled = self._inner.spmd(wrapped, sharded_out=sharded_out)
@@ -543,8 +559,11 @@ class CapacityLadder:
         )
 
     def note(self, overflow: Optional[bool]) -> None:
-        """Record the outcome of running the current rung."""
-        self._attempts.append(RetryAttempt(
+        """Record the outcome of running the current rung. The attempt
+        also lands in the telemetry event log (the RetryReport's
+        per-attempt record, streamed as it happens — a killed run
+        keeps the trail its report would have carried)."""
+        att = RetryAttempt(
             attempt=len(self._attempts),
             action=self._action,
             overflow=overflow,
@@ -555,7 +574,11 @@ class CapacityLadder:
             hh_build_capacity=self.hh_build,
             hh_probe_capacity=self.hh_probe,
             hh_out_capacity=self.hh_out,
-        ))
+        )
+        self._attempts.append(att)
+        from distributed_join_tpu import telemetry
+
+        telemetry.event("retry_attempt", **att.as_record())
 
     def escalate(self) -> str:
         """Advance one rung; returns the action taken."""
@@ -652,6 +675,11 @@ class JoinManifest:
             "total": int(total), "overflow": bool(overflow),
         }
         self._write()
+        from distributed_join_tpu import telemetry
+
+        telemetry.event("manifest_batch", path=self.path,
+                        batch=int(batch), total=int(total),
+                        overflow=bool(overflow))
 
     def record_failure(self, batch: int, error: str,
                        attempt: int) -> None:
@@ -660,6 +688,11 @@ class JoinManifest:
                     "error": error})
         del log[:-self.MAX_FAILURES]
         self._write()
+        from distributed_join_tpu import telemetry
+
+        telemetry.event("manifest_failure", path=self.path,
+                        batch=int(batch), attempt=int(attempt),
+                        error=error)
 
     def _write(self) -> None:
         tmp = self.path + ".tmp"

@@ -26,6 +26,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from distributed_join_tpu import telemetry
 from distributed_join_tpu.parallel.communicator import Communicator
 from distributed_join_tpu.table import Table
 
@@ -275,23 +276,37 @@ def batched_join_host(
     # fetch_wait_s: time the MAIN loop blocked on a fetch — the
     # UNHIDDEN remainder, the number that shows whether the overlap
     # worked. Only the fetch worker writes fetch_s; only the main
-    # thread writes the others — no lock needed.
+    # thread writes the others — no lock needed. The same increments
+    # flow into the telemetry session (``out_of_core.<key>`` counters
+    # + per-batch spans, docs/OBSERVABILITY.md) with the JSON keys
+    # preserved verbatim — `stats` consumers never notice telemetry.
     phase = {"pad_s": 0.0, "put_s": 0.0, "dispatch_s": 0.0,
              "fetch_s": 0.0, "fetch_wait_s": 0.0}
 
+    def _phase_add(key, dt):
+        phase[key] += dt
+        telemetry.counter_add("out_of_core." + key, dt)
+
     def stage(b):
-        t0 = time.perf_counter()
-        bt = _pad_host(build_batches[b], bcap)
-        pt = _pad_host(probe_batches[b], pcap)
-        t1 = time.perf_counter()
-        out = comm.device_put_sharded((bt, pt))
-        phase["pad_s"] += t1 - t0
-        phase["put_s"] += time.perf_counter() - t1
+        with telemetry.span("stage", batch=b):
+            t0 = time.perf_counter()
+            bt = _pad_host(build_batches[b], bcap)
+            pt = _pad_host(probe_batches[b], pcap)
+            t1 = time.perf_counter()
+            out = comm.device_put_sharded((bt, pt))
+            _phase_add("pad_s", t1 - t0)
+            _phase_add("put_s", time.perf_counter() - t1)
         return out
 
     from concurrent.futures import ThreadPoolExecutor
 
-    fn = make_distributed_join(comm, key=key, **join_opts)
+    # with_metrics=False: the per-batch dispatch loop stays the seed
+    # program even under an active telemetry session — out-of-core
+    # observability is host-side by design (phase counters, per-batch
+    # spans/events above), and an aux device block nobody fetches
+    # would still be computed every batch.
+    fn = make_distributed_join(comm, key=key, with_metrics=False,
+                               **join_opts)
     pool = ThreadPoolExecutor(max_workers=1)
     fetch_pool = ThreadPoolExecutor(max_workers=1)
 
@@ -300,9 +315,10 @@ def batched_join_host(
         # consumer's D2H pulls overlap the NEXT batch's device compute
         # — mirror image of the staging thread. numpy materialization
         # and the transfer both release the GIL.
-        tf = time.perf_counter()
-        on_batch_result(b, res)
-        phase["fetch_s"] += time.perf_counter() - tf
+        with telemetry.span("fetch", batch=b):
+            tf = time.perf_counter()
+            on_batch_result(b, res)
+            _phase_add("fetch_s", time.perf_counter() - tf)
 
     # Per-batch remaining FAILED-attempt budget: one pool of
     # batch_retries + 1, shared between the warmup dispatch and the
@@ -369,7 +385,11 @@ def batched_join_host(
                 raise
             totals[i], overflows[i] = None, None
             failed.add(b)
+            telemetry.event("batch_failed", batch=b,
+                            error=f"{type(exc).__name__}: {exc}")
             return
+        telemetry.event("batch_complete", batch=b, total=totals[i],
+                        overflow=overflows[i])
         if manifest is not None:
             manifest.record_batch(b, totals[i], overflows[i])
 
@@ -404,9 +424,14 @@ def batched_join_host(
     # Warmup staged the first pending batch before t0: reset the phase
     # counters so the breakdown covers exactly the [t0, end) window it
     # is reported against (otherwise pad_s/put_s over-count by one
-    # batch).
+    # batch). The telemetry counters are NOT reset — a session covers
+    # the whole run, warmup included; the event below marks where the
+    # measured window begins so the two accountings reconcile.
     for k_ in phase:
         phase[k_] = 0.0
+    telemetry.event("out_of_core_measured_window",
+                    n_batches=n_batches, pending=len(pending),
+                    resumed=sorted(completed))
     t0 = time.perf_counter()
     fut = None
     if pending:
@@ -421,7 +446,7 @@ def batched_join_host(
             bt, pt = fut.result()
             td = time.perf_counter()
             res = _dispatch(b, bt, pt)
-            phase["dispatch_s"] += time.perf_counter() - td
+            _phase_add("dispatch_s", time.perf_counter() - td)
             if res is not None:
                 # A batch marked failed at the warmup FETCH (dispatch
                 # succeeded, async failure at the scalar sync) that
@@ -458,7 +483,8 @@ def batched_join_host(
                     # manifest record rides the same sync point, so
                     # durability costs no extra synchronization.
                     _settle(i - 1)
-                    phase["fetch_wait_s"] += time.perf_counter() - tf
+                    _phase_add("fetch_wait_s",
+                               time.perf_counter() - tf)
         tf = time.perf_counter()
         for f in fetch_futs:
             if f is not None:
@@ -467,7 +493,7 @@ def batched_join_host(
             _settle(i)
         total = sum(t for t in totals if t is not None)
         overflow = any(bool(o) for o in overflows if o is not None)
-        phase["fetch_wait_s"] += time.perf_counter() - tf
+        _phase_add("fetch_wait_s", time.perf_counter() - tf)
     finally:
         # Also on error: an orphaned worker would hang the interpreter
         # at exit via ThreadPoolExecutor's atexit join.

@@ -45,7 +45,7 @@ from distributed_join_tpu.table import Table
 
 def shuffle_padded(
     comm: Communicator, padded_columns, counts: jax.Array, capacity: int,
-    via: str = "all_to_all",
+    via: str = "all_to_all", tape=None,
 ) -> Tuple[Table, jax.Array]:
     """Shuffle a pre-padded (n_ranks, capacity) block; returns the
     received rows as a masked Table plus the received counts.
@@ -53,18 +53,34 @@ def shuffle_padded(
     ``via='ppermute'`` moves the data blocks over a chain of
     collective-permutes instead of one grouped all-to-all — same
     bytes and result, but an async-schedulable lowering (see
-    Communicator.ppermute_all_to_all / docs/OVERLAP.md)."""
+    Communicator.ppermute_all_to_all / docs/OVERLAP.md).
+
+    ``tape`` (a ``telemetry.MetricsTape`` view, or None) receives the
+    wire accounting: ``rows_shuffled``/``rows_received`` are ACTUAL
+    rows (the count vectors), ``wire_bytes`` the data-plane bytes the
+    collective moves — for this padded layout the full static
+    ``n_ranks x capacity`` block per column, pad included, because
+    that IS what rides the wire (the ~1/load-factor inflation the
+    module docstring describes, now measurable per run). Metadata
+    (the count exchange) is not billed; see docs/OBSERVABILITY.md."""
     a2a = (
         comm.ppermute_all_to_all if via == "ppermute" else comm.all_to_all
     )
     recv_counts = comm.all_to_all(counts)
     recv_cols = {n: a2a(c) for n, c in padded_columns.items()}
+    if tape is not None:
+        tape.add("rows_shuffled", jnp.sum(counts.astype(jnp.int64)))
+        tape.add("rows_received",
+                 jnp.sum(recv_counts.astype(jnp.int64)))
+        tape.add("wire_bytes",
+                 sum(c.size * c.dtype.itemsize
+                     for c in padded_columns.values()))
     return unpad(recv_cols, recv_counts, capacity), recv_counts
 
 
 def shuffle_padded_compressed(
     comm: Communicator, padded_columns, counts: jax.Array, capacity: int,
-    bits: int, block: int = 256, via: str = "all_to_all",
+    bits: int, block: int = 256, via: str = "all_to_all", tape=None,
 ) -> Tuple[Table, jax.Array, jax.Array]:
     """Padded shuffle with the FoR+bitpack codec on the wire.
 
@@ -104,6 +120,12 @@ def shuffle_padded_compressed(
     row_valid = lane[None, :] < counts[:, None]
     c_ovf = jnp.bool_(False)
     recv_cols = {}
+    # Wire accounting (static — every buffer here is capacity-shaped):
+    # raw_bytes is what the UNcompressed padded shuffle would move,
+    # sent_bytes what actually rides; the difference is the codec's
+    # saving at this bits width (negative = expansion, reportable).
+    raw_bytes = 0
+    sent_bytes = 0
     for name, col in padded_columns.items():
         compressible = (
             col.ndim == 2
@@ -115,8 +137,10 @@ def shuffle_padded_compressed(
             # raw by construction.
             and not name.startswith(_WORD_PREFIX)
         )
+        raw_bytes += col.size * col.dtype.itemsize
         if not compressible:
             # uint8 string payload planes etc. ride raw.
+            sent_bytes += col.size * col.dtype.itemsize
             recv_cols[name] = a2a(col)
             continue
 
@@ -135,6 +159,8 @@ def shuffle_padded_compressed(
 
         words, frames, ovf = jax.vmap(_enc)(col)
         c_ovf = c_ovf | jnp.any(ovf)
+        sent_bytes += (words.size * words.dtype.itemsize
+                       + frames.size * frames.dtype.itemsize)
         rwords, rframes = a2a(words), a2a(frames)
 
         def _dec(w, f, dt=col.dtype):
@@ -145,6 +171,12 @@ def shuffle_padded_compressed(
             )
 
         recv_cols[name] = jax.vmap(_dec)(rwords, rframes)
+    if tape is not None:
+        tape.add("rows_shuffled", jnp.sum(counts.astype(jnp.int64)))
+        tape.add("rows_received",
+                 jnp.sum(recv_counts.astype(jnp.int64)))
+        tape.add("wire_bytes", sent_bytes)
+        tape.add("wire_bytes_saved", raw_bytes - sent_bytes)
     return unpad(recv_cols, recv_counts, capacity), recv_counts, c_ovf
 
 
@@ -214,6 +246,7 @@ def shuffle_ragged(
     bucket_start: int = 0,
     capacity_per_bucket: int | None = None,
     varwidth=None,
+    tape=None,
 ) -> Tuple[Table, jax.Array]:
     """Exact-size shuffle of ``n_ranks`` buckets starting at
     ``bucket_start``: wire bytes = actual rows, not padded capacity.
@@ -257,6 +290,15 @@ def shuffle_ragged(
     Debug mode (``faults.validate_plans()`` / ``DJTPU_VALIDATE_PLANS``
     at trace time): the transfer plan is cross-rank validated before
     the exchange — see :func:`..faults.validate_ragged_plan`.
+
+    ``tape`` (``telemetry.MetricsTape`` view, or None): wire
+    accounting from the PLAN vectors — ``rows_shuffled`` =
+    sum(send_sizes) (the clamped plan totals, i.e. rows actually
+    transferred), ``rows_received`` = total_recv, ``wire_bytes`` =
+    fixed-width row bytes x rows sent plus the varwidth columns'
+    exact u32-plane prefix bytes, with the bytes the byte-exact wire
+    avoided (vs shipping those columns fixed-width for the same rows)
+    in ``wire_bytes_saved``.
     """
     n = comm.n_ranks
     vw = ((varwidth,) if isinstance(varwidth, str)
@@ -278,6 +320,15 @@ def shuffle_ragged(
             comm, send_sizes, recv_sizes, output_offsets, out_capacity,
         )
         overflow = overflow | comm.pvary(tok > 0)
+    if tape is not None:
+        rows_sent = jnp.sum(send_sizes.astype(jnp.int64))
+        row_bytes = sum(
+            int(c.size // c.shape[0]) * c.dtype.itemsize
+            for name, c in pt.source.columns.items() if name not in vw
+        )
+        tape.add("rows_shuffled", rows_sent)
+        tape.add("rows_received", total_recv.astype(jnp.int64))
+        tape.add("wire_bytes", rows_sent * row_bytes)
     # One gather per column materializes the bucket-sorted layout the
     # input offsets point into (no padding, unlike to_padded). The
     # varwidth columns go LAST: the extra ones need their received
@@ -299,12 +350,13 @@ def shuffle_ragged(
                 comm, sorted_table.columns[name],
                 sorted_table.columns[name + "#len"],
                 offsets, counts, start, allowed, out_capacity,
+                tape=tape,
             )
             continue
         col_s, lens_s = sorted_vw[name]
         raw = _varwidth_exchange(
             comm, col_s, lens_s, offsets, counts, start,
-            allowed, out_capacity,
+            allowed, out_capacity, tape=tape,
         )
         unsorted = _receiver_unsort(
             comm, raw, out_cols[name + "#len"], start, total_recv
@@ -422,7 +474,7 @@ def _receiver_unsort(comm, raw, recv_lens, start, total_recv):
 
 
 def _varwidth_exchange(comm, col, lens, offsets, counts, start, allowed,
-                       out_capacity: int):
+                       out_capacity: int, tape=None):
     """Byte-exact exchange of one bucket-sorted (rows, L) uint8 column
     whose buckets are ordered by ``lens`` descending. Plane ``w`` of
     the u32 view is alive for exactly the first
@@ -455,6 +507,15 @@ def _varwidth_exchange(comm, col, lens, offsets, counts, start, allowed,
     # consistent with the row exchange.
     gk = comm.all_gather(k).reshape(n, n, W)
     k_allowed = jnp.minimum(gk, allowed[:, :, None])
+    if tape is not None:
+        # Exact prefix bytes on the wire for this column vs shipping
+        # the same (clamped) rows at fixed width — the byte-exact
+        # wire's whole point, now a counter.
+        exact = 4 * jnp.sum(k_allowed[me].astype(jnp.int64))
+        fixed = jnp.sum(allowed[me].astype(jnp.int64)) * L
+        tape.add("wire_bytes", exact)
+        tape.add("varwidth_bytes", exact)
+        tape.add("wire_bytes_saved", fixed - exact)
     out_planes = []
     for w in range(W):
         out = jnp.zeros((out_capacity,), jnp.uint32)

@@ -1,0 +1,204 @@
+"""Telemetry — one observability layer for the whole join pipeline.
+
+The reference paper's analysis lives and dies on per-stage accounting
+(partition vs. all-to-all vs. local join, wire bytes vs. the ICI
+roofline — SURVEY.md §5 "Tracing", docs/ROOFLINE.md). Before this
+subsystem the repo's instrumentation was fragmented: ``out_of_core.py``
+kept a hand-rolled phase dict, ``utils/benchmarking.py`` its own timer,
+each driver assembled its own JSON, and the failure-semantics layer's
+``RetryReport``/``JoinManifest``/``BootstrapError`` were three more
+disjoint sinks. Everything now flows through ONE process-global
+session with three parts (docs/OBSERVABILITY.md is the contract):
+
+- :mod:`.spans` — hierarchical host-side span timer with honest sync
+  semantics (fetch ONE scalar at span close, per ``benchmarking.py``'s
+  protocol; never bare ``block_until_ready``), emitting both a sink
+  event and a ``jax.profiler.TraceAnnotation``/``jax.named_scope`` so
+  spans line up inside XLA device profiles;
+- :mod:`.metrics` — device-side counters that travel as an auxiliary
+  ``Metrics`` pytree OUTPUT of the compiled SPMD join step (no host
+  callbacks inside jit), cross-rank aggregated with one
+  ``Communicator.all_gather`` of the summary vector at step end;
+- :mod:`.export` — the :class:`~.export.TelemetrySink`: JSONL event
+  log + Chrome-trace (Perfetto-loadable) file per rank, rank-0 merged
+  summary.
+
+The hard contract: **telemetry OFF is the exact seed hot path** — no
+extra aux outputs, no recompilation, zero overhead. Every function in
+this module is a no-op (and :func:`span` a shared nullcontext) until
+:func:`configure` activates a session; ``make_join_step`` only emits
+the aux ``Metrics`` output when explicitly asked
+(``with_metrics=True``) or when a session is active at build time via
+``make_distributed_join``'s ``with_metrics=None`` resolution.
+Tested by ``tests/test_telemetry.py`` (treedef/program-count parity
+with the seed plus counter-vs-pandas-oracle checks).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from distributed_join_tpu.telemetry.export import TelemetrySink
+from distributed_join_tpu.telemetry.metrics import Metrics, MetricsTape
+from distributed_join_tpu.telemetry import spans as _spans
+
+__all__ = [
+    "Metrics", "MetricsTape", "TelemetrySink",
+    "configure", "configure_from_args", "counter_add", "emit_metrics",
+    "enabled", "event", "finalize", "maybe_start_xla_trace", "session",
+    "sink", "span", "span_complete", "summary",
+]
+
+_active: Optional[TelemetrySink] = None
+_null = contextlib.nullcontext()
+
+
+def enabled() -> bool:
+    """Whether a telemetry session is active. Gates EVERYTHING: with no
+    session, spans are a shared nullcontext, events/counters no-ops,
+    and the join step compiles the exact seed program."""
+    return _active is not None
+
+
+def sink() -> Optional[TelemetrySink]:
+    return _active
+
+
+def configure(out_dir: str, *, trace: bool = False,
+              rank: Optional[int] = None) -> TelemetrySink:
+    """Activate a telemetry session writing under ``out_dir``
+    (events JSONL + Chrome trace per rank, summary on rank 0).
+    ``trace`` additionally arms a full XLA device profile — started
+    lazily by :func:`maybe_start_xla_trace` because
+    ``jax.profiler.start_trace`` initializes the backend, which must
+    not happen before the drivers' ``--platform`` handling / multi-host
+    bootstrap. Reconfiguring finalizes the previous session."""
+    global _active
+    if _active is not None:
+        finalize()
+    if rank is None:
+        # Env-based before backend init (bootstrap.process_id probes
+        # the env fallback without initializing a backend).
+        from distributed_join_tpu.parallel.bootstrap import process_id
+
+        rank = process_id()
+    _active = TelemetrySink(out_dir, rank=rank, xla_trace=trace)
+    return _active
+
+
+def configure_from_args(args) -> bool:
+    """Driver seam: activate from ``--telemetry[=DIR]`` / ``--trace``
+    flags (see ``benchmarks.add_telemetry_args``). ``--trace`` alone
+    implies telemetry at the default directory. Returns whether a
+    session was configured."""
+    out_dir = getattr(args, "telemetry", None)
+    trace = bool(getattr(args, "trace", False))
+    if out_dir is None and trace:
+        out_dir = "telemetry"
+    if out_dir is None:
+        return False
+    configure(out_dir, trace=trace)
+    return True
+
+
+def maybe_start_xla_trace() -> None:
+    """Start the XLA device profile for a ``--trace`` session, once,
+    AFTER platform selection/bootstrap (drivers call this from
+    ``apply_platform``; bench.py after backend init). Safe to call any
+    time: no-op without an armed session."""
+    if _active is not None:
+        _active.maybe_start_xla_trace()
+
+
+def refresh_rank() -> None:
+    """Re-resolve the process rank and rebind the sink's files to it.
+    Sessions are configured before the multi-host handshake, when only
+    the env fallback rank is visible; drivers call this (via
+    ``apply_platform``/bench.py, alongside :func:`maybe_start_xla_trace`)
+    once the runtime is authoritative. No-op without a session or when
+    the rank is unchanged."""
+    if _active is not None:
+        from distributed_join_tpu.parallel.bootstrap import process_id
+
+        _active.rebind_rank(process_id())
+
+
+def finalize() -> Optional[dict]:
+    """Close the session: stop an XLA trace, write the Chrome trace
+    (and rank-0 summary), close the JSONL log. Returns the final
+    summary dict (None when no session was active). Idempotent."""
+    global _active
+    if _active is None:
+        return None
+    s = _active
+    _active = None
+    return s.close()
+
+
+@contextlib.contextmanager
+def session(out_dir: str, *, trace: bool = False, rank: Optional[int] = None):
+    """Scoped session for tests/scripts: ``with telemetry.session(d)
+    as sink: ...`` — configured on entry, finalized on exit."""
+    s = configure(out_dir, trace=trace, rank=rank)
+    try:
+        yield s
+    finally:
+        if _active is s:
+            finalize()
+
+
+def span(name: str, **payload):
+    """Hierarchical span context manager (no-op nullcontext when
+    telemetry is off). The yielded handle supports ``note(**kv)`` and
+    ``sync_on(scalar)`` — the scalar is fetched (ONE value to host) at
+    span close so the span honestly covers device completion; see
+    :mod:`.spans` for the sync-semantics contract."""
+    if _active is None:
+        return _null
+    return _spans.span_scope(_active, name, payload or None)
+
+
+def span_complete(name: str, t0_perf: float, dur_s: float, **payload) -> None:
+    """Record an already-measured interval as a completed span (the
+    ``utils.benchmarking.measure`` seam: the timing definition lives
+    there, the record lands here). ``t0_perf`` is a
+    ``time.perf_counter()`` stamp."""
+    if _active is not None:
+        _active.span_event(name, t0_perf, dur_s, payload=payload or None)
+
+
+def event(name: str, **payload) -> None:
+    """Record an instant event (retry attempts, manifest writes,
+    bootstrap backoff, batch completion...)."""
+    if _active is not None:
+        _active.event(name, payload=payload or None)
+
+
+def counter_add(name: str, value) -> None:
+    """Accumulate a host-side counter (e.g. the out-of-core phase
+    seconds); appears under ``counters`` in the summary."""
+    if _active is not None:
+        _active.counter_add(name, value)
+
+
+def emit_metrics(metrics: Metrics) -> Optional[dict]:
+    """Fetch a device :class:`Metrics` pytree to host (the one
+    deliberate transfer — after the timed region) and fold it into the
+    session summary + event log. Returns the host-side dict."""
+    if metrics is None:
+        return None
+    d = metrics.to_dict()
+    if _active is not None:
+        _active.set_metrics(d)
+        _active.event("metrics", payload={"reduced": d["reduced"]})
+    return d
+
+
+def summary() -> Optional[dict]:
+    """The JSON-shaped session summary drivers embed in their records
+    (``benchmarks.report``): counters, span totals, device metrics,
+    event/file locations. None when telemetry is off."""
+    if _active is None:
+        return None
+    return _active.summary()

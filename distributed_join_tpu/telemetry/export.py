@@ -1,0 +1,270 @@
+"""Telemetry export: JSONL event log + Chrome trace + merged summary.
+
+One :class:`TelemetrySink` per process (rank). Files under the
+session directory:
+
+- ``events.rank<r>.jsonl`` — every event/span as one JSON line,
+  appended and flushed as it happens (a killed run keeps its log);
+- ``trace.rank<r>.json`` — Chrome trace-event format, loadable
+  directly in Perfetto (https://ui.perfetto.dev) or
+  ``chrome://tracing``: spans as ``"ph": "X"`` complete events on a
+  per-(rank, thread) track, instant events as ``"ph": "i"``. Written
+  at close.
+- ``summary.json`` — rank 0 only: the final session summary. Device
+  metrics arrive already cross-rank merged (the in-program
+  ``all_gather`` — every rank holds all ranks' values), so rank 0's
+  summary IS the merged view; no host-side gather needed.
+- ``xla/`` — the XLA device profile when ``--trace`` armed one
+  (open with TensorBoard/XProf; TraceAnnotation names from
+  :mod:`.spans` line up there).
+
+Timestamps are microseconds since the sink's origin (a
+``perf_counter`` stamp taken at construction) — monotonic and shared
+with every span's ``t0``, which is what the Chrome trace format wants.
+Thread-safe: the staging/fetch workers of ``parallel/out_of_core.py``
+log from their own threads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+# Version of the TELEMETRY file formats (JSONL event log, Chrome-trace
+# otherData, summary.json) — deliberately named and keyed differently
+# from benchmarks.SCHEMA_VERSION (the driver/bench JSON record layout)
+# so the two can move independently without silent drift.
+TELEMETRY_FORMAT_VERSION = 1
+# Chrome-trace events are buffered in memory until close; cap the
+# buffer so a pathological event storm degrades to a counted drop
+# instead of unbounded host memory (the JSONL log is unaffected —
+# it streams).
+MAX_TRACE_EVENTS = 200_000
+
+
+def _json_default(o):
+    try:
+        import numpy as np
+
+        if isinstance(o, np.generic):
+            return o.item()
+    except Exception:  # pragma: no cover - numpy always present here
+        pass
+    return str(o)
+
+
+class TelemetrySink:
+    """Collects events/spans/counters and writes the per-rank files.
+    Use via the module-level ``telemetry`` API, not directly."""
+
+    def __init__(self, out_dir: str, rank: int = 0,
+                 xla_trace: bool = False):
+        self.dir = str(out_dir)
+        self.rank = int(rank)
+        os.makedirs(self.dir, exist_ok=True)
+        self._origin = time.perf_counter()
+        self._epoch = time.time()
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._span_stats: dict = {}
+        self._metrics: Optional[dict] = None
+        self._trace_events: list = []
+        self._dropped_trace_events = 0
+        self._n_events = 0
+        self._closed = False
+        self._xla_trace_armed = xla_trace
+        self._xla_trace_started = False
+        self.events_path = os.path.join(
+            self.dir, f"events.rank{self.rank}.jsonl")
+        self.trace_path = os.path.join(
+            self.dir, f"trace.rank{self.rank}.json")
+        self._log = open(self.events_path, "a", buffering=1)
+        self.event("session_start", payload={
+            "rank": self.rank, "epoch_s": self._epoch,
+            "telemetry_format_version": TELEMETRY_FORMAT_VERSION,
+        })
+
+    # -- time base ----------------------------------------------------
+
+    def _us(self, t_perf: Optional[float] = None) -> float:
+        t = time.perf_counter() if t_perf is None else t_perf
+        return (t - self._origin) * 1e6
+
+    # -- recording ----------------------------------------------------
+
+    def _write_line(self, rec: dict) -> None:
+        self._log.write(json.dumps(rec, default=_json_default) + "\n")
+
+    def _push_trace(self, ev: dict) -> None:
+        if len(self._trace_events) < MAX_TRACE_EVENTS:
+            self._trace_events.append(ev)
+        else:
+            self._dropped_trace_events += 1
+
+    def event(self, name: str, payload: Optional[dict] = None) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._n_events += 1
+            self._write_line({"kind": "event", "name": name,
+                              "ts_us": self._us(), "rank": self.rank,
+                              "payload": payload})
+            self._push_trace({
+                "name": name, "cat": "event", "ph": "i", "s": "t",
+                "ts": self._us(), "pid": self.rank,
+                "tid": threading.get_ident() % 2**31,
+                "args": payload or {},
+            })
+
+    def span_event(self, name: str, t0_perf: float, dur_s: float,
+                   path: Optional[str] = None,
+                   payload: Optional[dict] = None) -> None:
+        """A completed span: ``t0_perf`` is the perf_counter start
+        stamp, ``dur_s`` the measured duration (the caller owns the
+        timing definition — spans.span_scope or benchmarking.measure)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._n_events += 1
+            self._write_line({"kind": "span", "name": name,
+                              "path": path or name,
+                              "ts_us": self._us(t0_perf),
+                              "dur_us": dur_s * 1e6, "rank": self.rank,
+                              "payload": payload})
+            self._push_trace({
+                "name": name, "cat": "span", "ph": "X",
+                "ts": self._us(t0_perf), "dur": dur_s * 1e6,
+                "pid": self.rank,
+                "tid": threading.get_ident() % 2**31,
+                "args": dict(payload or {}, path=path or name),
+            })
+            st = self._span_stats.setdefault(
+                path or name, {"count": 0, "total_s": 0.0})
+            st["count"] += 1
+            st["total_s"] += dur_s
+
+    def counter_add(self, name: str, value) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def set_metrics(self, metrics_dict: dict) -> None:
+        """Install the host-fetched device-metrics summary (already
+        cross-rank merged by the in-program all_gather)."""
+        with self._lock:
+            self._metrics = metrics_dict
+
+    def rebind_rank(self, rank: int) -> None:
+        """Adopt the authoritative rank once the distributed runtime
+        is up. The session is configured BEFORE the multi-host
+        handshake (run_guarded runs before apply_platform), when
+        ``bootstrap.process_id()`` can only see the env fallback — on
+        a pod bootstrapped without ``DJTPU_*`` env every host would
+        otherwise write rank-0 files and race on summary.json. Renames
+        the event log to the ranked name and restamps the buffered
+        trace events; the only events recorded pre-bootstrap are
+        session bookkeeping, so the restamp is exact."""
+        rank = int(rank)
+        with self._lock:
+            if rank == self.rank or self._closed:
+                return
+            old_events = self.events_path
+            self.rank = rank
+            self.events_path = os.path.join(
+                self.dir, f"events.rank{rank}.jsonl")
+            self.trace_path = os.path.join(
+                self.dir, f"trace.rank{rank}.json")
+            self._log.close()
+            try:
+                os.replace(old_events, self.events_path)
+            except OSError:
+                # Shared output dir: another process may own the old
+                # name — start the ranked log fresh rather than steal.
+                pass
+            self._log = open(self.events_path, "a", buffering=1)
+            for ev in self._trace_events:
+                ev["pid"] = rank
+
+    # -- XLA device profile -------------------------------------------
+
+    def maybe_start_xla_trace(self) -> None:
+        if not self._xla_trace_armed or self._xla_trace_started:
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(os.path.join(self.dir, "xla"))
+            self._xla_trace_started = True
+        except Exception as exc:  # pragma: no cover - env-dependent
+            import warnings
+
+            warnings.warn(f"could not start XLA trace: {exc}",
+                          stacklevel=2)
+            self._xla_trace_armed = False
+
+    def _stop_xla_trace(self) -> None:
+        if not self._xla_trace_started:
+            return
+        self._xla_trace_started = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as exc:  # pragma: no cover - env-dependent
+            import warnings
+
+            warnings.warn(f"could not stop XLA trace: {exc}",
+                          stacklevel=2)
+
+    # -- summary + close ----------------------------------------------
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "telemetry_format_version": TELEMETRY_FORMAT_VERSION,
+                "rank": self.rank,
+                "dir": self.dir,
+                "events": self._n_events,
+                "events_path": self.events_path,
+                "trace_path": self.trace_path,
+                "counters": dict(self._counters),
+                "spans": {k: dict(v)
+                          for k, v in self._span_stats.items()},
+                "metrics": self._metrics,
+            }
+
+    def close(self) -> dict:
+        """Write the Chrome trace (+ rank-0 summary.json), close the
+        log; returns the final summary. Idempotent."""
+        self._stop_xla_trace()
+        with self._lock:
+            if self._closed:
+                pass
+            else:
+                self._closed = True
+                trace = {
+                    "displayTimeUnit": "ms",
+                    "otherData": {
+                        "rank": self.rank,
+                        "telemetry_format_version": TELEMETRY_FORMAT_VERSION,
+                        "epoch_s": self._epoch,
+                        "dropped_events": self._dropped_trace_events,
+                    },
+                    "traceEvents": self._trace_events,
+                }
+                tmp = self.trace_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(trace, f, default=_json_default)
+                os.replace(tmp, self.trace_path)
+                self._log.close()
+        s = self.summary()
+        if self.rank == 0:
+            tmp = os.path.join(self.dir, "summary.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(s, f, indent=1, default=_json_default)
+            os.replace(tmp, os.path.join(self.dir, "summary.json"))
+        return s

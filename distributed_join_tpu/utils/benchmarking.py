@@ -24,8 +24,31 @@ from __future__ import annotations
 import time
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def measure(fn: Callable, fetch: Callable, iters: int,
+            name: str = "timed") -> float:
+    """THE timing definition — every profile script, driver, and
+    bench.py routes through here: warm up ``fn`` (compiles + runs),
+    then time ONE more call; returns seconds per iteration.
+    ``fetch(result)`` must force completion by pulling at least one
+    scalar to the host (the honest sync under the RPC relay — see the
+    module docstring). The measured interval is recorded as a
+    completed telemetry span (``telemetry.span_complete``, a no-op
+    without an active session) so driver JSON records and Chrome
+    traces share this one definition."""
+    from distributed_join_tpu import telemetry
+
+    fetch(fn())
+    t0 = time.perf_counter()
+    fetch(fn())
+    dt = time.perf_counter() - t0
+    telemetry.span_complete(name, t0, dt, iters=iters,
+                            per_iter_s=dt / iters)
+    return dt / iters
 
 
 def measure_chained(name: str, make_body: Callable, *args,
@@ -33,12 +56,9 @@ def measure_chained(name: str, make_body: Callable, *args,
     """Time one primitive with the chained-loop protocol:
     ``make_body(i, *args) -> scalar`` is run ``iters`` dependent times
     inside a single jitted ``fori_loop`` (the loop counter perturbed by
-    the carry so nothing hoists), compiled+warmed once, then timed with
-    one scalar fetch. Prints and returns seconds per iteration. Used by
-    the scripts/profile_*.py microbenchmarks."""
-    import time as _time
-
-    import jax
+    the carry so nothing hoists), then handed to :func:`measure` (one
+    timing codepath, not two). Prints and returns seconds per
+    iteration. Used by the scripts/profile_*.py microbenchmarks."""
 
     def looped(*args):
         def body(i, acc):
@@ -47,22 +67,9 @@ def measure_chained(name: str, make_body: Callable, *args,
         return lax.fori_loop(0, iters, body, jnp.int64(0))
 
     fn = jax.jit(looped)
-    int(fn(*args))  # compile + warmup
-    t0 = _time.perf_counter()
-    int(fn(*args))
-    dt = (_time.perf_counter() - t0) / iters
+    dt = measure(lambda: fn(*args), lambda r: int(r), iters, name=name)
     print(f"{name:52s} {dt * 1e3:9.1f} ms", flush=True)
     return dt
-
-
-def measure(fn: Callable, fetch: Callable, iters: int) -> float:
-    """Warm up ``fn`` (compiles + runs), then time it; returns seconds
-    per iteration. ``fetch(result)`` must force completion by pulling at
-    least one scalar to the host."""
-    fetch(fn())
-    t0 = time.perf_counter()
-    fetch(fn())
-    return (time.perf_counter() - t0) / iters
 
 
 def consume_all_columns(table) -> "jnp.ndarray":
@@ -156,5 +163,6 @@ def timed_join_throughput(
     def fetch(res):
         state["total"], state["overflow"] = int(res[0]), bool(res[1])
 
-    sec = measure(lambda: fn(build, probe), fetch, iters)
+    sec = measure(lambda: fn(build, probe), fetch, iters,
+                  name="timed_join")
     return sec, state["total"] // iters, state["overflow"]

@@ -3,8 +3,9 @@
 # else), encoding the ROADMAP.md tier-1 command VERBATIM plus a fast
 # failure-semantics smoke lane.
 #
-#   scripts/run_tier1.sh           # full tier-1 (ROADMAP verbatim)
-#   scripts/run_tier1.sh faults    # fast lane: -m faults smoke only
+#   scripts/run_tier1.sh            # full tier-1 (ROADMAP verbatim)
+#   scripts/run_tier1.sh faults     # fast lane: -m faults smoke only
+#   scripts/run_tier1.sh telemetry  # fast lane: -m telemetry smoke only
 #
 # Notes:
 # - tests/conftest.py points the persistent XLA compile cache at
@@ -28,8 +29,16 @@ case "$lane" in
       tests/ -q -m faults --continue-on-collection-errors \
       -p no:cacheprovider -p no:xdist -p no:randomly
     ;;
+  telemetry)
+    # Observability smoke: telemetry-off seed parity (treedef +
+    # program count), device-counter oracle checks, span/Chrome-trace
+    # export, the driver --telemetry acceptance run.
+    exec timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m telemetry --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    ;;
   *)
-    echo "usage: $0 [tier1|faults]" >&2
+    echo "usage: $0 [tier1|faults|telemetry]" >&2
     exit 2
     ;;
 esac

@@ -1,0 +1,312 @@
+"""Telemetry subsystem (distributed_join_tpu/telemetry/) on the
+8-virtual-device CPU mesh.
+
+Two contracts (docs/OBSERVABILITY.md):
+
+- **Off = seed.** With no telemetry session, the compiled join step's
+  output treedef and compiled-program count are identical to the seed
+  — no silent aux outputs, no recompiles, no attribute leakage.
+- **On = honest.** With a session active, the device-side counters
+  that ride the compiled step as an aux ``Metrics`` pytree match
+  pandas-oracle ground truth (rows shuffled, wire bytes, match
+  count), span events land in the JSONL log, and the Chrome trace is
+  Perfetto-loadable JSON carrying the partition/shuffle/join stage
+  spans.
+"""
+
+import json
+import math
+
+import pytest
+
+import jax
+
+import distributed_join_tpu as dj
+from distributed_join_tpu import telemetry
+from distributed_join_tpu.ops.join import JoinResult
+from distributed_join_tpu.parallel.communicator import TpuCommunicator
+from distributed_join_tpu.parallel.distributed_join import (
+    make_distributed_join,
+)
+from distributed_join_tpu.parallel.out_of_core import keyrange_batched_join
+from distributed_join_tpu.utils.generators import (
+    generate_build_probe_tables,
+)
+
+pytestmark = pytest.mark.telemetry
+
+# int64 key + int64 payload: the generators' fixed row layout.
+ROW_BYTES = 16
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_session():
+    """Telemetry state is process-global; a test that dies mid-session
+    must not flip every later test into the instrumented path."""
+    telemetry.finalize()
+    yield
+    telemetry.finalize()
+
+
+class CountingComm(TpuCommunicator):
+    """Counts compiled SPMD programs — the observable behind the
+    'telemetry off compiles exactly the seed program set' contract."""
+
+    def __init__(self, n_ranks: int = 8):
+        super().__init__(n_ranks=n_ranks)
+        self.programs_built = 0
+
+    def spmd(self, fn, *, sharded_out=None):
+        self.programs_built += 1
+        return super().spmd(fn, sharded_out=sharded_out)
+
+
+def _tables():
+    return generate_build_probe_tables(
+        seed=11, build_nrows=512, probe_nrows=1024, rand_max=256,
+        selectivity=0.5,
+    )
+
+
+def _oracle(build, probe) -> int:
+    return len(build.to_pandas().merge(probe.to_pandas(), on="key"))
+
+
+# -- telemetry OFF: the seed hot path, bit for bit --------------------
+
+
+def test_off_path_treedef_and_program_count(tmp_path):
+    """No session: one compiled program, a bare JoinResult output
+    (same treedef as the instrumented mode's result — the aux Metrics
+    block must never leak into the JoinResult pytree), no telemetry
+    attribute, and no recompile on the second call."""
+    assert not telemetry.enabled()
+    b, p = _tables()
+    want = _oracle(b, p)
+
+    comm = CountingComm()
+    fn = make_distributed_join(comm, key="key", out_capacity_factor=4.0)
+    res_off = fn(b, p)
+    assert comm.programs_built == 1
+    assert type(res_off) is JoinResult
+    assert not hasattr(res_off, "telemetry")
+    assert int(res_off.total) == want
+    # Second call: the jit cache must be hit, not re-traced.
+    fn(b, p)
+    assert comm.programs_built == 1
+    if hasattr(fn, "_cache_size"):
+        assert fn._cache_size() == 1
+
+    # Same join with a session active: result carries the metrics as a
+    # HOST-side attribute; the JoinResult pytree itself is unchanged.
+    with telemetry.session(str(tmp_path / "tel")):
+        comm_on = CountingComm()
+        fn_on = make_distributed_join(comm_on, key="key",
+                                      out_capacity_factor=4.0)
+        res_on = fn_on(b, p)
+        assert comm_on.programs_built == 1
+        assert hasattr(res_on, "telemetry")
+        assert int(res_on.total) == want
+        assert (jax.tree_util.tree_structure(res_off)
+                == jax.tree_util.tree_structure(res_on))
+
+
+def test_explicit_with_metrics_false_wins_over_session(tmp_path):
+    """An active session must not leak into callers that pinned the
+    seed program (e.g. the out-of-core batch loop)."""
+    b, p = _tables()
+    with telemetry.session(str(tmp_path / "tel")):
+        fn = make_distributed_join(CountingComm(), key="key",
+                                   with_metrics=False,
+                                   out_capacity_factor=4.0)
+        res = fn(b, p)
+        assert not hasattr(res, "telemetry")
+
+
+# -- telemetry ON: counters vs. pandas-oracle ground truth ------------
+
+
+def test_ragged_metrics_match_oracle(tmp_path):
+    """Exact-size shuffle: rows shuffled = valid rows, wire bytes =
+    rows x fixed row bytes, matches = the pandas join size."""
+    b, p = _tables()
+    want = _oracle(b, p)
+    with telemetry.session(str(tmp_path / "tel")):
+        comm = dj.make_communicator("tpu", n_ranks=8)
+        res = dj.distributed_inner_join(
+            b, p, comm, shuffle="ragged", out_capacity_factor=4.0,
+        )
+        assert int(res.total) == want
+        m = res.telemetry.to_dict()
+        summ = telemetry.summary()
+    r = m["reduced"]
+    assert r["matches"] == want
+    assert r["build.rows_partitioned"] == 512
+    assert r["build.rows_shuffled"] == 512
+    assert r["build.rows_received"] == 512
+    assert r["probe.rows_shuffled"] == 1024
+    assert r["build.wire_bytes"] == 512 * ROW_BYTES
+    assert r["probe.wire_bytes"] == 1024 * ROW_BYTES
+    assert r["build.overflow_margin_min"] >= 0
+    assert r["retry_attempt_max"] == 0
+    # per-rank matches sum to the global total (gathered pre-psum)
+    assert sum(m["per_rank"]["matches"]) == want
+    # distributed_inner_join folded the same block into the session
+    assert summ["metrics"]["reduced"] == r
+
+
+def test_padded_metrics_wire_bytes_are_static_capacity(tmp_path):
+    """Padded mode bills the full static block per column — the
+    ~1/load-factor wire inflation the shuffle docstring describes —
+    while rows_shuffled stays the actual row count."""
+    b, p = _tables()
+    n, factor = 8, 2.0
+    with telemetry.session(str(tmp_path / "tel")):
+        comm = dj.make_communicator("tpu", n_ranks=8)
+        res = dj.distributed_inner_join(
+            b, p, comm, shuffle="padded",
+            shuffle_capacity_factor=factor, out_capacity_factor=4.0,
+        )
+        m = res.telemetry.to_dict()
+    r = m["reduced"]
+    assert r["build.rows_shuffled"] == 512
+    assert r["probe.rows_shuffled"] == 1024
+
+    def padded_bytes(rows):
+        cap = math.ceil(rows / n / n * factor)
+        cap += (-cap) % 8  # _round_up(., 8)
+        return n * (n * cap) * ROW_BYTES  # all ranks x padded block
+
+    assert r["build.wire_bytes"] == padded_bytes(512)
+    assert r["probe.wire_bytes"] == padded_bytes(1024)
+
+
+def test_retry_ladder_events_and_attempt_metric(tmp_path):
+    """An injected capacity squeeze: the final attempt's metrics carry
+    the retry attempt index, and each ladder rung streamed a
+    retry_attempt event into the JSONL log as it happened."""
+    from distributed_join_tpu.parallel.faults import (
+        FaultInjectingCommunicator,
+        FaultPlan,
+    )
+
+    b, p = _tables()
+    with telemetry.session(str(tmp_path / "tel")) as sink:
+        comm = FaultInjectingCommunicator(
+            dj.make_communicator("tpu", n_ranks=8),
+            FaultPlan(overflow_programs=1),
+        )
+        res = dj.distributed_inner_join(
+            b, p, comm, auto_retry=2, out_capacity_factor=4.0,
+        )
+        assert not bool(res.overflow)
+        assert res.telemetry.to_dict()["reduced"]["retry_attempt_max"] == 1
+        events_path = sink.events_path
+    events = [json.loads(line) for line in open(events_path)]
+    attempts = [e["payload"] for e in events
+                if e["name"] == "retry_attempt"]
+    assert [a["overflow"] for a in attempts] == [True, False]
+    assert attempts[1]["action"] == "double_capacities"
+
+
+def test_out_of_core_phase_counters_and_events(tmp_path):
+    """The out-of-core phase dict keeps its JSON keys verbatim while
+    the same increments land as out_of_core.* telemetry counters, and
+    every settled batch leaves a batch_complete event."""
+    b, p = _tables()
+    stats = {}
+    with telemetry.session(str(tmp_path / "tel")) as sink:
+        comm = dj.make_communicator("tpu", n_ranks=8)
+        total, overflow = keyrange_batched_join(
+            b, p, comm, n_batches=2, stats=stats,
+            out_capacity_factor=4.0, shuffle_capacity_factor=3.0,
+        )
+        events_path = sink.events_path
+        summ = telemetry.summary()
+    assert total == _oracle(b, p) and not overflow
+    # JSON keys preserved for downstream BENCH parsing
+    for key in ("pad_s", "put_s", "dispatch_s", "fetch_s",
+                "fetch_wait_s", "elapsed_s"):
+        assert key in stats
+    assert {"out_of_core.pad_s", "out_of_core.put_s",
+            "out_of_core.dispatch_s"} <= set(summ["counters"])
+    events = [json.loads(line) for line in open(events_path)]
+    done = [e["payload"]["batch"] for e in events
+            if e["name"] == "batch_complete"]
+    assert sorted(done) == [0, 1]
+
+
+# -- the acceptance run: driver --telemetry end-to-end ----------------
+
+
+def test_join_driver_telemetry_acceptance(tmp_path):
+    """ISSUE 2 acceptance: one --telemetry join-driver run on the CPU
+    mesh produces a JSONL event log, a Perfetto-loadable Chrome trace
+    with partition/shuffle/join spans, and a JSON record whose
+    embedded counters match the pandas oracle."""
+    from distributed_join_tpu.benchmarks import (
+        distributed_join as dj_driver,
+    )
+
+    tel_dir = str(tmp_path / "tel")
+    args = dj_driver.parse_args([
+        "--build-table-nrows", "8000", "--probe-table-nrows", "8000",
+        "--communicator", "tpu", "--iterations", "1",
+        "--out-capacity-factor", "3.0", "--shuffle", "ragged",
+        "--telemetry", tel_dir,
+    ])
+    assert telemetry.configure_from_args(args)
+    try:
+        record = dj_driver.run(args)
+    finally:
+        telemetry.finalize()
+
+    want = _oracle(*generate_build_probe_tables(
+        seed=42, build_nrows=8000, probe_nrows=8000, selectivity=0.3,
+        unique_build_keys=True,
+    ))
+    assert record["schema_version"] == 2
+    assert record["rank"] == 0
+    assert record["matches_per_join"] == want
+
+    tel = record["telemetry"]
+    red = tel["metrics"]["reduced"]
+    assert red["matches"] == want
+    assert red["build.rows_shuffled"] == 8000
+    assert red["probe.rows_shuffled"] == 8000
+    assert red["build.wire_bytes"] == 8000 * ROW_BYTES
+    assert red["probe.wire_bytes"] == 8000 * ROW_BYTES
+
+    # JSONL event log: one JSON object per line, metrics event present.
+    events = [json.loads(line) for line in open(tel["events_path"])]
+    assert any(e["name"] == "metrics" for e in events)
+    assert any(e["kind"] == "span" for e in events)
+
+    # Chrome trace: Perfetto-loadable shape with the stage spans.
+    trace = json.load(open(tel["trace_path"]))
+    assert isinstance(trace["traceEvents"], list)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"partition", "shuffle", "join"} <= names
+    complete = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert complete and all(
+        {"name", "ts", "dur", "pid", "tid"} <= set(e) for e in complete
+    )
+
+
+def test_driver_record_off_mode_unchanged():
+    """Without --telemetry the record gains only the schema stamp —
+    no telemetry block, and the run is the seed path."""
+    from distributed_join_tpu.benchmarks import (
+        distributed_join as dj_driver,
+    )
+
+    assert not telemetry.enabled()
+    args = dj_driver.parse_args([
+        "--build-table-nrows", "4096", "--probe-table-nrows", "4096",
+        "--communicator", "tpu", "--iterations", "1",
+        "--out-capacity-factor", "3.0",
+    ])
+    record = dj_driver.run(args)
+    assert record["schema_version"] == 2
+    assert record["rank"] == 0
+    assert "telemetry" not in record
