@@ -26,12 +26,21 @@ under BOTH capacity stories and both appear in the one JSON line —
   general contract, ``out_capacity_factor`` (1.2) x probe rows — what a
   user who does NOT know the match count pays.
 
-Observability: ``--telemetry [DIR]`` / ``--trace`` activate the shared
-telemetry session (docs/OBSERVABILITY.md); the record carries
-``schema_version``/``rank`` always, and the session summary under
-``"telemetry"`` only when a session is active (key present iff
-telemetry is on — the same presence contract as ``benchmarks.report``).
-Flagless invocation changes nothing else about the record or the run.
+Observability: ``--telemetry [DIR]`` / ``--trace`` / ``--diagnose``
+activate the shared telemetry session (docs/OBSERVABILITY.md); the
+record carries ``schema_version``/``rank`` always, and the session
+summary under ``"telemetry"`` only when a session is active (key
+present iff telemetry is on — the same presence contract as
+``benchmarks.report``). Flagless invocation changes nothing else
+about the record or the run.
+
+Outage fallback: when backend init fails (the TPU relay down), the
+same protocol reruns SMALL on an 8-virtual-device CPU mesh and the
+record carries ``proxy: true`` plus the deterministic counter
+signature (telemetry/baselines.py) instead of ``value: null`` — the
+perf trajectory stays populated through outages. Proxy walls are
+emulation artifacts and are never compared against the TPU baseline
+(``vs_baseline`` stays null).
 """
 
 from __future__ import annotations
@@ -64,6 +73,19 @@ def _init_devices():
 
     return call_with_deadline(jax.devices, _INIT_TIMEOUT_S,
                               what="backend init")
+
+# CPU-mesh proxy fallback (the observability layer's "perf trajectory
+# is never empty" contract, docs/OBSERVABILITY.md): when backend init
+# fails, rerun the protocol small on an 8-virtual-device CPU mesh and
+# emit the deterministic counter signature as a `proxy: true` record
+# instead of `value: null`. The proxy itself runs under a watchdog —
+# if the hung TPU init poisoned backend state, we degrade to the old
+# null record rather than hanging with no record at all.
+PROXY_NROWS = int(os.environ.get("DJTPU_BENCH_PROXY_NROWS", 262_144))
+PROXY_ITERS = int(os.environ.get("DJTPU_BENCH_PROXY_ITERS", 2))
+PROXY_TIMEOUT_S = float(
+    os.environ.get("DJTPU_BENCH_PROXY_TIMEOUT", 600))
+PROXY_RANKS = 8
 
 # Row count / slack / iteration knobs are env-overridable so the
 # hardware pack's smoke lane (scripts/hardware_session.py) can run the
@@ -104,35 +126,141 @@ def main(argv=None) -> int:
     add_telemetry_args(p)
     args = p.parse_args(argv)
     telemetry.configure_from_args(args)
+    result = None
     try:
-        return _run()
+        result = _run()
+        return 0
     except Exception as exc:  # noqa: BLE001 — record, then re-signal
         from distributed_join_tpu.parallel.bootstrap import BootstrapError
 
         is_outage = isinstance(exc, BootstrapError)
-        record = stamp_record({
-            "metric": "join throughput",
-            "value": None,
-            "unit": "M rows/sec/chip",
-            "vs_baseline": None,
-            "error": f"{type(exc).__name__}: {exc}",
-            "bootstrap": exc.record() if is_outage else None,
-            "traceback": traceback.format_exc().splitlines()[-3:],
-        })
+        record = None
+        if is_outage:
+            # TPU relay down: the headline number is unmeasurable, but
+            # the perf trajectory must not go empty — rerun the
+            # protocol small on the CPU mesh and emit its
+            # deterministic counter signature as a proxy record.
+            record = _try_proxy(exc)
+        if record is None:
+            record = stamp_record({
+                "metric": "join throughput",
+                "value": None,
+                "unit": "M rows/sec/chip",
+                "vs_baseline": None,
+                "error": f"{type(exc).__name__}: {exc}",
+                "bootstrap": exc.record() if is_outage else None,
+                "traceback": traceback.format_exc().splitlines()[-3:],
+            })
         print(json.dumps(record), flush=True)
         # A hung init thread (relay down) would block normal interpreter
         # exit; the record is already flushed, so leave hard (after
         # flushing the telemetry files — finally won't run past
         # os._exit). Only an environment outage exits 0: a regressed
         # benchmark must not read as a clean pass to rc-checking
-        # automation.
-        telemetry.finalize()
+        # automation. Non-outage failures (overflow, a code bug) DID
+        # leave join telemetry behind — exactly the run --diagnose is
+        # for — so they get the diagnosis run_guarded's finally would
+        # have given them; an outage has nothing to read.
+        from distributed_join_tpu.benchmarks import maybe_diagnose
+
+        summ = telemetry.finalize()
+        if not is_outage:
+            maybe_diagnose(args, summ, record=record)
         os._exit(0 if is_outage else 1)
     finally:
-        telemetry.finalize()
+        from distributed_join_tpu.benchmarks import maybe_diagnose
+
+        maybe_diagnose(args, telemetry.finalize(), record=result)
 
 
-def _run() -> int:
+def _try_proxy(outage) -> dict | None:
+    """Best-effort CPU-mesh proxy record after a backend-init outage.
+    Runs under its own watchdog deadline: if the hung TPU init
+    poisoned jax's backend state the proxy hangs too, and the caller
+    must still get its null record (we os._exit afterwards, so a
+    stuck worker thread is moot). Returns None when the proxy itself
+    cannot run."""
+    from distributed_join_tpu.parallel.bootstrap import call_with_deadline
+
+    try:
+        return call_with_deadline(
+            lambda: _proxy_run(outage), PROXY_TIMEOUT_S,
+            what="cpu-mesh proxy bench",
+        )
+    except Exception as exc:  # noqa: BLE001 — proxy is best-effort
+        print(f"note: cpu-mesh proxy failed: {type(exc).__name__}: "
+              f"{exc}", file=sys.stderr)
+        return None
+
+
+def _proxy_run(outage) -> dict:
+    """The headline protocol, small, on 8 virtual CPU devices — same
+    generator seed, same timing discipline, same join program shape.
+    The wall number is an emulation artifact and is clearly labeled
+    ``proxy``; the COUNTER SIGNATURE (rows shuffled, wire bytes,
+    matches — telemetry/baselines.py) is bit-identical to what the
+    hardware run would have produced, which is what the perf
+    trajectory and the perfgate lane consume."""
+    from distributed_join_tpu.benchmarks import (
+        force_cpu_platform,
+        stamp_record,
+    )
+
+    force_cpu_platform(PROXY_RANKS)
+    from distributed_join_tpu.parallel.communicator import TpuCommunicator
+    from distributed_join_tpu.parallel.distributed_join import (
+        JOIN_METRICS_SHARDED_OUT,
+        make_join_step,
+    )
+    from distributed_join_tpu.telemetry.baselines import counter_signature
+    from distributed_join_tpu.utils.benchmarking import timed_join_throughput
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+    )
+
+    n = PROXY_RANKS
+    comm = TpuCommunicator(n_ranks=n)
+    build, probe = generate_build_probe_tables(
+        seed=42, build_nrows=PROXY_NROWS, probe_nrows=PROXY_NROWS,
+        selectivity=SELECTIVITY,
+    )
+    build, probe = comm.device_put_sharded((build, probe))
+    jax.block_until_ready((build, probe))
+    join_opts = dict(key="key", over_decomposition=1,
+                     out_capacity_factor=3.0)
+    step = make_join_step(comm, **join_opts)
+    sec, matches, overflow = timed_join_throughput(
+        comm, step, build, probe, PROXY_ITERS
+    )
+    # The deterministic counter signature from one metrics-
+    # instrumented single step on the same inputs (the untimed
+    # program, as in benchmarks.collect_join_metrics).
+    mstep = make_join_step(comm, with_metrics=True, **join_opts)
+    _, metrics = comm.spmd(
+        mstep, sharded_out=JOIN_METRICS_SHARDED_OUT)(build, probe)
+    rows_per_sec = (2 * PROXY_NROWS) / sec
+    return stamp_record({
+        "metric": "join throughput",
+        "value": round(rows_per_sec / 1e6 / n, 3),
+        "unit": "M rows/sec/chip",
+        "vs_baseline": None,
+        "proxy": True,
+        "proxy_protocol": {
+            "platform": "cpu-mesh",
+            "n_ranks": n,
+            "build_nrows": PROXY_NROWS,
+            "probe_nrows": PROXY_NROWS,
+            "selectivity": SELECTIVITY,
+            "iterations": PROXY_ITERS,
+        },
+        "matches_per_join": int(matches),
+        "overflow": bool(overflow),
+        "counter_signature": counter_signature(metrics),
+        "bootstrap": outage.record(),
+    })
+
+
+def _run() -> dict:
     from distributed_join_tpu.parallel.communicator import (
         LocalCommunicator,
         TpuCommunicator,
@@ -234,7 +362,7 @@ def _run() -> int:
         },
     })
     print(json.dumps(record))
-    return 0
+    return record
 
 
 if __name__ == "__main__":

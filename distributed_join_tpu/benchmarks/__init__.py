@@ -34,6 +34,28 @@ def stamp_record(record: dict) -> dict:
     return record
 
 
+def load_record(source) -> dict:
+    """THE one place driver/bench JSON records are read back
+    (:func:`stamp_record`'s inverse — the analysis/baseline layer and
+    any BENCH parser route through here). ``source`` is a path or an
+    already-parsed dict. Records that predate ``schema_version`` (the
+    round-1..5 ``results/*.json`` and ``BENCH_r0*.json`` files) are
+    stamped as version 1 with rank 0 instead of crashing downstream
+    readers — key ABSENCE is the v1 signal, never an error."""
+    import json
+
+    if isinstance(source, dict):
+        record = dict(source)
+    else:
+        with open(source) as f:
+            record = json.load(f)
+        if not isinstance(record, dict):
+            raise ValueError(f"{source}: not a JSON record object")
+    record.setdefault("schema_version", 1)
+    record.setdefault("rank", 0)
+    return record
+
+
 def report(headline: str, record: dict, json_output: str | None) -> None:
     """Rank-0-only result reporting, shared by every driver: a
     reference-shaped stdout line, the JSON record, and the optional
@@ -80,8 +102,9 @@ def run_guarded(run, args, benchmark: str) -> int:
     # the XLA device profile for --trace starts later, in
     # apply_platform, after platform/bootstrap selection.
     telemetry.configure_from_args(args)
+    result = None
     try:
-        run(args)
+        result = run(args)
         return 0
     # SystemExit (argparse/flag validation) propagates untouched: it is
     # not an Exception, and it is not a runtime failure record.
@@ -113,7 +136,8 @@ def run_guarded(run, args, benchmark: str) -> int:
             # .initialize, and concurrent.futures' atexit hook would
             # join it forever on a normal return — the record above is
             # already flushed. os._exit skips the finally below, so
-            # flush the telemetry files first.
+            # flush the telemetry files first. (--diagnose is skipped:
+            # an environment outage leaves no join telemetry to read.)
             telemetry.finalize()
             sys.stdout.flush()
             sys.stderr.flush()
@@ -122,7 +146,40 @@ def run_guarded(run, args, benchmark: str) -> int:
     finally:
         # Write the Chrome trace / summary even on failure — a run
         # that died is exactly the run whose trace you want.
-        telemetry.finalize()
+        summary = telemetry.finalize()
+        maybe_diagnose(args, summary, record=result)
+
+
+def maybe_diagnose(args, summary, record=None) -> None:
+    """End-of-run ``--diagnose`` hook (run_guarded and bench.py): read
+    the just-finalized session directory back through
+    ``telemetry.analyze`` and leave ``diagnosis.json`` + a printed
+    report. ``record`` is the driver's result dict when the run
+    produced one — it supplies workload context (dtypes, shuffle
+    mode) the wire-efficiency indicator needs. Rank 0 only — the
+    per-rank event logs live in a shared directory and the diagnosis
+    is the cross-rank merge; peer ranks' logs are line-flushed as
+    events happen, but there is no end-of-run barrier, so a peer
+    still finalizing can be missing its last events (re-run
+    ``analyze diagnose RUNDIR`` afterwards for the settled view).
+    Never lets an analysis bug mask the benchmark's own outcome."""
+    import sys
+
+    if not getattr(args, "diagnose", False) or summary is None:
+        return
+    from distributed_join_tpu.parallel.bootstrap import is_coordinator
+
+    if not is_coordinator():
+        return
+    try:
+        from distributed_join_tpu.telemetry.analyze import diagnose_run
+
+        diagnose_run(summary["dir"],
+                     record=record if isinstance(record, dict) else None,
+                     print_report=True)
+    except Exception as exc:  # noqa: BLE001 — diagnosis is best-effort
+        print(f"note: --diagnose failed: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
 
 
 def add_platform_arg(parser) -> None:
@@ -152,6 +209,14 @@ def add_telemetry_args(parser) -> None:
         help="additionally capture a full XLA device profile under "
              "DIR/xla (open with TensorBoard/XProf; span names line "
              "up via TraceAnnotation). Implies --telemetry",
+    )
+    parser.add_argument(
+        "--diagnose", action="store_true",
+        help="at end of run, analyze the telemetry run directory "
+             "(telemetry.analyze): straggler/skew/headroom/wire "
+             "indicators + knob recommendations, written to "
+             "DIR/diagnosis.json and printed on rank 0. Implies "
+             "--telemetry",
     )
 
 
@@ -227,17 +292,30 @@ def apply_platform(platform: str | None, n_ranks: int | None) -> None:
     if platform in (None, "", "default"):
         _start_trace()
         return
+    if platform == "cpu":
+        force_cpu_platform(n_ranks)
+    else:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    _start_trace()
+
+
+def force_cpu_platform(n_ranks: int | None = None) -> None:
+    """THE one definition of "force the host-platform fake backend
+    with >= max(8, n_ranks) virtual devices" (apply_platform's cpu
+    branch and bench.py's outage proxy both route here). Must run
+    before first device use: XLA_FLAGS is read at backend-creation
+    time, and a pre-existing device-count flag is honored."""
     import os
 
     import jax
 
-    if platform == "cpu":
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            count = max(8, n_ranks or 0)
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} "
-                f"--xla_force_host_platform_device_count={count}"
-            ).strip()
-    jax.config.update("jax_platforms", platform)
-    _start_trace()
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        count = max(8, n_ranks or 0)
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} "
+            f"--xla_force_host_platform_device_count={count}"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")

@@ -28,6 +28,7 @@ import subprocess
 import sys
 import time
 
+from distributed_join_tpu.benchmarks import add_telemetry_args
 from distributed_join_tpu.parallel.bootstrap import (
     ENV_COORDINATOR,
     ENV_CPU_DEVICES,
@@ -47,6 +48,14 @@ def parse_args(argv=None):
     p.add_argument("--cpu-devices-per-process", type=int, default=None,
                    help="emulate this many virtual CPU devices per "
                         "process (no-TPU validation path, gloo transport)")
+    # --telemetry/--trace/--diagnose at the launcher are FORWARDED to
+    # every spawned driver process (one shared session directory; the
+    # per-rank file names keep the processes apart, and the drivers'
+    # own rank-0 gating elects the summary/diagnosis writer). The
+    # launcher itself must NOT open a session — its env-fallback rank
+    # would collide with child rank 0's files — so the flags are moved
+    # off the args before run_guarded sees them (_extract_telemetry).
+    add_telemetry_args(p)
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="driver command to launch (prefix with --)")
     args = p.parse_args(argv)
@@ -55,8 +64,28 @@ def parse_args(argv=None):
         cmd = cmd[1:]
     if not cmd:
         p.error("no driver command given (append: -- <driver> [args...])")
-    args.command = cmd
+    args.command = cmd + _extract_telemetry(args)
     return args
+
+
+def _extract_telemetry(args) -> list:
+    """Move the launcher-level telemetry flags into child-command
+    argv (skipping any the command already carries) and strip them
+    from ``args`` so ``run_guarded``'s ``configure_from_args`` sees a
+    flagless launcher process."""
+    def has(flag):
+        return any(c == flag or c.startswith(flag + "=")
+                   for c in args.command)
+
+    extra = []
+    if args.telemetry is not None and not has("--telemetry"):
+        extra += ["--telemetry", args.telemetry]
+    if args.trace and not has("--trace"):
+        extra.append("--trace")
+    if args.diagnose and not has("--diagnose"):
+        extra.append("--diagnose")
+    args.telemetry, args.trace, args.diagnose = None, False, False
+    return extra
 
 
 def _env_for(args, pid: int) -> dict:
@@ -69,8 +98,12 @@ def _env_for(args, pid: int) -> dict:
     return env
 
 
-def main(argv=None) -> int:
-    args = parse_args(argv)
+def run(args) -> int:
+    """Spawn-and-reap under ``run_guarded``'s failure-record contract:
+    a rank death still leaves a one-line JSON record on the launcher's
+    stdout (the children's own ``run_guarded`` wraps their failures;
+    this covers the launcher layer itself — spawn errors, killed
+    ranks)."""
     if args.process_id is not None:
         # One process on this host: exec in place, mpirun-task style.
         os.execvpe(args.command[0], args.command,
@@ -85,6 +118,7 @@ def main(argv=None) -> int:
     # blocked in a collective waiting for the dead peer and would never
     # exit on their own.
     rc = 0
+    failed = None
     live = list(procs)
     while live:
         for p in list(live):
@@ -94,11 +128,22 @@ def main(argv=None) -> int:
             live.remove(p)
             if code and not rc:
                 rc = code
+                failed = procs.index(p)
                 for q in live:
                     q.terminate()
         if live:
             time.sleep(0.05)
-    return rc
+    if rc:
+        raise RuntimeError(
+            f"process {failed} exited with rc={rc} "
+            f"(command: {' '.join(args.command)})")
+    return 0
+
+
+def main(argv=None) -> int:
+    from distributed_join_tpu.benchmarks import run_guarded
+
+    return run_guarded(run, parse_args(argv), benchmark="launch")
 
 
 if __name__ == "__main__":

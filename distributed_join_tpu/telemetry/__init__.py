@@ -23,6 +23,13 @@ session with three parts (docs/OBSERVABILITY.md is the contract):
   log + Chrome-trace (Perfetto-loadable) file per rank, rank-0 merged
   summary.
 
+On top of the recording layer sit the READERS — :mod:`.analyze`
+(cross-rank diagnosis: skew Gini, stragglers, overflow headroom, wire
+efficiency, retry cost, with knob recommendations; every driver's
+``--diagnose``) and :mod:`.baselines` (deterministic counter
+signatures + the ``compare`` perf gate of ``run_tier1.sh perfgate``).
+Both are device-free: they consume the files, never the session.
+
 The hard contract: **telemetry OFF is the exact seed hot path** — no
 extra aux outputs, no recompilation, zero overhead. Every function in
 this module is a no-op (and :func:`span` a shared nullcontext) until
@@ -89,12 +96,13 @@ def configure(out_dir: str, *, trace: bool = False,
 
 def configure_from_args(args) -> bool:
     """Driver seam: activate from ``--telemetry[=DIR]`` / ``--trace``
-    flags (see ``benchmarks.add_telemetry_args``). ``--trace`` alone
-    implies telemetry at the default directory. Returns whether a
-    session was configured."""
+    / ``--diagnose`` flags (see ``benchmarks.add_telemetry_args``).
+    ``--trace`` or ``--diagnose`` alone imply telemetry at the default
+    directory (both need a session's files to exist). Returns whether
+    a session was configured."""
     out_dir = getattr(args, "telemetry", None)
     trace = bool(getattr(args, "trace", False))
-    if out_dir is None and trace:
+    if out_dir is None and (trace or getattr(args, "diagnose", False)):
         out_dir = "telemetry"
     if out_dir is None:
         return False

@@ -1,0 +1,835 @@
+"""Run analysis — the read side of the telemetry subsystem.
+
+PR 2 made every run *record* the quantities the paper says dominate
+distributed-join throughput (wire bytes, per-rank occupancy, match
+counts, overflow headroom); this module *reads* them back and closes
+the loop:
+
+- :func:`load_run` merges a run directory (per-rank
+  ``events.rank<r>.jsonl`` + rank-0 ``summary.json``) into one
+  cross-rank view;
+- :func:`compute_indicators` turns it into structured health
+  indicators — straggler index (max/mean span seconds per stage
+  across ranks), key-skew Gini over the per-rank row counters,
+  overflow-margin headroom, wire-byte efficiency (actual vs. ideal
+  payload incl. varwidth prefixes and compression savings), retry-
+  ladder cost, and the host-side stage split;
+- :func:`recommend` maps warning indicators to the CONCRETE knobs
+  that relieve them (``--skew-threshold``/``--hh-*`` in
+  ``parallel/skew.py``'s PRPD path, ``--shuffle-capacity-factor`` /
+  ``--out-capacity-factor`` / ``--over-decomposition-factor`` /
+  ``--shuffle ragged`` in ``parallel/distributed_join.py``);
+- :func:`diagnose_run` writes ``diagnosis.json`` next to the run's
+  telemetry files and renders the human report (every driver's
+  ``--diagnose`` flag lands here via ``benchmarks.run_guarded``);
+- the CLI (``python -m distributed_join_tpu.telemetry.analyze``)
+  exposes ``diagnose`` / ``report`` / ``compare`` / ``check``, where
+  ``compare`` is the perf gate: non-zero exit on counter-signature
+  drift or banded wall-time regression against a committed baseline
+  (:mod:`.baselines`; the ``perfgate`` lane of
+  ``scripts/run_tier1.sh``).
+
+Deliberately device-free: analysis runs on the artifacts, never the
+accelerators, so it works on a laptop against files scp'd from a pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import re
+import sys
+from typing import Optional
+
+from distributed_join_tpu.telemetry import baselines
+
+DIAGNOSIS_SCHEMA_VERSION = 1
+
+# Warning thresholds (docs/OBSERVABILITY.md "Diagnosis & baselines"
+# records the rationale; tests/test_analysis.py pins behavior on both
+# sides of each).
+SKEW_GINI_WARN = 0.10        # Gini over per-rank counters
+SKEW_IMBALANCE_WARN = 1.30   # max/mean over per-rank counters
+STRAGGLER_WARN = 1.50        # max/mean span seconds across ranks
+HEADROOM_RATIO_WARN = 0.15   # overflow margin / avg bucket rows
+WIRE_EFFICIENCY_WARN = 0.60  # payload bytes / wire bytes
+
+# The per-rank counters whose imbalance means KEY skew (receive-side:
+# hash routing concentrated rows; matches: multiplicity concentrated
+# work). Send-side counters are generator-balanced by construction.
+_SKEW_COUNTERS = ("build.rows_received", "probe.rows_received",
+                  "matches")
+# Span names worth a cross-rank straggler index (host-visible stages).
+_STAGE_SPANS = ("timed_join", "all_to_all", "collect_metrics",
+                "generate", "stage", "fetch", "dispatch")
+
+
+@dataclasses.dataclass
+class RunData:
+    """One run directory, merged cross-rank."""
+
+    run_dir: str
+    events: list                 # all ranks' JSONL events, ts-sorted
+    summary: Optional[dict]      # rank-0 summary.json (None if absent)
+    record: Optional[dict]       # driver/bench JSON record (optional)
+    ranks_seen: list             # ranks with an events file
+    malformed_lines: int
+
+    @property
+    def metrics(self) -> Optional[dict]:
+        """The device-counter block {n_ranks, per_rank, reduced}."""
+        if self.summary and isinstance(self.summary.get("metrics"), dict):
+            return self.summary["metrics"]
+        if self.record:
+            sig = None
+            tel = self.record.get("telemetry")
+            if isinstance(tel, dict) and isinstance(
+                    tel.get("metrics"), dict):
+                sig = tel["metrics"]
+            return sig
+        return None
+
+
+def load_run(run_dir: str, record=None) -> RunData:
+    """Load a telemetry run directory. ``record`` may be a path to the
+    driver's ``--json-output`` file or an already-loaded dict; any
+    pre-``schema_version: 2`` record is tolerated
+    (``benchmarks.load_record`` stamps missing versions as v1)."""
+    from distributed_join_tpu.benchmarks import load_record
+
+    if not os.path.isdir(run_dir):
+        raise FileNotFoundError(f"not a run directory: {run_dir}")
+    events, ranks, malformed = [], [], 0
+    for path in sorted(glob.glob(os.path.join(run_dir,
+                                              "events.rank*.jsonl"))):
+        m = re.search(r"events\.rank(\d+)\.jsonl$", path)
+        rank = int(m.group(1)) if m else 0
+        ranks.append(rank)
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    malformed += 1   # a killed run's torn last line
+                    continue
+                ev.setdefault("rank", rank)
+                events.append(ev)
+    events.sort(key=lambda e: e.get("ts_us", 0.0))
+    summary = None
+    spath = os.path.join(run_dir, "summary.json")
+    if os.path.exists(spath):
+        with open(spath) as f:
+            summary = json.load(f)
+    if record is not None and not isinstance(record, dict):
+        record = load_record(record)
+    return RunData(run_dir=run_dir, events=events, summary=summary,
+                   record=record, ranks_seen=sorted(set(ranks)),
+                   malformed_lines=malformed)
+
+
+# -- small stats ------------------------------------------------------
+
+
+def gini(values) -> Optional[float]:
+    """Gini coefficient over non-negative per-rank totals: 0 =
+    perfectly balanced, ->1 = one rank holds everything."""
+    vals = sorted(float(v) for v in values)
+    n = len(vals)
+    total = sum(vals)
+    if n < 2 or total <= 0:
+        return None
+    cum = 0.0
+    for i, v in enumerate(vals, start=1):
+        cum += i * v
+    return (2.0 * cum) / (n * total) - (n + 1.0) / n
+
+
+def imbalance(values) -> Optional[float]:
+    vals = [float(v) for v in values]
+    if not vals or sum(vals) <= 0:
+        return None
+    mean = sum(vals) / len(vals)
+    return max(vals) / mean if mean > 0 else None
+
+
+def _status(warn: bool) -> str:
+    return "warn" if warn else "ok"
+
+
+# -- indicators -------------------------------------------------------
+
+
+def compute_indicators(run: RunData) -> dict:
+    """The structured health block of ``diagnosis.json``. Every
+    indicator degrades to ``{"status": "unknown"}`` when its inputs
+    were not recorded (telemetry-off runs, non-join drivers) — a
+    diagnosis must never crash on a sparse run."""
+    return {
+        "key_skew": _key_skew(run),
+        "straggler": _straggler(run),
+        "overflow_headroom": _overflow_headroom(run),
+        "wire_efficiency": _wire_efficiency(run),
+        "retry_ladder": _retry_ladder(run),
+        "stage_split": _stage_split(run),
+    }
+
+
+def _key_skew(run: RunData) -> dict:
+    m = run.metrics
+    if not m or not m.get("per_rank"):
+        return {"status": "unknown"}
+    per_counter, worst = {}, ("", 0.0)
+    for name in _SKEW_COUNTERS:
+        vals = m["per_rank"].get(name)
+        if not vals:
+            continue
+        g, imb = gini(vals), imbalance(vals)
+        if g is None:
+            continue
+        per_counter[name] = {
+            "gini": round(g, 4),
+            "max_over_mean": round(imb, 4),
+            "per_rank": [int(v) for v in vals],
+        }
+        if g > worst[1]:
+            worst = (name, g)
+    if not per_counter:
+        return {"status": "unknown"}
+    skewed = any(
+        c["gini"] > SKEW_GINI_WARN
+        or c["max_over_mean"] > SKEW_IMBALANCE_WARN
+        for c in per_counter.values()
+    )
+    return {
+        "status": _status(skewed),
+        "counters": per_counter,
+        "worst_counter": worst[0],
+        "gini_warn_threshold": SKEW_GINI_WARN,
+        "imbalance_warn_threshold": SKEW_IMBALANCE_WARN,
+    }
+
+
+def _straggler(run: RunData) -> dict:
+    """max/mean of per-rank span seconds, per stage — needs >= 2 ranks
+    WITH event files (a single-process CPU-mesh run has one log; its
+    in-program imbalance shows up in key_skew instead)."""
+    per_rank: dict = {}
+    for ev in run.events:
+        if ev.get("kind") != "span":
+            continue
+        name = ev.get("name")
+        if name not in _STAGE_SPANS:
+            continue
+        per_rank.setdefault(name, {})
+        r = ev.get("rank", 0)
+        per_rank[name][r] = (per_rank[name].get(r, 0.0)
+                             + ev.get("dur_us", 0.0) / 1e6)
+    stages = {}
+    for name, by_rank in per_rank.items():
+        if len(by_rank) < 2:
+            continue
+        vals = list(by_rank.values())
+        idx = imbalance(vals)
+        if idx is None:
+            continue
+        stages[name] = {
+            "straggler_index": round(idx, 4),
+            "per_rank_s": {str(r): round(s, 6)
+                           for r, s in sorted(by_rank.items())},
+        }
+    if not stages:
+        return {"status": "unknown",
+                "note": "needs per-rank event logs from >= 2 processes"}
+    worst = max(stages.values(), key=lambda s: s["straggler_index"])
+    return {
+        "status": _status(worst["straggler_index"] > STRAGGLER_WARN),
+        "stages": stages,
+        "warn_threshold": STRAGGLER_WARN,
+    }
+
+
+def _overflow_headroom(run: RunData) -> dict:
+    m = run.metrics
+    if not m or not m.get("reduced"):
+        return {"status": "unknown"}
+    red = m["reduced"]
+    n = int(m.get("n_ranks", 0)) or 1
+    sides, tight = {}, False
+    for side in ("build", "probe"):
+        margin = red.get(f"{side}.overflow_margin_min")
+        rows = red.get(f"{side}.rows_shuffled")
+        if margin is None:
+            continue
+        # Average rows per (sender, destination) bucket — the unit the
+        # margin is measured against (shuffle.py's per-bucket clamp).
+        avg_bucket = (rows / (n * n)) if rows else None
+        ratio = (margin / avg_bucket
+                 if avg_bucket and avg_bucket > 0 else None)
+        low = bool(margin <= 0
+                   or (ratio is not None and ratio < HEADROOM_RATIO_WARN))
+        tight = tight or low
+        sides[side] = {
+            "margin_rows_min": int(margin),
+            "avg_bucket_rows": (round(avg_bucket, 1)
+                                if avg_bucket is not None else None),
+            "headroom_ratio": (round(ratio, 4)
+                               if ratio is not None else None),
+            "low": low,
+        }
+    if not sides:
+        return {"status": "unknown"}
+    # Trend across successive metrics emissions (retried/batched runs
+    # emit more than one metrics event).
+    trend = [
+        {s: ev["payload"]["reduced"].get(f"{s}.overflow_margin_min")
+         for s in ("build", "probe")}
+        for ev in run.events
+        if ev.get("name") == "metrics"
+        and isinstance(ev.get("payload"), dict)
+        and isinstance(ev["payload"].get("reduced"), dict)
+    ]
+    return {
+        "status": _status(tight),
+        "sides": sides,
+        "trend": trend if len(trend) > 1 else None,
+        "warn_ratio_threshold": HEADROOM_RATIO_WARN,
+    }
+
+
+def _wire_efficiency(run: RunData) -> dict:
+    """Actual wire bytes vs. the ideal payload. The ideal row width
+    comes from the record's dtypes when available; the codec/varwidth
+    ledger (``wire_bytes_saved``) is always available from the
+    counters themselves."""
+    m = run.metrics
+    if not m or not m.get("reduced"):
+        return {"status": "unknown"}
+    red = m["reduced"]
+    row_bytes = _ideal_row_bytes(run.record)
+    sides, inflated = {}, False
+    for side in ("build", "probe"):
+        wire = red.get(f"{side}.wire_bytes")
+        rows = red.get(f"{side}.rows_shuffled")
+        if not wire or not rows:
+            continue
+        saved = red.get(f"{side}.wire_bytes_saved", 0)
+        entry = {
+            "wire_bytes": int(wire),
+            "bytes_per_row": round(wire / rows, 2),
+            "saved_vs_fixed_width_bytes": int(saved),
+            "varwidth_prefix_bytes":
+                int(red.get(f"{side}.varwidth_bytes", 0)),
+        }
+        if row_bytes:
+            eff = (rows * row_bytes) / wire
+            entry["ideal_row_bytes"] = row_bytes
+            entry["efficiency"] = round(eff, 4)
+            if eff < WIRE_EFFICIENCY_WARN:
+                entry["inflated"] = True
+                inflated = True
+        sides[side] = entry
+    if not sides:
+        return {"status": "unknown"}
+    return {
+        "status": _status(inflated),
+        "sides": sides,
+        "shuffle_mode": (run.record or {}).get("shuffle"),
+        "warn_efficiency_threshold": WIRE_EFFICIENCY_WARN,
+    }
+
+
+_DTYPE_BYTES = {"int32": 4, "int64": 8, "float32": 4, "float64": 8}
+
+
+def _ideal_row_bytes(record: Optional[dict]) -> Optional[int]:
+    """Fixed row width on the wire for the generator drivers' simple
+    schema (one key + one payload column, possibly composite). String
+    payloads are varwidth — the counters' own ledger covers those."""
+    if not record or record.get("string_payload_bytes") or \
+            record.get("string_key_bytes"):
+        return None
+    kb = _DTYPE_BYTES.get(record.get("key_type", ""))
+    pb = _DTYPE_BYTES.get(record.get("payload_type", ""))
+    if kb is None or pb is None:
+        return None
+    return kb * max(int(record.get("key_columns", 1) or 1), 1) + pb
+
+
+def _retry_ladder(run: RunData) -> dict:
+    attempts = [ev["payload"] for ev in run.events
+                if ev.get("name") == "retry_attempt"
+                and isinstance(ev.get("payload"), dict)]
+    red = (run.metrics or {}).get("reduced", {})
+    attempt_max = red.get("retry_attempt_max")
+    if not attempts and attempt_max in (None, 0):
+        return {"status": "ok", "n_attempts": 1 if red else None,
+                "escalations": 0}
+    overflowed = [a for a in attempts if a.get("overflow")]
+    final = attempts[-1] if attempts else None
+    return {
+        "status": _status(bool(overflowed) or bool(attempt_max)),
+        "n_attempts": len(attempts) or (
+            attempt_max + 1 if attempt_max is not None else None),
+        "escalations": len(overflowed),
+        "resolved": (not final.get("overflow")) if final else None,
+        "final_sizing": {
+            k: final[k] for k in (
+                "shuffle_capacity_factor", "out_capacity_factor",
+                "out_rows_per_rank", "compression_bits",
+                "hh_probe_capacity", "hh_out_capacity",
+            ) if final and final.get(k) is not None
+        } if final else None,
+    }
+
+
+def _stage_split(run: RunData) -> dict:
+    """Host-visible span totals (from the rank-0 summary): where the
+    run's wall time went. Spans inside the compiled step time tracing,
+    not execution (docs/OBSERVABILITY.md) — this is the HOST split;
+    the device split needs ``--trace``'s XLA profile."""
+    if not run.summary or not run.summary.get("spans"):
+        return {"status": "unknown"}
+    spans = {path: {"count": st.get("count"),
+                    "total_s": round(st.get("total_s", 0.0), 6)}
+             for path, st in sorted(run.summary["spans"].items())}
+    return {"status": "info", "spans": spans}
+
+
+# -- recommendations --------------------------------------------------
+
+
+def recommend(indicators: dict, run: RunData) -> list:
+    """Map warning indicators to the concrete knobs that relieve them.
+    Every entry names the flag (driver CLI) and the module owning the
+    mechanism, so the reader can go from symptom to code."""
+    recs = []
+    rec = run.record or {}
+
+    skew = indicators["key_skew"]
+    if skew.get("status") == "warn":
+        already_skew = bool(
+            (run.metrics or {}).get("reduced", {}).get("skew.hh_matches")
+        ) or rec.get("skew_threshold")
+        worst = skew.get("worst_counter", "")
+        detail = skew["counters"].get(worst, {})
+        if already_skew:
+            recs.append({
+                "id": "skew_widen_hh",
+                "severity": "warn",
+                "knob": "hh_slots / hh capacities",
+                "flags": ["--hh-slots 128", "--hh-probe-capacity",
+                          "--hh-out-capacity"],
+                "module": "parallel/skew.py",
+                "message": (
+                    f"per-rank {worst} still imbalanced (gini="
+                    f"{detail.get('gini')}) with the PRPD skew path "
+                    "already on — widen the heavy-hitter set "
+                    "(--hh-slots) and its capacities so more hot keys "
+                    "leave the hashed shuffle."),
+            })
+        else:
+            recs.append({
+                "id": "skew_enable_prpd",
+                "severity": "warn",
+                "knob": "skew_threshold",
+                "flags": ["--skew-threshold 0.001"],
+                "module": "parallel/skew.py",
+                "message": (
+                    f"per-rank {worst} is key-skewed (gini="
+                    f"{detail.get('gini')}, max/mean="
+                    f"{detail.get('max_over_mean')}): enable the PRPD "
+                    "heavy-hitter path (--skew-threshold 0.001; "
+                    "--hh-slots/--hh-probe-capacity/--hh-out-capacity "
+                    "size its static blocks) so hot keys stay on their "
+                    "generating rank instead of overloading one "
+                    "receiver."),
+            })
+
+    head = indicators["overflow_headroom"]
+    if head.get("status") == "warn":
+        factor = rec.get("shuffle_capacity_factor") or 1.6
+        tight_sides = [s for s, d in head["sides"].items() if d["low"]]
+        recs.append({
+            "id": "shuffle_headroom",
+            "severity": "warn",
+            "knob": "shuffle_capacity_factor",
+            "flags": [f"--shuffle-capacity-factor {factor * 1.5:g}"],
+            "module": "parallel/distributed_join.py",
+            "message": (
+                f"{'/'.join(tight_sides)} shuffle buckets are within "
+                f"{HEADROOM_RATIO_WARN:.0%} of overflow (tightest "
+                "margin "
+                + ", ".join(
+                    f"{s}={head['sides'][s]['margin_rows_min']} rows"
+                    for s in tight_sides)
+                + ") — raise --shuffle-capacity-factor before the "
+                "next data drift trips auto_retry's recompile."),
+        })
+
+    retry = indicators["retry_ladder"]
+    if retry.get("status") == "warn":
+        sizing = retry.get("final_sizing") or {}
+        flags = [f"--{k.replace('_', '-')} {v:g}" for k, v in
+                 sizing.items()
+                 if k in ("shuffle_capacity_factor",
+                          "out_capacity_factor")]
+        recs.append({
+            "id": "bake_retry_sizing",
+            "severity": "warn",
+            "knob": "out_capacity_factor / shuffle_capacity_factor",
+            "flags": flags or ["--out-capacity-factor",
+                               "--shuffle-capacity-factor"],
+            "module": "parallel/faults.py (CapacityLadder)",
+            "message": (
+                f"the run paid {retry.get('escalations', 0)} overflow "
+                "recompile(s) on the capacity ladder — start from the "
+                "final rung's sizing so production runs compile once."),
+        })
+
+    wire = indicators["wire_efficiency"]
+    if wire.get("status") == "warn":
+        recs.append({
+            "id": "ragged_wire",
+            "severity": "warn",
+            "knob": "shuffle",
+            "flags": ["--shuffle ragged"],
+            "module": "parallel/shuffle.py",
+            "message": (
+                "wire bytes are dominated by static-capacity padding "
+                "(efficiency "
+                + ", ".join(
+                    f"{s}={d.get('efficiency')}"
+                    for s, d in wire["sides"].items()
+                    if "efficiency" in d)
+                + ") — the exact-size ragged exchange ships only real "
+                "rows."),
+        })
+
+    strag = indicators["straggler"]
+    if strag.get("status") == "warn":
+        worst_stage = max(strag["stages"].items(),
+                          key=lambda kv: kv[1]["straggler_index"])
+        recs.append({
+            "id": "over_decompose",
+            "severity": "warn",
+            "knob": "over_decomposition",
+            "flags": ["--over-decomposition-factor 4"],
+            "module": "parallel/distributed_join.py",
+            "message": (
+                f"stage '{worst_stage[0]}' has a straggler (max/mean "
+                f"= {worst_stage[1]['straggler_index']}) — over-"
+                "decompose so each rank's work splits into more, "
+                "smaller batches that interleave around the slow "
+                "rank."),
+        })
+    return recs
+
+
+# -- diagnosis --------------------------------------------------------
+
+
+def diagnose(run: RunData) -> dict:
+    indicators = compute_indicators(run)
+    recs = recommend(indicators, run)
+    sig = baselines.counter_signature(run.metrics)
+    status = ("warn" if any(i.get("status") == "warn"
+                            for i in indicators.values()) else "ok")
+    return {
+        "schema_version": DIAGNOSIS_SCHEMA_VERSION,
+        "run_dir": run.run_dir,
+        "ranks_seen": run.ranks_seen,
+        "n_events": len(run.events),
+        "malformed_lines": run.malformed_lines,
+        "status": status,
+        "indicators": indicators,
+        "recommendations": recs,
+        "signature": sig,
+    }
+
+
+def diagnose_run(run_dir: str, record=None, *, write: bool = True,
+                 print_report: bool = False) -> dict:
+    """Load, diagnose, write ``<run_dir>/diagnosis.json`` (atomic,
+    rank-0 caller's job), optionally print the human report. The
+    drivers' ``--diagnose`` entry point."""
+    run = load_run(run_dir, record=record)
+    diag = diagnose(run)
+    if write:
+        tmp = os.path.join(run_dir, "diagnosis.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(diag, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, os.path.join(run_dir, "diagnosis.json"))
+    if print_report:
+        print(format_report(diag))
+    return diag
+
+
+def format_report(diag: dict) -> str:
+    """The human-readable rendering of a diagnosis."""
+    lines = [
+        f"run: {diag['run_dir']}  "
+        f"[{diag['status'].upper()}]  ranks={diag['ranks_seen']}  "
+        f"events={diag['n_events']}",
+    ]
+    ind = diag["indicators"]
+
+    def head(title, block):
+        lines.append(f"  {title:<18} {block.get('status', '?')}")
+
+    skew = ind["key_skew"]
+    head("key skew", skew)
+    for name, c in (skew.get("counters") or {}).items():
+        lines.append(f"    {name}: gini={c['gini']} "
+                     f"max/mean={c['max_over_mean']}")
+    strag = ind["straggler"]
+    head("stragglers", strag)
+    for name, s in (strag.get("stages") or {}).items():
+        lines.append(f"    {name}: max/mean="
+                     f"{s['straggler_index']}")
+    headr = ind["overflow_headroom"]
+    head("overflow headroom", headr)
+    for side, d in (headr.get("sides") or {}).items():
+        lines.append(
+            f"    {side}: margin_min={d['margin_rows_min']} rows"
+            + (f" ({d['headroom_ratio']:.0%} of avg bucket)"
+               if d.get("headroom_ratio") is not None else ""))
+    wire = ind["wire_efficiency"]
+    head("wire efficiency", wire)
+    for side, d in (wire.get("sides") or {}).items():
+        lines.append(
+            f"    {side}: {d['wire_bytes']} B "
+            f"({d['bytes_per_row']} B/row"
+            + (f", efficiency={d['efficiency']}"
+               if "efficiency" in d else "")
+            + (f", saved={d['saved_vs_fixed_width_bytes']} B"
+               if d.get("saved_vs_fixed_width_bytes") else "") + ")")
+    retry = ind["retry_ladder"]
+    head("retry ladder", retry)
+    if retry.get("escalations"):
+        lines.append(f"    {retry['n_attempts']} attempts, "
+                     f"{retry['escalations']} overflowed; final "
+                     f"sizing {retry.get('final_sizing')}")
+    split = ind["stage_split"]
+    if split.get("spans"):
+        lines.append("  host stage split (s):")
+        for path, st in split["spans"].items():
+            lines.append(f"    {path:<28} {st['total_s']:>10.4f} "
+                         f"x{st['count']}")
+    if diag["recommendations"]:
+        lines.append("  recommendations:")
+        for r in diag["recommendations"]:
+            lines.append(f"    [{r['id']}] {r['message']}")
+            lines.append(f"      knob: {' '.join(r['flags'])}  "
+                         f"({r['module']})")
+    else:
+        lines.append("  no action needed — balanced run, headroom ok")
+    return "\n".join(lines)
+
+
+# -- schema checks (the perfgate lane's artifact validation) ----------
+
+_SUMMARY_REQUIRED = ("telemetry_format_version", "rank", "counters",
+                     "spans", "events")
+_DIAGNOSIS_REQUIRED = ("schema_version", "status", "indicators",
+                       "recommendations", "signature")
+_BASELINE_REQUIRED = ("name", "signature")
+
+
+def check_file(path: str) -> list:
+    """Validate one telemetry artifact by shape; returns a list of
+    problems (empty = valid). Hand-rolled on purpose: no jsonschema
+    dependency in this container."""
+    problems = []
+    try:
+        if path.endswith(".jsonl"):
+            torn = []   # (line_no, error) of unparseable lines
+            with open(path) as f:
+                lines = [(i, ln) for i, ln in enumerate(f, 1)
+                         if ln.strip()]
+            for i, line in lines:
+                try:
+                    ev = json.loads(line)
+                except ValueError as exc:
+                    torn.append((i, exc))
+                    continue
+                if ev.get("kind") not in ("event", "span"):
+                    problems.append(f"line {i}: bad kind "
+                                    f"{ev.get('kind')!r}")
+            # A torn FINAL line is the advertised killed-run artifact
+            # (export.py streams and a kill can land mid-write) —
+            # tolerated, exactly as load_run tolerates it. Torn lines
+            # anywhere else mean real corruption.
+            for i, exc in torn:
+                if not (lines and i == lines[-1][0]):
+                    problems.append(f"line {i}: unparseable: {exc}")
+            return problems
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as exc:
+        return [f"unreadable: {exc}"]
+    name = os.path.basename(path)
+    if isinstance(doc, list) or "traceEvents" in doc or \
+            name.startswith("trace."):
+        # Chrome trace: JSON Object Format, or the equally valid JSON
+        # Array Format (a bare list of events).
+        evs = doc if isinstance(doc, list) else doc.get("traceEvents")
+        if not isinstance(evs, list):
+            return ["traceEvents is not a list"]
+        for i, ev in enumerate(evs):
+            if not isinstance(ev, dict) or \
+                    not {"name", "ph", "ts", "pid"} <= set(ev):
+                problems.append(f"traceEvents[{i}] missing required "
+                                "Chrome-trace keys")
+        return problems
+    if name == "summary.json":
+        required = _SUMMARY_REQUIRED
+    elif name == "diagnosis.json":
+        required = _DIAGNOSIS_REQUIRED
+    elif "signature" in doc:
+        required = _BASELINE_REQUIRED
+    else:
+        return [f"unrecognized artifact (basename {name!r})"]
+    for key in required:
+        if key not in doc:
+            problems.append(f"missing required key {key!r}")
+    if name == "diagnosis.json" and not problems:
+        for ind in ("key_skew", "straggler", "overflow_headroom",
+                    "wire_efficiency", "retry_ladder"):
+            if ind not in doc["indicators"]:
+                problems.append(f"indicators missing {ind!r}")
+    return problems
+
+
+# -- CLI --------------------------------------------------------------
+
+
+def _signature_source(path: str, record_path: Optional[str]):
+    """Resolve a compare/diagnose SOURCE argument: a run directory, a
+    driver record JSON, or a diagnosis.json. Returns (source_for_
+    signature, record_dict_or_None)."""
+    from distributed_join_tpu.benchmarks import load_record
+
+    record = load_record(record_path) if record_path else None
+    if os.path.isdir(path):
+        run = load_run(path, record=record)
+        source = run.metrics
+        if source is None:
+            # No summary.json (non-rank-0 dir copy): fall back to a
+            # previously written diagnosis's signature.
+            dpath = os.path.join(path, "diagnosis.json")
+            if os.path.exists(dpath):
+                with open(dpath) as f:
+                    source = json.load(f)
+        return source, record if record is not None else run.record
+    doc = load_record(path)
+    return doc, record if record is not None else doc
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m distributed_join_tpu.telemetry.analyze",
+        description=__doc__.split("\n")[0],
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    d = sub.add_parser("diagnose",
+                       help="analyze a run dir, write diagnosis.json, "
+                            "print the report")
+    d.add_argument("run_dir")
+    d.add_argument("--record", default=None,
+                   help="driver --json-output record for workload "
+                        "context (v1 records accepted)")
+    d.add_argument("--json", action="store_true",
+                   help="print the diagnosis JSON instead of the "
+                        "human report")
+
+    r = sub.add_parser("report", help="print the report only "
+                                      "(no diagnosis.json written)")
+    r.add_argument("run_dir")
+    r.add_argument("--record", default=None)
+
+    c = sub.add_parser("compare",
+                       help="gate a run's counter signature (and "
+                            "banded wall time) against a baseline; "
+                            "exit 2 on drift/regression")
+    c.add_argument("source",
+                   help="run dir, driver record JSON, or "
+                        "diagnosis.json")
+    c.add_argument("--baseline", required=True,
+                   help="baseline name in the registry (or a path)")
+    c.add_argument("--baseline-dir", default=None,
+                   help=f"registry dir (default "
+                        f"{baselines.DEFAULT_BASELINE_DIR})")
+    c.add_argument("--record", default=None,
+                   help="record JSON supplying the wall time when "
+                        "source is a run dir")
+    c.add_argument("--noise-band", type=float, default=None,
+                   help="wall-time relative band (default: the "
+                        "baseline's, else 0.25)")
+    c.add_argument("--write", action="store_true",
+                   help="write/update the baseline from this run "
+                        "instead of gating")
+    c.add_argument("--with-wall", action="store_true",
+                   help="with --write: also store the record's wall "
+                        "time (hardware sessions only)")
+    c.add_argument("--note", default=None,
+                   help="with --write: free-text provenance note")
+
+    k = sub.add_parser("check",
+                       help="shape-validate telemetry artifacts "
+                            "(summary/diagnosis/baseline/trace/"
+                            "events); exit 1 on any problem")
+    k.add_argument("files", nargs="+")
+
+    args = p.parse_args(argv)
+    try:
+        if args.cmd in ("diagnose", "report"):
+            diag = diagnose_run(args.run_dir, record=args.record,
+                                write=args.cmd == "diagnose",
+                                print_report=not getattr(
+                                    args, "json", False))
+            if getattr(args, "json", False):
+                print(json.dumps(diag, indent=1))
+            return 0
+        if args.cmd == "compare":
+            source, record = _signature_source(args.source, args.record)
+            if args.write:
+                path = baselines.write_baseline(
+                    args.baseline, source,
+                    baseline_dir=args.baseline_dir, record=record,
+                    with_wall=args.with_wall, note=args.note)
+                print(f"baseline written: {path}")
+                return 0
+            baseline = baselines.load_baseline(args.baseline,
+                                               args.baseline_dir)
+            cmp = baselines.compare(baseline, source, record=record,
+                                    noise_band=args.noise_band)
+            print(cmp.format())
+            return 0 if cmp.ok else 2
+        if args.cmd == "check":
+            bad = 0
+            for path in args.files:
+                problems = check_file(path)
+                if problems:
+                    bad += 1
+                    for prob in problems:
+                        print(f"{path}: {prob}")
+                else:
+                    print(f"{path}: OK")
+            return 1 if bad else 0
+    except (OSError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,10 @@
 #   scripts/run_tier1.sh            # full tier-1 (ROADMAP verbatim)
 #   scripts/run_tier1.sh faults     # fast lane: -m faults smoke only
 #   scripts/run_tier1.sh telemetry  # fast lane: -m telemetry smoke only
+#   scripts/run_tier1.sh analysis   # fast lane: -m analysis smoke only
+#   scripts/run_tier1.sh perfgate   # deterministic CPU-mesh join vs.
+#                                   # the committed counter-signature
+#                                   # baseline + artifact schema check
 #
 # Notes:
 # - tests/conftest.py points the persistent XLA compile cache at
@@ -37,8 +41,42 @@ case "$lane" in
       tests/ -q -m telemetry --continue-on-collection-errors \
       -p no:cacheprovider -p no:xdist -p no:randomly
     ;;
+  analysis)
+    # Run-analysis smoke: skew/balanced diagnosis, baseline
+    # round-trip + drift detection, CLI exit codes, bench proxy.
+    exec timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m analysis --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    ;;
+  perfgate)
+    # The perf gate (docs/OBSERVABILITY.md "Diagnosis & baselines"):
+    # one small DETERMINISTIC join on the 8-virtual-device CPU mesh,
+    # its counter signature compared exactly against the committed
+    # baseline (results/baselines/cpu_mesh_smoke.json — re-baseline
+    # intentional changes with `analyze compare ... --write`), plus a
+    # shape check of every artifact the run produced. Wall time is
+    # never gated here: CPU-mesh timings measure emulation, not perf.
+    set -e
+    tmp="$(mktemp -d /tmp/djtpu_perfgate.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.benchmarks.distributed_join \
+      --platform cpu --n-ranks 8 \
+      --build-table-nrows 8000 --probe-table-nrows 8000 \
+      --iterations 1 --shuffle ragged --out-capacity-factor 3.0 \
+      --telemetry "$tmp/tel" --diagnose \
+      --json-output "$tmp/record.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/tel/summary.json" "$tmp/tel/diagnosis.json" \
+      "$tmp/tel/trace.rank0.json" "$tmp/tel/events.rank0.jsonl"
+    # no exec: the EXIT trap must still clean $tmp
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/record.json" --baseline cpu_mesh_smoke
+    exit $?
+    ;;
   *)
-    echo "usage: $0 [tier1|faults|telemetry]" >&2
+    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate]" >&2
     exit 2
     ;;
 esac
