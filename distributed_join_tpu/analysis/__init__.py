@@ -1,0 +1,50 @@
+"""joinlint — static analysis for the SPMD join pipeline.
+
+The all-to-all join is SPMD: every rank must execute the same ordered
+collective sequence, so a collective under rank-dependent Python
+control flow, a hidden host sync inside a timed span, or a host
+callback that only fires on some ranks is a silent deadlock or perf
+bug that tier-1 CPU tests cannot see (they run all 8 virtual ranks in
+one process, where "deadlock" degenerates to a wrong answer or
+nothing at all). This package enforces those invariants as tooling,
+at two levels (docs/STATIC_ANALYSIS.md is the contract):
+
+- **Level 1** (:mod:`.rules` + :mod:`.linter`): an AST linter with
+  repo-specific rules — collective-divergence, hidden-sync,
+  callback-discipline, recompile-hazard, tape-parity, and the
+  unused-symbol sweep. Purely syntactic, no jax import, runs in
+  milliseconds. Deliberate patterns are suppressed in
+  ``suppressions.toml`` (same directory), one reason per entry.
+- **Level 2** (:mod:`.schedule`): a trace-level checker — under the
+  8-virtual-device CPU mesh it traces the key compiled programs
+  (three shuffle modes, the join step with and without metrics, the
+  skew path), extracts each jaxpr's ordered collective schedule, and
+  verifies it against the committed goldens in ``results/schedules/``
+  plus two unconditional invariants: no host-callback primitive in a
+  telemetry-off program, and no ``cond`` whose branches carry
+  different collective sequences.
+
+CLI: ``python -m distributed_join_tpu.analysis.lint`` (the ``lint``
+lane of ``scripts/run_tier1.sh``). Regenerate goldens after an
+intentional schedule change with ``--update-schedules`` — the diff
+then shows up in review, exactly like the counter-signature
+baselines workflow (telemetry/baselines.py).
+"""
+
+from __future__ import annotations
+
+from distributed_join_tpu.analysis.linter import (  # noqa: F401
+    LintResult,
+    Linter,
+    Suppression,
+    load_suppressions,
+)
+from distributed_join_tpu.analysis.rules import (  # noqa: F401
+    ALL_RULES,
+    Finding,
+)
+
+__all__ = [
+    "ALL_RULES", "Finding", "LintResult", "Linter", "Suppression",
+    "load_suppressions",
+]

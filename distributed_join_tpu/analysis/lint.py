@@ -1,0 +1,159 @@
+"""joinlint CLI — ``python -m distributed_join_tpu.analysis.lint``.
+
+Runs both levels (docs/STATIC_ANALYSIS.md):
+
+  python -m distributed_join_tpu.analysis.lint
+      AST rules over the production tree + the jaxpr
+      collective-schedule check against results/schedules/. Exit 0
+      when clean (modulo the committed suppressions), 1 on findings
+      or schedule violations, 2 on configuration errors.
+
+  python -m distributed_join_tpu.analysis.lint --rules-only [PATHS]
+      Level 1 only (no jax import — milliseconds; PATHS default to
+      the production tree).
+
+  python -m distributed_join_tpu.analysis.lint --schedules-only
+      Level 2 only.
+
+  python -m distributed_join_tpu.analysis.lint --update-schedules
+      Re-trace the key programs and rewrite the goldens under
+      results/schedules/ (the baselines-style regen workflow: commit
+      the diff, review sees the schedule change). The unconditional
+      invariants (no callback in a telemetry-off program, no
+      cond-divergent collectives) still gate the regen.
+
+The schedule half forces the 8-virtual-device CPU mesh before any jax
+backend initializes (``benchmarks.force_cpu_platform`` — the same
+seam the drivers' ``--platform cpu`` uses), so the CLI works on any
+host, no TPU required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from distributed_join_tpu.analysis.linter import (
+    DEFAULT_SUPPRESSIONS,
+    DEFAULT_TARGETS,
+    Linter,
+    SuppressionError,
+    load_suppressions,
+)
+
+
+def repo_root() -> str:
+    """The tree joinlint scans by default: the repository holding this
+    package (``analysis/`` -> ``distributed_join_tpu/`` -> root)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_join_tpu.analysis.lint",
+        description="joinlint: SPMD hazard linter + jaxpr "
+                    "collective-schedule checker",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint, relative to the repo "
+                         f"root (default: {' '.join(DEFAULT_TARGETS)})")
+    ap.add_argument("--root", default=None,
+                    help="repo root to scan (default: the repository "
+                         "containing this package)")
+    ap.add_argument("--suppressions", default=None, metavar="TOML",
+                    help="suppression file (default: the committed "
+                         "distributed_join_tpu/analysis/"
+                         "suppressions.toml)")
+    ap.add_argument("--no-suppressions", action="store_true",
+                    help="report every finding, committed "
+                         "suppressions ignored (burn-in mode)")
+    ap.add_argument("--rules-only", action="store_true",
+                    help="level 1 only: AST rules, no jax import")
+    ap.add_argument("--schedules-only", action="store_true",
+                    help="level 2 only: the jaxpr schedule check")
+    ap.add_argument("--update-schedules", action="store_true",
+                    help="re-trace the key programs and rewrite the "
+                         "golden schedules (commit the diff)")
+    ap.add_argument("--schedule-dir", default=None,
+                    help="golden schedule directory (default: "
+                         "results/schedules under the root)")
+    return ap.parse_args(argv)
+
+
+def run_rules(args, root: str) -> int:
+    sup_path = args.suppressions or DEFAULT_SUPPRESSIONS
+    try:
+        sups = ([] if args.no_suppressions
+                else load_suppressions(sup_path))
+    except SuppressionError as exc:
+        print(f"joinlint: bad suppression file: {exc}",
+              file=sys.stderr)
+        return 2
+    linter = Linter(root, suppressions=sups)
+    try:
+        result = linter.run(args.paths or None)
+    except FileNotFoundError as exc:
+        print(f"joinlint: {exc}", file=sys.stderr)
+        return 2
+    for f in result.findings:
+        print(f.format())
+    n = len(result.findings)
+    print(f"joinlint rules: {n} finding(s) in "
+          f"{result.files_checked} file(s)"
+          + (f", {len(result.suppressed)} suppressed"
+             if result.suppressed else ""))
+    # Dead suppressions rot; surface them (a note, not a failure —
+    # a partial-path lint run legitimately misses some).
+    if not args.paths and not args.no_suppressions:
+        for s in result.unused_suppressions:
+            print(f"joinlint: note: suppression at {s.origin} "
+                  f"({s.rule} {s.path}) matched nothing",
+                  file=sys.stderr)
+    return 1 if result.findings else 0
+
+
+def run_schedules(args, root: str) -> int:
+    # Force the 8-virtual-device CPU mesh BEFORE any backend
+    # initializes — the one blessed seam for that.
+    from distributed_join_tpu.benchmarks import force_cpu_platform
+
+    force_cpu_platform(8)
+    from distributed_join_tpu.analysis.schedule import (
+        DEFAULT_SCHEDULE_DIR,
+        check_schedules,
+    )
+
+    sched_dir = args.schedule_dir or os.path.join(
+        root, DEFAULT_SCHEDULE_DIR)
+    violations, schedules = check_schedules(
+        schedule_dir=sched_dir, update=args.update_schedules)
+    for v in violations:
+        print(f"joinlint schedule: {v}")
+    verb = "updated" if args.update_schedules else "checked"
+    print(f"joinlint schedules: {len(schedules)} program(s) {verb}, "
+          f"{len(violations)} violation(s)")
+    return 1 if violations else 0
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.rules_only and (args.schedules_only
+                            or args.update_schedules):
+        print("joinlint: --rules-only excludes the schedule flags",
+              file=sys.stderr)
+        return 2
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    rc = 0
+    if not args.schedules_only and not args.update_schedules:
+        rc = run_rules(args, root)
+        if rc == 2:
+            return rc
+    if not args.rules_only:
+        rc = max(rc, run_schedules(args, root))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

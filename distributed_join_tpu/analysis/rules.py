@@ -1,0 +1,780 @@
+"""Level-1 joinlint rules: AST-level SPMD hazard detection.
+
+Every rule encodes an invariant the rest of the repo only documents
+(docs/STATIC_ANALYSIS.md has the full catalog with examples):
+
+- DJL001 collective-divergence — a collective (``all_to_all``,
+  ``all_gather``, ``ragged_all_to_all``, ``ppermute``, ``psum``...)
+  reachable under a rank-dependent Python branch, or after a
+  rank-dependent early exit. SPMD requires every rank to issue the
+  identical collective sequence; divergence deadlocks real hardware.
+- DJL002 hidden-sync — ``block_until_ready``/``device_get``/
+  ``.item()``/``int()``/``float()``/``np.asarray`` on traced values
+  inside a ``telemetry.span`` region. Spans time host intervals; a
+  hidden device sync inside one silently bills device completion to
+  whatever span happens to be open (the honest protocol is
+  ``sp.sync_on(scalar)`` — telemetry/spans.py).
+- DJL003 callback-discipline — ``pure_callback``/``io_callback``
+  outside the sanctioned ``parallel/faults.py``/``telemetry/`` seams,
+  and callback target functions that can raise: an exception inside a
+  backend callback poisons the process-wide dispatch stream (see
+  ``faults._plan_check_host``, which returns an error token instead).
+- DJL004 recompile-hazard — ``int()``/``float()`` over a ``jnp``/
+  ``lax`` reduction (an array-derived Python scalar: a host sync that
+  also retraces per value when it flows into a static shape), and
+  list/dict literals passed as jit static arguments (unhashable —
+  cache miss or TypeError).
+- DJL005 tape-parity — a function taking ``tape=``/``with_metrics=``
+  must guard every tape method call so telemetry-off compiles the
+  exact seed program (the parity contract of docs/OBSERVABILITY.md).
+- DJL006 unused-symbol — unused and duplicate imports (dead code the
+  other rules' taint passes would otherwise chase for nothing).
+
+Rules are deliberately narrow: a lint finding here should be worth a
+human's time, and deliberate patterns are suppressed WITH A REASON in
+``analysis/suppressions.toml`` rather than widening the rules until
+they see nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, List, Optional
+
+# Rank-dependent value sources: anything derived from these diverges
+# across ranks/processes.
+RANK_SOURCES = {
+    "axis_index", "process_id", "process_index", "is_coordinator",
+}
+# The collective callees of this repo's Communicator seam + jax.lax.
+COLLECTIVE_CALLEES = {
+    "all_to_all", "all_gather", "ragged_all_to_all", "ppermute",
+    "ppermute_all_to_all", "psum", "pbroadcast", "reduce_scatter",
+}
+SYNC_CALLEES = {"block_until_ready", "device_get"}
+CALLBACK_CALLEES = {"pure_callback", "io_callback", "debug_callback"}
+# Roots whose calls produce traced arrays (for the hidden-sync taint).
+TRACED_ROOTS = {"jnp", "lax"}
+JNP_REDUCERS = {
+    "max", "min", "sum", "prod", "argmax", "argmin", "count_nonzero",
+}
+NP_ROOTS = {"np", "numpy"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, anchored to a repo-relative path + line."""
+
+    rule: str       # "DJL00x"
+    name: str       # "collective-divergence"
+    path: str       # repo-relative, posix separators
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.name}] " \
+               f"{self.message}"
+
+
+@dataclasses.dataclass
+class ParsedModule:
+    """One parsed source file, parent-annotated (see
+    :func:`annotate_parents`)."""
+
+    path: str
+    tree: ast.Module
+
+
+# -- AST helpers ------------------------------------------------------
+
+
+def annotate_parents(tree: ast.AST) -> None:
+    """Attach ``_djl_parent`` to every node so rules can walk UP."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._djl_parent = node  # type: ignore[attr-defined]
+
+
+def parents(node: ast.AST) -> Iterator[ast.AST]:
+    while True:
+        node = getattr(node, "_djl_parent", None)
+        if node is None:
+            return
+        yield node
+
+
+def dotted(expr) -> Optional[str]:
+    """Best-effort dotted name of an expression: ``comm.all_to_all``,
+    ``jnp.sum``; for a chain rooted in a call (``f().attr``) only the
+    attribute tail is returned."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = dotted(expr.value)
+        return f"{base}.{expr.attr}" if base else expr.attr
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def last_seg(name: Optional[str]) -> Optional[str]:
+    return None if name is None else name.rsplit(".", 1)[-1]
+
+
+def first_seg(name: Optional[str]) -> Optional[str]:
+    return None if name is None else name.split(".", 1)[0]
+
+
+def enclosing_function(node: ast.AST):
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return p
+    return None
+
+
+def outermost_scopes(tree: ast.Module) -> List[ast.AST]:
+    """Top-level function scopes (methods of top-level classes count —
+    their enclosing *function* is None)."""
+    return [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and enclosing_function(n) is None
+    ]
+
+
+# Attributes that are Python-static even on a traced object: reading
+# them off a tainted value yields host data, so they must not
+# propagate taint (Table.capacity is THE case: an int property of a
+# traced table, used in host capacity math everywhere).
+STATIC_ATTRS = {
+    "capacity", "shape", "ndim", "dtype", "itemsize", "size",
+    "n_ranks", "column_names", "name",
+}
+
+
+def _taint_carrier(n: ast.AST, tainted: set) -> bool:
+    """``n`` is a Name occurrence that carries taint — tainted, and
+    not merely the base of a static-attribute read."""
+    if not (isinstance(n, ast.Name) and n.id in tainted):
+        return False
+    parent = getattr(n, "_djl_parent", None)
+    if isinstance(parent, ast.Attribute) and parent.value is n \
+            and parent.attr in STATIC_ATTRS:
+        return False
+    return True
+
+
+def tainted_names(scope: ast.AST, is_source) -> set:
+    """Names in ``scope`` (nested functions included — closures taint
+    through) assigned, directly or transitively, from an expression
+    containing a source node. Fixpoint over simple assignments — no
+    attribute/subscript tracking, which keeps false positives near
+    zero at the cost of under-approximating (a linter's right
+    trade)."""
+    tainted: set = set()
+
+    def value_tainted(expr) -> bool:
+        for n in ast.walk(expr):
+            if _taint_carrier(n, tainted):
+                return True
+            if is_source(n):
+                return True
+        return False
+
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                if node.value is None:
+                    continue
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.NamedExpr):
+                targets, value = [node.target], node.value
+            else:
+                continue
+            if not value_tainted(value):
+                continue
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name) and n.id not in tainted:
+                        tainted.add(n.id)
+                        changed = True
+    return tainted
+
+
+def _is_rank_source(node) -> bool:
+    return (isinstance(node, ast.Call)
+            and last_seg(call_name(node)) in RANK_SOURCES)
+
+
+def _is_traced_source(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    return (first_seg(name) in TRACED_ROOTS
+            or last_seg(name) in COLLECTIVE_CALLEES)
+
+
+def _mentions(expr, names: set, also_sources=None) -> bool:
+    for n in ast.walk(expr):
+        if _taint_carrier(n, names):
+            return True
+        if also_sources is not None and also_sources(n):
+            return True
+    return False
+
+
+def _has_early_exit(body_nodes) -> bool:
+    for stmt in body_nodes:
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.Return, ast.Raise, ast.Continue,
+                              ast.Break)):
+                # Exits inside nested defs execute later, elsewhere.
+                if enclosing_function(n) is enclosing_function(stmt):
+                    return True
+    return False
+
+
+# -- DJL001 collective-divergence -------------------------------------
+
+
+class CollectiveDivergence:
+    id = "DJL001"
+    name = "collective-divergence"
+
+    def run(self, mod: ParsedModule) -> Iterator[Finding]:
+        for scope in outermost_scopes(mod.tree):
+            tainted = tainted_names(scope, _is_rank_source)
+
+            def rank_dep(expr) -> bool:
+                return _mentions(expr, tainted,
+                                 also_sources=_is_rank_source)
+
+            collectives = [
+                n for n in ast.walk(scope)
+                if isinstance(n, ast.Call)
+                and last_seg(call_name(n)) in COLLECTIVE_CALLEES
+            ]
+            for call in collectives:
+                cname = last_seg(call_name(call))
+                prev = call
+                hit = None
+                for anc in parents(call):
+                    if anc is scope:
+                        break
+                    if isinstance(anc, (ast.If, ast.While)) \
+                            and prev is not anc.test \
+                            and rank_dep(anc.test):
+                        hit = anc.test
+                    elif isinstance(anc, ast.IfExp) \
+                            and prev is not anc.test \
+                            and rank_dep(anc.test):
+                        hit = anc.test
+                    elif isinstance(anc, ast.For) \
+                            and prev is not anc.iter \
+                            and rank_dep(anc.iter):
+                        hit = anc.iter
+                    if hit is not None:
+                        break
+                    prev = anc
+                if hit is not None:
+                    yield Finding(
+                        self.id, self.name, mod.path, call.lineno,
+                        f"collective {cname}() under a rank-dependent "
+                        f"branch (condition at line {hit.lineno}) — "
+                        "SPMD ranks would issue different collective "
+                        "sequences and deadlock",
+                    )
+
+            # Rank-dependent early exit with collectives issued after
+            # it: the exiting rank skips them, every other rank blocks.
+            for iff in ast.walk(scope):
+                if not isinstance(iff, ast.If) or not rank_dep(iff.test):
+                    continue
+                if not (_has_early_exit(iff.body)
+                        or _has_early_exit(iff.orelse)):
+                    continue
+                fn = enclosing_function(iff)
+                for call in collectives:
+                    if enclosing_function(call) is not fn:
+                        continue
+                    if call.lineno <= iff.lineno:
+                        continue
+                    if any(a is iff for a in parents(call)):
+                        continue  # inside the if itself: handled above
+                    yield Finding(
+                        self.id, self.name, mod.path, call.lineno,
+                        f"collective {last_seg(call_name(call))}() is "
+                        f"reachable after a rank-dependent early exit "
+                        f"(line {iff.lineno}) — exiting ranks skip it "
+                        "while the rest block in it",
+                    )
+
+
+# -- DJL002 hidden-sync -----------------------------------------------
+
+
+def _span_withs(tree: ast.Module) -> List[ast.With]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Call) \
+                    and last_seg(call_name(ctx)) in ("span",
+                                                     "span_scope"):
+                out.append(node)
+                break
+    return out
+
+
+def _span_label(with_node: ast.With) -> str:
+    for item in with_node.items:
+        ctx = item.context_expr
+        if isinstance(ctx, ast.Call) and ctx.args:
+            a = ctx.args[0]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                return a.value
+    return "?"
+
+
+class HiddenSync:
+    id = "DJL002"
+    name = "hidden-sync"
+
+    def run(self, mod: ParsedModule) -> Iterator[Finding]:
+        for w in _span_withs(mod.tree):
+            scope = enclosing_function(w) or mod.tree
+            tainted = tainted_names(scope, _is_traced_source)
+            label = _span_label(w)
+            seen = set()
+            for node in ast.walk(w):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = self._classify(node, tainted)
+                if f and (node.lineno, f) not in seen:
+                    seen.add((node.lineno, f))
+                    yield Finding(
+                        self.id, self.name, mod.path, node.lineno,
+                        f"{f} inside span '{label}' — a hidden device "
+                        "sync mis-bills device completion to the span; "
+                        "register the completion scalar with "
+                        "sp.sync_on(...) instead (telemetry/spans.py)",
+                    )
+
+    def _classify(self, call: ast.Call, tainted) -> Optional[str]:
+        name = call_name(call)
+        seg = last_seg(name)
+        if seg in SYNC_CALLEES:
+            return f"{seg}()"
+        if seg == "item" and not call.args and not call.keywords \
+                and isinstance(call.func, ast.Attribute):
+            return ".item()"
+        arg = call.args[0] if len(call.args) == 1 else None
+        if arg is None:
+            return None
+
+        def arg_traced() -> bool:
+            return _mentions(arg, tainted,
+                             also_sources=_is_traced_source)
+
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in ("int", "float", "bool") \
+                and arg_traced():
+            return f"{call.func.id}() on a traced value"
+        if first_seg(name) in NP_ROOTS \
+                and seg in ("asarray", "array") and arg_traced():
+            return f"{name}() on a traced value"
+        return None
+
+
+# -- DJL003 callback-discipline ---------------------------------------
+
+
+SANCTIONED_CALLBACK_FILES = (
+    "distributed_join_tpu/parallel/faults.py",
+)
+SANCTIONED_CALLBACK_DIRS = (
+    "distributed_join_tpu/telemetry/",
+)
+
+
+class CallbackDiscipline:
+    id = "DJL003"
+    name = "callback-discipline"
+
+    def run(self, mod: ParsedModule) -> Iterator[Finding]:
+        sanctioned = (
+            mod.path in SANCTIONED_CALLBACK_FILES
+            or mod.path.startswith(SANCTIONED_CALLBACK_DIRS)
+        )
+        funcs = {
+            n.name: n for n in ast.walk(mod.tree)
+            if isinstance(n, ast.FunctionDef)
+        }
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = last_seg(call_name(node))
+            if seg not in CALLBACK_CALLEES:
+                continue
+            if not sanctioned:
+                yield Finding(
+                    self.id, self.name, mod.path, node.lineno,
+                    f"{seg}() outside the sanctioned faults/telemetry "
+                    "seams — host callbacks in the join hot path break "
+                    "the no-callbacks-in-jit contract "
+                    "(docs/OBSERVABILITY.md) and can differ across "
+                    "ranks",
+                )
+                continue
+            target = self._callback_target(node, funcs)
+            if target is not None and self._may_raise(target):
+                yield Finding(
+                    self.id, self.name, mod.path, node.lineno,
+                    f"callback target {target.name}() can raise — an "
+                    "exception inside a backend callback poisons the "
+                    "process-wide dispatch stream; record and return "
+                    "an error token instead (faults._plan_check_host "
+                    "is the documented pattern)",
+                )
+
+    def _callback_target(self, call: ast.Call, funcs):
+        if not call.args:
+            return None
+        tgt = call.args[0]
+        if isinstance(tgt, ast.Call) \
+                and last_seg(call_name(tgt)) == "partial" and tgt.args:
+            tgt = tgt.args[0]
+        if isinstance(tgt, ast.Name):
+            return funcs.get(tgt.id)
+        return None
+
+    def _may_raise(self, fn: ast.FunctionDef) -> bool:
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Raise):
+                continue
+            if enclosing_function(n) is not fn:
+                continue
+            guarded = False
+            chain = [n, *parents(n)]
+            for i, p in enumerate(chain):
+                if p is fn:
+                    break
+                if isinstance(p, ast.Try) and p.handlers and i > 0:
+                    # A raise in the try BODY is caught; one in a
+                    # handler/else/finally escapes the Try.
+                    if chain[i - 1] in p.body:
+                        guarded = True
+                    break
+            if not guarded:
+                return True
+        return False
+
+
+# -- DJL004 recompile-hazard ------------------------------------------
+
+
+class RecompileHazard:
+    id = "DJL004"
+    name = "recompile-hazard"
+
+    def run(self, mod: ParsedModule) -> Iterator[Finding]:
+        yield from self._scalar_pulls(mod)
+        yield from self._unhashable_statics(mod)
+
+    def _scalar_pulls(self, mod) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in ("int", "float")
+                    and len(node.args) == 1):
+                continue
+            for sub in ast.walk(node.args[0]):
+                if isinstance(sub, ast.Call) \
+                        and first_seg(call_name(sub)) in TRACED_ROOTS \
+                        and last_seg(call_name(sub)) in JNP_REDUCERS:
+                    yield Finding(
+                        self.id, self.name, mod.path, node.lineno,
+                        f"{node.func.id}({call_name(sub)}(...)) pulls "
+                        "an array-derived Python scalar: a device "
+                        "sync, and a retrace per distinct value when "
+                        "it flows into a static shape/capacity",
+                    )
+                    break
+
+    def _static_spec(self, call: ast.Call):
+        """(static positions, static names) declared by one jit-ish
+        call's keywords; None when it declares none."""
+        pos, names = set(), set()
+        for kw in call.keywords:
+            vals = []
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                vals = [e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)]
+            elif isinstance(kw.value, ast.Constant):
+                vals = [kw.value.value]
+            if kw.arg == "static_argnums":
+                pos.update(v for v in vals if isinstance(v, int))
+            elif kw.arg == "static_argnames":
+                names.update(v for v in vals if isinstance(v, str))
+        return (pos, names) if (pos or names) else None
+
+    def _jit_call_spec(self, call) -> Optional[tuple]:
+        """Static spec of ``jax.jit(...)`` or ``partial(jax.jit, ...)``
+        (the decorator idiom) — None for anything else."""
+        if not isinstance(call, ast.Call):
+            return None
+        seg = last_seg(call_name(call))
+        if seg == "jit":
+            return self._static_spec(call)
+        if seg == "partial" and call.args \
+                and last_seg(dotted(call.args[0])) == "jit":
+            return self._static_spec(call)
+        return None
+
+    def _unhashable_statics(self, mod) -> Iterator[Finding]:
+        # Both jit idioms: `fn = jax.jit(f, static_*=...)` and the
+        # decorator form `@partial(jax.jit, static_*=...)` / `@jax.jit(
+        # static_*=...)` on a def.
+        jitted = {}   # local name -> (set of positions, set of names)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                spec = self._jit_call_spec(node.value)
+                if spec is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            jitted[t.id] = spec
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    spec = self._jit_call_spec(dec)
+                    if spec is not None:
+                        jitted[node.name] = spec
+        if not jitted:
+            return
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in jitted):
+                continue
+            pos, names = jitted[node.func.id]
+            bad = []
+            bad += [a for i, a in enumerate(node.args) if i in pos
+                    and isinstance(a, (ast.List, ast.Dict, ast.Set))]
+            bad += [kw.value for kw in node.keywords
+                    if kw.arg in names
+                    and isinstance(kw.value,
+                                   (ast.List, ast.Dict, ast.Set))]
+            for a in bad:
+                yield Finding(
+                    self.id, self.name, mod.path, a.lineno,
+                    f"list/dict/set literal passed as a static "
+                    f"argument of jitted {node.func.id}() — static "
+                    "args must be hashable (pass a tuple)",
+                )
+
+
+# -- DJL005 tape-parity -----------------------------------------------
+
+
+TAPE_METHODS = {"add", "record_min", "scoped", "gathered"}
+
+
+class TapeParity:
+    id = "DJL005"
+    name = "tape-parity"
+
+    def run(self, mod: ParsedModule) -> Iterator[Finding]:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            tape_like = {
+                a.arg for a in (fn.args.args + fn.args.kwonlyargs)
+                if a.arg == "tape"
+            }
+            has_with_metrics = any(
+                a.arg == "with_metrics"
+                for a in fn.args.args + fn.args.kwonlyargs
+            )
+            for node in fn.body:
+                for sub in ast.walk(node):
+                    if enclosing_function(sub) is not fn:
+                        continue
+                    if isinstance(sub, ast.Assign) \
+                            and self._guarded_tape_expr(sub.value):
+                        for t in sub.targets:
+                            if isinstance(t, ast.Name):
+                                tape_like.add(t.id)
+                    elif (isinstance(sub, ast.Assign)
+                          and has_with_metrics
+                          and self._bare_tape_ctor(sub.value)):
+                        yield Finding(
+                            self.id, self.name, mod.path, sub.lineno,
+                            "MetricsTape constructed unconditionally "
+                            "in a function taking with_metrics= — "
+                            "telemetry-off would no longer compile "
+                            "the seed program (guard with `... if "
+                            "with_metrics else None`)",
+                        )
+            if not tape_like:
+                continue
+            guards = tape_like | {"with_metrics"}
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in TAPE_METHODS
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in tape_like):
+                    continue
+                if not self._guarded(node, fn, guards):
+                    yield Finding(
+                        self.id, self.name, mod.path, node.lineno,
+                        f"unguarded {node.func.value.id}."
+                        f"{node.func.attr}(...) — tape may be None "
+                        "(telemetry off); guard with `if "
+                        f"{node.func.value.id} is not None:` so "
+                        "telemetry-off stays the seed program",
+                    )
+
+    def _guarded_tape_expr(self, value) -> bool:
+        """``X if <cond> else None`` where X builds/derives a tape."""
+        if not (isinstance(value, ast.IfExp)
+                and isinstance(value.orelse, ast.Constant)
+                and value.orelse.value is None):
+            return False
+        for n in ast.walk(value.body):
+            if isinstance(n, ast.Call) and last_seg(call_name(n)) in (
+                    "MetricsTape", "scoped"):
+                return True
+        return False
+
+    def _bare_tape_ctor(self, value) -> bool:
+        return (isinstance(value, ast.Call)
+                and last_seg(call_name(value)) == "MetricsTape")
+
+    def _guarded(self, call, fn, guard_names) -> bool:
+        prev = call
+        for anc in parents(call):
+            if anc is fn:
+                return False
+            if isinstance(anc, (ast.If, ast.IfExp)) \
+                    and prev is not anc.test \
+                    and _mentions(anc.test, guard_names):
+                return True
+            prev = anc
+        return False
+
+
+# -- DJL006 unused-symbol ---------------------------------------------
+
+
+class UnusedSymbol:
+    id = "DJL006"
+    name = "unused-symbol"
+
+    def run(self, mod: ParsedModule) -> Iterator[Finding]:
+        is_init = mod.path.endswith("__init__.py")
+        exported = self._dunder_all(mod.tree)
+        # imports per scope (module or the function they live in)
+        scopes: dict = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            if isinstance(node, ast.ImportFrom) \
+                    and node.module == "__future__":
+                continue
+            scope = enclosing_function(node) or mod.tree
+            scopes.setdefault(id(scope), (scope, []))[1].append(node)
+        for scope, imports in scopes.values():
+            imports.sort(key=lambda n: n.lineno)
+            used = {
+                n.id for n in ast.walk(scope)
+                if isinstance(n, ast.Name)
+            }
+            used |= self._string_annotation_names(scope)
+            bound: dict = {}
+            for imp in imports:
+                for alias in imp.names:
+                    if alias.name == "*":
+                        continue
+                    name = alias.asname or alias.name.split(".")[0]
+                    in_try = any(isinstance(p, ast.Try)
+                                 for p in parents(imp))
+                    if name in bound and not in_try \
+                            and not bound[name][1]:
+                        yield Finding(
+                            self.id, self.name, mod.path, imp.lineno,
+                            f"duplicate import of {name!r} (first "
+                            f"bound at line {bound[name][0]}) — one "
+                            "of the two is dead, or one shadows the "
+                            "other",
+                        )
+                    else:
+                        bound[name] = (imp.lineno, in_try)
+                    if is_init or name in exported:
+                        continue  # re-export idiom
+                    if name not in used:
+                        yield Finding(
+                            self.id, self.name, mod.path, imp.lineno,
+                            f"import {name!r} is never used in its "
+                            "scope",
+                        )
+
+    def _string_annotation_names(self, scope) -> set:
+        """Identifier tokens inside STRING annotations (forward refs
+        like ``Optional["KernelConfig"]`` never appear as Name
+        nodes)."""
+        import re as _re
+
+        anns = []
+        for n in ast.walk(scope):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                anns.extend(a.annotation
+                            for a in n.args.args + n.args.kwonlyargs
+                            if a.annotation is not None)
+                if n.returns is not None:
+                    anns.append(n.returns)
+            elif isinstance(n, ast.AnnAssign):
+                anns.append(n.annotation)
+        out: set = set()
+        for ann in anns:
+            for c in ast.walk(ann):
+                if isinstance(c, ast.Constant) \
+                        and isinstance(c.value, str):
+                    out.update(_re.findall(r"[A-Za-z_]\w*", c.value))
+        return out
+
+    def _dunder_all(self, tree) -> set:
+        out: set = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "__all__"
+                            for t in node.targets) \
+                    and isinstance(node.value, (ast.List, ast.Tuple)):
+                out.update(
+                    e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                )
+        return out
+
+
+ALL_RULES = (
+    CollectiveDivergence(),
+    HiddenSync(),
+    CallbackDiscipline(),
+    RecompileHazard(),
+    TapeParity(),
+    UnusedSymbol(),
+)

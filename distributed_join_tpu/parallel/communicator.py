@@ -34,7 +34,6 @@ mesh, with row-sharded inputs/outputs; the orchestrator
 from __future__ import annotations
 
 import abc
-from functools import partial
 from typing import Callable
 
 import jax
@@ -43,7 +42,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_join_tpu import compat
-from distributed_join_tpu.parallel.mesh import RANK_AXIS, make_mesh
+from distributed_join_tpu.parallel.mesh import make_mesh
 
 
 class Communicator(abc.ABC):

@@ -20,11 +20,10 @@ thread.
 from __future__ import annotations
 
 import time
+import warnings
 from typing import Callable, Optional, Tuple
 
 import numpy as np
-
-import jax.numpy as jnp
 
 from distributed_join_tpu import telemetry
 from distributed_join_tpu.parallel.communicator import Communicator
@@ -256,8 +255,6 @@ def batched_join_host(
         completed = {b: v for b, v in manifest.completed.items()
                      if not v["overflow"]}
         if completed and on_batch_result is not None:
-            import warnings
-
             warnings.warn(
                 "resuming from a manifest: on_batch_result will not "
                 f"be called for already-completed batches "
@@ -504,8 +501,6 @@ def batched_join_host(
     # `pending` above, so `completed` carries no overflow.
     total += sum(v["total"] for v in completed.values())
     if failed and stats is None:
-        import warnings
-
         warnings.warn(
             f"on_batch_failure='continue': batches {sorted(failed)} "
             "were abandoned and the returned total is PARTIAL — pass "

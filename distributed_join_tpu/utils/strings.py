@@ -24,7 +24,7 @@ key; the byte column is reconstructed exactly on output.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Sequence
 
 import numpy as np
 

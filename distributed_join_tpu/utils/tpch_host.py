@@ -30,7 +30,7 @@ bandwidth. This is the out-of-core analog of predicate pushdown.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import numpy as np
 

@@ -143,7 +143,7 @@ def main():
         make_distributed_join, make_join_step,
     )
     from distributed_join_tpu.utils.benchmarking import (
-        measure, timed_join_throughput,
+        timed_join_throughput,
     )
     from distributed_join_tpu.utils.generators import (
         generate_build_probe_tables,

@@ -4,8 +4,6 @@ via roll, reverse via flip both axes, dynamic flat shift)."""
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -13,16 +13,12 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 import distributed_join_tpu  # noqa: F401
 from distributed_join_tpu.ops import join as J
 from distributed_join_tpu.ops.compact_pallas import stream_compact
-from distributed_join_tpu.ops.expand_pallas import (
-    build_windows_ok,
-    expand_gather,
-)
+from distributed_join_tpu.ops.expand_pallas import expand_gather
 from distributed_join_tpu.ops.scan_pallas import join_scans
 from distributed_join_tpu.utils.generators import (
     generate_build_probe_tables,

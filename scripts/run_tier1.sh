@@ -10,6 +10,9 @@
 #   scripts/run_tier1.sh perfgate   # deterministic CPU-mesh join vs.
 #                                   # the committed counter-signature
 #                                   # baseline + artifact schema check
+#   scripts/run_tier1.sh lint       # joinlint: AST SPMD-hazard rules
+#                                   # + jaxpr collective-schedule check
+#                                   # vs results/schedules/ goldens
 #
 # Notes:
 # - tests/conftest.py points the persistent XLA compile cache at
@@ -75,8 +78,22 @@ case "$lane" in
       "$tmp/record.json" --baseline cpu_mesh_smoke
     exit $?
     ;;
+  lint)
+    # Static analysis (docs/STATIC_ANALYSIS.md): level-1 AST rules
+    # over the production tree (exit nonzero on any finding not in
+    # the committed suppressions) + level-2 jaxpr collective-schedule
+    # check against results/schedules/ (re-baseline intentional
+    # schedule changes with `analysis.lint --update-schedules`).
+    # DJTPU_VALIDATE_PLANS is cleared: the gate checks the SHIPPING
+    # trace, and the debug seam's callback would (correctly) fail the
+    # telemetry-off no-callback invariant.
+    exec timeout -k 10 600 env -u DJTPU_VALIDATE_PLANS \
+      JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.analysis.lint
+    ;;
   *)
-    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate]" >&2
+    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint]" >&2
     exit 2
     ;;
 esac

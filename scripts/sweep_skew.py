@@ -28,7 +28,6 @@ from distributed_join_tpu.benchmarks import add_platform_arg, apply_platform
 
 def on_chip_overhead(report):
     import jax
-    import jax.numpy as jnp
 
     import distributed_join_tpu as dj
     from distributed_join_tpu.parallel.distributed_join import (

@@ -1,0 +1,11 @@
+"""Known-bad joinlint fixture: DJL003 callback-discipline.
+
+Never executed — parsed by tests/test_lint.py. A host callback
+outside the sanctioned faults/telemetry seams.
+"""
+
+import jax
+
+
+def hot_path_peek(x):
+    return jax.pure_callback(lambda v: v, x, x)

@@ -1,0 +1,28 @@
+"""Known-bad joinlint fixture: DJL001 collective-divergence.
+
+Never executed — parsed by tests/test_lint.py. Both hazard shapes:
+a collective lexically under a rank-dependent branch, and a
+collective reachable after a rank-dependent early exit.
+"""
+
+
+def branch_divergence(comm, x):
+    me = comm.axis_index()
+    if me == 0:
+        x = comm.all_to_all(x)  # only rank 0 issues it: deadlock
+    return x
+
+
+def early_exit_divergence(comm, x):
+    if comm.axis_index() == 0:
+        return x  # rank 0 leaves; everyone else blocks below
+    return comm.all_gather(x)
+
+
+def transitive_taint(comm, x):
+    me = comm.axis_index()
+    leader = me == 0
+    while leader:
+        x = comm.psum(x)  # taint flows me -> leader -> the loop test
+        leader = False
+    return x

@@ -1,0 +1,18 @@
+"""Known-bad joinlint fixture: DJL002 hidden-sync.
+
+Never executed — parsed by tests/test_lint.py. Host syncs inside a
+telemetry span bill device completion to whatever span is open.
+"""
+
+import jax.numpy as jnp
+
+from distributed_join_tpu import telemetry
+
+
+def timed_shuffle(arr):
+    with telemetry.span("shuffle"):
+        total = jnp.sum(arr)
+        host = float(total)        # pulls the scalar inside the span
+        arr.block_until_ready()    # bare sync inside the span
+        snap = jnp.asarray(total).item()
+    return host, snap

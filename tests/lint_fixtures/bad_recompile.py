@@ -1,0 +1,37 @@
+"""Known-bad joinlint fixture: DJL004 recompile-hazard.
+
+Never executed — parsed by tests/test_lint.py. Both hazard shapes:
+an array-derived Python scalar, and an unhashable static argument.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def capacity_of(counts):
+    # A device sync AND a retrace per distinct value once it flows
+    # into a static capacity.
+    return int(jnp.max(counts))
+
+
+def _kernel(widths, x):
+    return x
+
+
+fn = jax.jit(_kernel, static_argnums=(0,))
+
+
+def run(x):
+    return fn([8, 16], x)  # list literal as a static arg: unhashable
+
+
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("caps",))
+def decorated_kernel(x, caps=None):
+    return x
+
+
+def run_decorated(x):
+    return decorated_kernel(x, caps=[8, 16])  # same hazard, decorator form

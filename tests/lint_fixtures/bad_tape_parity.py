@@ -1,0 +1,25 @@
+"""Known-bad joinlint fixture: DJL005 tape-parity.
+
+Never executed — parsed by tests/test_lint.py. Unguarded tape use
+and an unconditional tape construction: telemetry-off would either
+crash (tape is None) or stop compiling the seed program.
+"""
+
+from distributed_join_tpu.telemetry import MetricsTape
+
+
+def shuffle(comm, x, tape=None):
+    y = comm.all_to_all(x)
+    tape.add("rows_shuffled", 1)  # crashes when telemetry is off
+    return y
+
+
+def make_step(comm, with_metrics=False):
+    tape = MetricsTape()  # built even when with_metrics is False
+
+    def step(x):
+        if tape is not None:
+            tape.add("rows", 1)
+        return x
+
+    return step

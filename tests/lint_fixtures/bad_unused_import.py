@@ -1,0 +1,11 @@
+"""Known-bad joinlint fixture: DJL006 unused-symbol.
+
+Never executed — parsed by tests/test_lint.py. One dead import, one
+duplicate.
+"""
+
+import os
+import sys  # never referenced
+import os  # duplicate binding of 'os'
+
+CWD = os.getcwd()

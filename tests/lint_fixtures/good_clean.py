@@ -1,0 +1,45 @@
+"""Known-good joinlint fixture: the sanctioned twin of every bad
+fixture — near-miss patterns that must stay clean.
+
+Never executed — parsed by tests/test_lint.py.
+"""
+
+import jax.numpy as jnp
+
+from distributed_join_tpu import telemetry
+
+
+def step(comm, x, tape=None):
+    me = comm.axis_index()
+    y = comm.all_to_all(x)  # unconditional collective: fine
+    # Rank-dependent VALUES are fine — only control flow diverges.
+    shifted = jnp.where(me == 0, y, x)
+    if tape is not None:
+        tape.add("rows_shuffled", 1)  # guarded tape use
+    return shifted
+
+
+def make_step(comm, with_metrics=False):
+    tape = telemetry.MetricsTape() if with_metrics else None
+
+    def inner(x):
+        t = tape.scoped("build") if tape is not None else None
+        if tape is not None:
+            tape.add("rows", 1)
+        return comm.psum(x), t
+
+    return inner
+
+
+def timed_fetch(arr):
+    with telemetry.span("fetch") as sp:
+        sp.sync_on(arr)  # the honest sync: one scalar, at span close
+    # Host capacity math on static attributes never taints.
+    cap = int(arr.shape[0] * 1.5)
+    return cap
+
+
+def validated(comm, x):
+    if x.shape[0] == 0:
+        return x  # data-INdependent early exit (static shape): fine
+    return comm.all_gather(x)

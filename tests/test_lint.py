@@ -1,0 +1,299 @@
+"""joinlint acceptance suite (docs/STATIC_ANALYSIS.md).
+
+Three layers, mirroring how the tool is used:
+
+1. rule fixtures — every known-bad snippet under tests/lint_fixtures/
+   must flag with exactly its rule; the known-good twin must stay
+   clean (the linter's false-positive contract);
+2. self-lint — the repo itself is clean modulo the committed
+   suppressions, and no committed suppression is dead;
+3. schedule checker — the committed goldens in results/schedules/
+   match a fresh trace, a tampered golden fails loudly, and a host
+   callback appearing in a telemetry-off program (exactly what
+   ``faults.validate_plans`` weaves in) fails the unconditional
+   invariant even against a freshly-regenerated golden.
+
+Marker: ``lint`` (the ``lint`` lane of scripts/run_tier1.sh runs the
+CLI; tier-1 runs this suite).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_join_tpu.analysis import (
+    Linter,
+    load_suppressions,
+)
+from distributed_join_tpu.analysis.linter import (
+    DEFAULT_SUPPRESSIONS,
+    SuppressionError,
+)
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+SCHEDULE_DIR = os.path.join(REPO, "results", "schedules")
+
+PROGRAMS = {
+    "join_step_padded", "join_step_ragged", "join_step_ppermute",
+    "join_step_metrics", "join_step_skew",
+}
+
+
+def lint_fixture(name):
+    return Linter(FIXTURES).lint_file(name)
+
+
+# -- level 1: rule fixtures -------------------------------------------
+
+
+@pytest.mark.parametrize("fixture,rule", [
+    ("bad_collective_divergence.py", "DJL001"),
+    ("bad_hidden_sync.py", "DJL002"),
+    ("bad_callback.py", "DJL003"),
+    ("bad_recompile.py", "DJL004"),
+    ("bad_tape_parity.py", "DJL005"),
+    ("bad_unused_import.py", "DJL006"),
+])
+def test_known_bad_fixture_flags_its_rule(fixture, rule):
+    findings = lint_fixture(fixture)
+    assert findings, f"{fixture} produced no findings"
+    rules = {f.rule for f in findings}
+    assert rules == {rule}, (
+        f"{fixture} expected only {rule}, got "
+        + "; ".join(f.format() for f in findings)
+    )
+
+
+def test_known_good_fixture_is_clean():
+    findings = lint_fixture("good_clean.py")
+    assert findings == [], "; ".join(f.format() for f in findings)
+
+
+def test_divergence_covers_branch_and_early_exit():
+    msgs = [f.message for f in
+            lint_fixture("bad_collective_divergence.py")]
+    assert any("rank-dependent branch" in m for m in msgs)
+    assert any("early exit" in m for m in msgs)
+
+
+def test_noqa_inline_suppression():
+    src = "import sys  # noqa: DJL006\n"
+    assert Linter(FIXTURES).lint_source(src, "x.py") == []
+    # flake8 alias the repo already carries
+    src = "import sys  # noqa: F401\n"
+    assert Linter(FIXTURES).lint_source(src, "x.py") == []
+    # an unrelated code does NOT suppress
+    src = "import sys  # noqa: DJL001\n"
+    assert Linter(FIXTURES).lint_source(src, "x.py") != []
+
+
+def test_suppression_file_covers_finding(tmp_path):
+    sup = tmp_path / "s.toml"
+    sup.write_text(
+        '[[suppress]]\n'
+        'rule = "DJL003"\n'
+        'path = "bad_callback.py"\n'
+        'match = "pure_callback"\n'
+        'reason = "fixture exercises the rule"\n'
+    )
+    linter = Linter(FIXTURES, suppressions=load_suppressions(str(sup)))
+    result = linter.run(["bad_callback.py"])
+    assert result.ok
+    assert len(result.suppressed) == 1
+    assert not result.unused_suppressions
+
+
+def test_recompile_covers_assignment_and_decorator_jit_forms():
+    msgs = [f.message for f in lint_fixture("bad_recompile.py")
+            if "static argument" in f.message]
+    assert any("fn()" in m for m in msgs)
+    assert any("decorated_kernel()" in m for m in msgs)
+
+
+def test_missing_lint_target_is_loud(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        Linter(REPO).run(["distributd_join_tpu"])  # typo'd
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    rc = subprocess.run(
+        [sys.executable, "-m", "distributed_join_tpu.analysis.lint",
+         "--rules-only", "no_such_dir"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    assert rc.returncode == 2, rc.stdout + rc.stderr
+
+
+def test_suppression_hits_reset_per_run(tmp_path):
+    sup = tmp_path / "s.toml"
+    sup.write_text(
+        '[[suppress]]\n'
+        'rule = "DJL003"\n'
+        'path = "bad_callback.py"\n'
+        'reason = "fixture"\n'
+    )
+    linter = Linter(FIXTURES, suppressions=load_suppressions(str(sup)))
+    assert not linter.run(["bad_callback.py"]).unused_suppressions
+    # A second run on files the entry cannot match must report it
+    # unused — hits are per-run, not per-instance lifetime.
+    assert linter.run(["good_clean.py"]).unused_suppressions
+
+
+def test_suppression_requires_reason(tmp_path):
+    sup = tmp_path / "bad.toml"
+    sup.write_text(
+        '[[suppress]]\nrule = "DJL003"\npath = "*"\n'
+    )
+    with pytest.raises(SuppressionError):
+        load_suppressions(str(sup))
+
+
+def test_self_lint_repo_clean_modulo_suppressions():
+    """THE burn-in contract: the production tree is clean under the
+    committed suppression file, and every suppression still earns its
+    place."""
+    sups = load_suppressions(DEFAULT_SUPPRESSIONS)
+    result = Linter(REPO, suppressions=sups).run()
+    assert result.findings == [], (
+        "repo lint regressed:\n"
+        + "\n".join(f.format() for f in result.findings)
+    )
+    assert not result.unused_suppressions, (
+        "dead suppressions: "
+        + ", ".join(s.origin for s in result.unused_suppressions)
+    )
+    assert result.files_checked > 50  # the scan actually scanned
+
+
+def test_cli_rules_only_exit_codes():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    rc = subprocess.run(
+        [sys.executable, "-m", "distributed_join_tpu.analysis.lint",
+         "--rules-only"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    assert rc.returncode == 0, rc.stdout + rc.stderr
+    rc = subprocess.run(
+        [sys.executable, "-m", "distributed_join_tpu.analysis.lint",
+         "--rules-only", "--no-suppressions", "--root", FIXTURES,
+         "bad_callback.py"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    assert rc.returncode == 1, rc.stdout + rc.stderr
+    assert "DJL003" in rc.stdout
+
+
+# -- level 2: the schedule checker ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_schedules():
+    """Trace every key program once for the whole module (trace only —
+    nothing compiles or runs)."""
+    from distributed_join_tpu.analysis import schedule as S
+
+    return {name: S.trace_program(name, prog)
+            for name, prog in S.key_programs().items()}
+
+
+def test_committed_goldens_match_fresh_trace(traced_schedules):
+    from distributed_join_tpu.analysis.schedule import check_program
+
+    assert set(traced_schedules) == PROGRAMS
+    for name, sched in traced_schedules.items():
+        violations = check_program(sched, SCHEDULE_DIR)
+        assert violations == [], "\n".join(violations)
+
+
+def test_metrics_program_adds_exactly_one_gather(traced_schedules):
+    """The telemetry contract, now schedule-checked: with_metrics adds
+    ONE all_gather (the tape) and nothing else."""
+    off = traced_schedules["join_step_padded"].collectives
+    on = traced_schedules["join_step_metrics"].collectives
+    assert on.count("all_gather") == off.count("all_gather") + 1
+    assert [c for c in on if c != "all_gather"] == \
+           [c for c in off if c != "all_gather"]
+
+
+def test_reordered_golden_fails(traced_schedules, tmp_path):
+    from distributed_join_tpu.analysis.schedule import (
+        check_program,
+        write_golden,
+    )
+
+    sched = traced_schedules["join_step_padded"]
+    path = write_golden(sched, str(tmp_path))
+    golden = json.load(open(path))
+    assert len(golden["collectives"]) >= 2
+    golden["collectives"] = list(reversed(golden["collectives"]))
+    json.dump(golden, open(path, "w"))
+    violations = check_program(sched, str(tmp_path))
+    assert any("drifted" in v and "join_step_padded" in v
+               for v in violations), violations
+
+
+def test_added_collective_fails(traced_schedules, tmp_path):
+    from distributed_join_tpu.analysis.schedule import (
+        check_program,
+        write_golden,
+    )
+
+    sched = traced_schedules["join_step_ragged"]
+    path = write_golden(sched, str(tmp_path))
+    golden = json.load(open(path))
+    golden["collectives"] = golden["collectives"][:-1]  # traced adds 1
+    json.dump(golden, open(path, "w"))
+    violations = check_program(sched, str(tmp_path))
+    assert any("added" in v for v in violations), violations
+
+
+def test_missing_golden_fails(traced_schedules, tmp_path):
+    from distributed_join_tpu.analysis.schedule import check_program
+
+    violations = check_program(
+        traced_schedules["join_step_skew"], str(tmp_path))
+    assert any("no committed golden" in v for v in violations)
+
+
+def test_update_roundtrip_reproduces_committed(traced_schedules,
+                                               tmp_path):
+    """--update-schedules is deterministic AND the committed goldens
+    are current: a fresh regen reproduces them byte-identically."""
+    from distributed_join_tpu.analysis.schedule import write_golden
+
+    for name, sched in traced_schedules.items():
+        path = write_golden(sched, str(tmp_path))
+        fresh = open(path).read()
+        committed = open(
+            os.path.join(SCHEDULE_DIR, f"{name}.json")).read()
+        assert fresh == committed, f"{name} golden is stale"
+
+
+def test_callback_in_telemetry_off_program_fails():
+    """Plan validation weaves a pure_callback into the ragged shuffle
+    at TRACE time — exactly the hazard the no-callback invariant
+    exists for. It must fail even against a regenerated golden."""
+    from distributed_join_tpu.analysis import schedule as S
+    from distributed_join_tpu.parallel import faults
+
+    with faults.validate_plans(True):
+        progs = {"join_step_ragged":
+                 S.key_programs()["join_step_ragged"]}
+        sched = S.trace_program("join_step_ragged",
+                                progs["join_step_ragged"])
+    assert sched.host_callbacks, "validate_plans added no callback?"
+    violations = S.check_program(sched, SCHEDULE_DIR)
+    assert any("TELEMETRY-OFF" in v for v in violations), violations
+    # regen cannot bless it: update=True still reports the invariant
+    with faults.validate_plans(True):
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            vs, _ = S.check_schedules(schedule_dir=td, update=True,
+                                      programs=progs)
+    assert any("host callback" in v for v in vs), vs
