@@ -122,13 +122,16 @@ def main(argv=None) -> int:
         stamp_record,
     )
 
+    from distributed_join_tpu.benchmarks import add_robustness_args
+
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     add_telemetry_args(p)
+    add_robustness_args(p)
     args = p.parse_args(argv)
     telemetry.configure_from_args(args)
     result = None
     try:
-        result = _run()
+        result = _run(args)
         return 0
     except Exception as exc:  # noqa: BLE001 — record, then re-signal
         from distributed_join_tpu.parallel.bootstrap import BootstrapError
@@ -260,7 +263,8 @@ def _proxy_run(outage) -> dict:
     })
 
 
-def _run() -> dict:
+def _run(args=None) -> dict:
+    from distributed_join_tpu.benchmarks import maybe_chaos_communicator
     from distributed_join_tpu.parallel.communicator import (
         LocalCommunicator,
         TpuCommunicator,
@@ -278,6 +282,8 @@ def _run() -> dict:
     telemetry.refresh_rank()
     telemetry.maybe_start_xla_trace()
     comm = LocalCommunicator() if n_dev == 1 else TpuCommunicator(n_ranks=n_dev)
+    if args is not None:
+        comm = maybe_chaos_communicator(comm, args)
 
     build, probe = generate_build_probe_tables(
         seed=42,
@@ -342,6 +348,20 @@ def _run() -> dict:
     # (distributed_join.DEFAULT_OUT_CAPACITY_FACTOR over probe rows) —
     # no match-count oracle.
     m_rows_contract, retry_contract = measure()
+
+    # --verify-integrity: one untimed digest-verified step after the
+    # timed regions (benchmarks.collect_integrity); a wire mismatch
+    # raises IntegrityError instead of shipping a headline number
+    # computed from corrupt rows.
+    integ = None
+    if args is not None and getattr(args, "verify_integrity", False):
+        from distributed_join_tpu.benchmarks import collect_integrity
+
+        integ = collect_integrity(
+            comm, build, probe,
+            dict(key="key", over_decomposition=1,
+                 out_capacity_factor=3.0),
+        )
     from distributed_join_tpu.benchmarks import stamp_record
 
     record = stamp_record({
@@ -360,6 +380,7 @@ def _run() -> dict:
             "match_sized": retry_match,
             "capacity_contract": retry_contract,
         },
+        "integrity": integ,
     })
     print(json.dumps(record))
     return record

@@ -398,8 +398,16 @@ class HiddenSync:
 # -- DJL003 callback-discipline ---------------------------------------
 
 
+# The sanctioned host-callback seams. faults.py carries the plan-
+# validation callback; integrity.py and chaos.py are the wire-
+# integrity / chaos-soak layer (PR 5) — registered so a future host
+# tap there follows the documented error-token discipline instead of
+# growing a blanket noqa; callbacks ANYWHERE else (the join hot path,
+# the shuffles, the drivers) still flag.
 SANCTIONED_CALLBACK_FILES = (
     "distributed_join_tpu/parallel/faults.py",
+    "distributed_join_tpu/parallel/integrity.py",
+    "distributed_join_tpu/parallel/chaos.py",
 )
 SANCTIONED_CALLBACK_DIRS = (
     "distributed_join_tpu/telemetry/",
@@ -602,8 +610,12 @@ class TapeParity:
                 a.arg for a in (fn.args.args + fn.args.kwonlyargs)
                 if a.arg == "tape"
             }
+            # with_integrity is the second parity switch (PR 5): the
+            # integrity digests ride the same aux Metrics slot, so a
+            # tape expression guarded on it is exactly as sound as one
+            # guarded on with_metrics.
             has_with_metrics = any(
-                a.arg == "with_metrics"
+                a.arg in ("with_metrics", "with_integrity")
                 for a in fn.args.args + fn.args.kwonlyargs
             )
             for node in fn.body:
@@ -628,7 +640,7 @@ class TapeParity:
                         )
             if not tape_like:
                 continue
-            guards = tape_like | {"with_metrics"}
+            guards = tape_like | {"with_metrics", "with_integrity"}
             for node in ast.walk(fn):
                 if not (isinstance(node, ast.Call)
                         and isinstance(node.func, ast.Attribute)

@@ -88,6 +88,15 @@ def run_guarded(run, args, benchmark: str) -> int:
     per-attempt record embedded, mirroring bench.py; every other
     failure keeps a nonzero rc so rc-checking automation still sees a
     regressed benchmark.
+
+    Hang guard (``--guard-deadline-s`` / ``DJTPU_GUARD_DEADLINE_S``;
+    default unguarded — the historical behavior): when a deadline is
+    configured, the whole ``run(args)`` executes under the shared
+    watchdog (parallel/watchdog.py) and a run that never comes back
+    becomes a bounded, reported ``HangError`` record with rc 1 — a
+    hang is a real failure, not an environment outage. The exit is
+    hard (``os._exit``): the wedged worker thread may hold backend
+    locks no clean shutdown can take.
     """
     import json
     import os
@@ -96,24 +105,36 @@ def run_guarded(run, args, benchmark: str) -> int:
 
     from distributed_join_tpu import telemetry
     from distributed_join_tpu.parallel.bootstrap import BootstrapError
+    from distributed_join_tpu.parallel.watchdog import (
+        HangError,
+        call_with_deadline,
+        resolve_guard_deadline,
+    )
 
     # --telemetry[=DIR]/--trace (add_telemetry_args) activate the one
     # observability session here, so every driver shares the wiring;
     # the XLA device profile for --trace starts later, in
     # apply_platform, after platform/bootstrap selection.
     telemetry.configure_from_args(args)
+    guard_s = resolve_guard_deadline(args)
     result = None
     try:
-        result = run(args)
+        if guard_s is None:
+            result = run(args)
+        else:
+            result = call_with_deadline(
+                lambda: run(args), guard_s, what=f"{benchmark} run")
         return 0
     # SystemExit (argparse/flag validation) propagates untouched: it is
     # not an Exception, and it is not a runtime failure record.
     except Exception as exc:
         is_bootstrap = isinstance(exc, BootstrapError)
+        is_hang = isinstance(exc, HangError)
         record = stamp_record({
             "benchmark": benchmark,
             "error": f"{type(exc).__name__}: {exc}",
-            "failure": (exc.record() if is_bootstrap else {
+            "failure": (exc.record() if (is_bootstrap or is_hang)
+                        else {
                 "error": type(exc).__name__,
                 "message": str(exc),
                 "traceback":
@@ -130,18 +151,21 @@ def run_guarded(run, args, benchmark: str) -> int:
             except OSError as io_exc:
                 print(f"note: could not write {json_output}: {io_exc}",
                       file=sys.stderr)
-        if is_bootstrap:
-            # Hard exit, as in bench.py: a hung handshake leaves a
-            # watchdog worker thread stuck inside jax.distributed
-            # .initialize, and concurrent.futures' atexit hook would
-            # join it forever on a normal return — the record above is
-            # already flushed. os._exit skips the finally below, so
-            # flush the telemetry files first. (--diagnose is skipped:
-            # an environment outage leaves no join telemetry to read.)
+        if is_bootstrap or is_hang:
+            # Hard exit, as in bench.py: a hung handshake (or a run
+            # that blew the guard deadline) leaves a watchdog worker
+            # thread stuck in backend code; even detached from the
+            # atexit join it may hold locks a clean shutdown needs —
+            # the record above is already flushed. os._exit skips the
+            # finally below, so flush the telemetry files first.
+            # (--diagnose is skipped: neither outage class leaves
+            # settled join telemetry to read.) Only the bootstrap
+            # outage exits 0; a hang keeps rc 1 — automation must see
+            # a wedged benchmark as a failure.
             telemetry.finalize()
             sys.stdout.flush()
             sys.stderr.flush()
-            os._exit(0)
+            os._exit(0 if is_bootstrap else 1)
         raise
     finally:
         # Write the Chrome trace / summary even on failure — a run
@@ -218,6 +242,87 @@ def add_telemetry_args(parser) -> None:
              "DIR/diagnosis.json and printed on rank 0. Implies "
              "--telemetry",
     )
+
+
+def add_robustness_args(parser) -> None:
+    """The shared failure-semantics flags (one definition for all
+    drivers + bench.py; docs/FAILURE_SEMANTICS.md)."""
+    parser.add_argument(
+        "--verify-integrity", action="store_true",
+        help="verify the shuffle wire with in-graph per-(src,dst) "
+             "digests (parallel/integrity.py): one extra untimed "
+             "verified step after the timed region (the timed loop "
+             "stays the seed program); a mismatch raises "
+             "IntegrityError instead of reporting a number computed "
+             "from corrupt rows. The verdict lands in the JSON "
+             "record under 'integrity'",
+    )
+    parser.add_argument(
+        "--chaos-seed", type=int, default=None, metavar="N",
+        help="wrap the communicator in a seeded fault schedule "
+             "(parallel/chaos.py) — deterministic chaos smoke for the "
+             "full driver stack; pair with --verify-integrity so "
+             "injected corruption is detected, not benchmarked",
+    )
+    parser.add_argument(
+        "--guard-deadline-s", type=float, default=None, metavar="S",
+        help="run the whole benchmark under the shared hang watchdog "
+             "(parallel/watchdog.py): a run that never returns "
+             "becomes a bounded, machine-readable HangError record "
+             "with rc 1. Default: DJTPU_GUARD_DEADLINE_S env, else "
+             "unguarded (hours-long out-of-core runs are legitimate)",
+    )
+
+
+def maybe_chaos_communicator(comm, args):
+    """Driver seam for ``--chaos-seed``: wrap (or pass through) the
+    communicator according to the flag."""
+    seed = getattr(args, "chaos_seed", None)
+    if seed is None:
+        return comm
+    from distributed_join_tpu.parallel.chaos import wrap_communicator
+
+    return wrap_communicator(comm, seed)
+
+
+def collect_integrity(comm, build, probe, join_opts: dict,
+                      raise_on_mismatch: bool = True):
+    """Driver seam for ``--verify-integrity``: run ONE digest-verified
+    join step on the real inputs (untimed, after the timed region —
+    the same shape as :func:`collect_join_metrics`, so the timed loop
+    stays the seed program) and return the host-side
+    ``IntegrityReport`` record. A mismatch raises ``IntegrityError``
+    by default — the driver's record must never carry a number
+    computed from rows the wire corrupted. An overflowed verification
+    step skips the digest check (clamped rows mismatch by design) and
+    says so in the record."""
+    from distributed_join_tpu import telemetry
+    from distributed_join_tpu.parallel import integrity
+    from distributed_join_tpu.parallel.distributed_join import (
+        JOIN_METRICS_SHARDED_OUT,
+        make_join_step,
+    )
+
+    # Chaos smoke (--chaos-seed): corruption is woven at TRACE time
+    # and its budget was spent on the timed program traced earlier —
+    # rearm it so THIS program faces the same schedule; otherwise the
+    # verification would trace clean and bless numbers the corruption
+    # already touched.
+    rearm = getattr(comm, "rearm_corruption", None)
+    if rearm is not None:
+        rearm()
+    with telemetry.span("verify_integrity") as sp:
+        step = make_join_step(comm, with_integrity=True, **join_opts)
+        fn = comm.spmd(step, sharded_out=JOIN_METRICS_SHARDED_OUT)
+        res, metrics = fn(build, probe)
+        if sp is not None:
+            sp.sync_on(res.total)
+    if bool(res.overflow):
+        return {"ok": None, "skipped": "overflow", "checked_pairs": 0}
+    report = integrity.verify_digests(metrics)
+    if not report.ok and raise_on_mismatch:
+        raise integrity.IntegrityError(report)
+    return report.as_record()
 
 
 def collect_join_metrics(comm, build, probe, join_opts: dict,

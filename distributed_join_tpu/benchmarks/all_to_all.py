@@ -26,8 +26,10 @@ from jax import lax
 
 from distributed_join_tpu.benchmarks import (
     add_platform_arg,
+    add_robustness_args,
     add_telemetry_args,
     apply_platform,
+    maybe_chaos_communicator,
     report,
 )
 from distributed_join_tpu.parallel.communicator import make_communicator
@@ -46,12 +48,53 @@ def parse_args(argv=None):
     p.add_argument("--json-output", default=None)
     add_platform_arg(p)
     add_telemetry_args(p)
+    add_robustness_args(p)
     return p.parse_args(argv)
+
+
+def _verified_exchange(comm, x, n: int, per_rank: int):
+    """One digest-verified exchange of the benchmark buffer (untimed,
+    after the timed loop): per-(src,dst) digests of the sent and
+    received blocks ride one step-end all_gather on the MetricsTape,
+    exactly the join shuffles' integrity channel
+    (parallel/integrity.py) applied to the raw microbenchmark wire.
+    Raises IntegrityError on any pair mismatch."""
+    import jax.numpy as jnp
+
+    from distributed_join_tpu.parallel import integrity
+    from distributed_join_tpu.telemetry import MetricsTape
+
+    # Chaos smoke: the timed loop's trace spent the corruption budget;
+    # rearm so THIS trace faces the same schedule (the same hazard
+    # benchmarks.collect_integrity guards against).
+    rearm = getattr(comm, "rearm_corruption", None)
+    if rearm is not None:
+        rearm()
+
+    def exchange(buf):
+        buf = buf.reshape(n, per_rank)
+        full = jnp.full((n,), per_rank, jnp.int32)
+        sent = integrity.padded_block_digests({"buf": buf}, full)
+        recv_buf = comm.all_to_all(buf)
+        recv = integrity.padded_block_digests({"buf": recv_buf}, full)
+        t = MetricsTape()
+        integrity.record_pair_digests(
+            t.scoped("wire.integrity"), sent, recv)
+        return t.gathered(comm)
+
+    metrics = comm.spmd(exchange, sharded_out=True)(x)
+    rep = integrity.verify_digests(metrics)
+    if not rep.ok:
+        raise integrity.IntegrityError(rep)
+    return rep.as_record()
 
 
 def run(args) -> dict:
     apply_platform(args.platform, args.n_ranks)
-    comm = make_communicator(args.communicator, n_ranks=args.n_ranks)
+    comm = maybe_chaos_communicator(
+        make_communicator(args.communicator, n_ranks=args.n_ranks),
+        args,
+    )
     n = comm.n_ranks
     if n < 2:
         raise SystemExit(
@@ -87,6 +130,12 @@ def run(args) -> dict:
 
     sec = measure(lambda: fn(x), fetch, iters, name="all_to_all")
 
+    # --verify-integrity: one untimed digest-verified exchange of the
+    # same buffer — the timed loop above stays the seed program.
+    integ = None
+    if args.verify_integrity:
+        integ = _verified_exchange(comm, x, n, per_rank)
+
     bytes_per_rank = elems * 4
     egress = bytes_per_rank * (n - 1) / n
     record = {
@@ -94,6 +143,8 @@ def run(args) -> dict:
         "communicator": comm.name,
         "n_ranks": n,
         "buffer_bytes_per_rank": bytes_per_rank,
+        "integrity": integ,
+        "chaos_seed": args.chaos_seed,
         "elapsed_per_exchange_s": sec,
         "aggregate_offchip_gb_per_sec": n * egress / sec / 1e9,
         "aggregate_gb_per_sec_incl_local": n * bytes_per_rank / sec / 1e9,

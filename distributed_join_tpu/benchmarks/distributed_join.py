@@ -32,9 +32,12 @@ import jax.numpy as jnp
 
 from distributed_join_tpu.benchmarks import (
     add_platform_arg,
+    add_robustness_args,
     add_telemetry_args,
     apply_platform,
+    collect_integrity,
     collect_join_metrics,
+    maybe_chaos_communicator,
     report,
 )
 from distributed_join_tpu.parallel.communicator import make_communicator
@@ -151,6 +154,7 @@ def parse_args(argv=None):
                    help="also write the result record to this file")
     add_platform_arg(p)
     add_telemetry_args(p)
+    add_robustness_args(p)
     return p.parse_args(argv)
 
 
@@ -202,7 +206,10 @@ def run(args) -> dict:
               "compression_for_bitpack.json) — above that, raw is "
               "faster", file=sys.stderr)
 
-    comm = make_communicator(args.communicator, n_ranks=args.n_ranks)
+    comm = maybe_chaos_communicator(
+        make_communicator(args.communicator, n_ranks=args.n_ranks),
+        args,
+    )
     n = comm.n_ranks
     gen_t0 = time.perf_counter()
     key_dtype = DTYPES[args.key_type]
@@ -386,6 +393,13 @@ def run(args) -> dict:
     collect_join_metrics(comm, build, probe,
                          dict(fixed_opts, **ladder.sizing()),
                          attempt=attempt)
+    # --verify-integrity: one digest-verified untimed step (same
+    # discipline); a wire mismatch raises IntegrityError rather than
+    # reporting a throughput computed from corrupt rows.
+    integ = None
+    if args.verify_integrity:
+        integ = collect_integrity(comm, build, probe,
+                                  dict(fixed_opts, **ladder.sizing()))
 
     rows = b_rows + p_rows
     rows_per_sec = rows / sec_per_join
@@ -417,6 +431,8 @@ def run(args) -> dict:
         "string_wire_bytes": _string_wire_accounting(build, args.shuffle),
         "matches_per_join": matches,
         "overflow": overflow,
+        "integrity": integ,
+        "chaos_seed": args.chaos_seed,
         "retry": ladder.report().as_record(),
         "elapsed_per_join_s": sec_per_join,
         "rows_per_sec": rows_per_sec,

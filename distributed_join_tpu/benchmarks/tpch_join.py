@@ -24,9 +24,12 @@ import jax
 
 from distributed_join_tpu.benchmarks import (
     add_platform_arg,
+    add_robustness_args,
     add_telemetry_args,
     apply_platform,
+    collect_integrity,
     collect_join_metrics,
+    maybe_chaos_communicator,
     report,
 )
 from distributed_join_tpu.parallel.communicator import make_communicator
@@ -82,6 +85,7 @@ def parse_args(argv=None):
     p.add_argument("--json-output", default=None)
     add_platform_arg(p)
     add_telemetry_args(p)
+    add_robustness_args(p)
     return p.parse_args(argv)
 
 
@@ -123,7 +127,10 @@ def run(args) -> dict:
             "--batches > 1 or --host-generator"
         )
     apply_platform(args.platform, args.n_ranks)
-    comm = make_communicator(args.communicator, n_ranks=args.n_ranks)
+    comm = maybe_chaos_communicator(
+        make_communicator(args.communicator, n_ranks=args.n_ranks),
+        args,
+    )
     n = comm.n_ranks
 
     if args.host_generator:
@@ -164,10 +171,12 @@ def run(args) -> dict:
             on_batch_failure=("continue"
                               if args.continue_on_batch_failure
                               else "raise"),
+            verify_integrity=args.verify_integrity,
         )
         sec = stats["elapsed_s"]
         record_extra = {
             "host_generator": True,
+            "verify_integrity": args.verify_integrity,
             "narrow_wire": not args.wide_wire,
             "generate_s": gen_s,
             "batch_build_capacity": stats["build_capacity"],
@@ -220,10 +229,12 @@ def run(args) -> dict:
             on_batch_failure=("continue"
                               if args.continue_on_batch_failure
                               else "raise"),
+            verify_integrity=args.verify_integrity,
         )
         sec = stats["elapsed_s"]
         matches = total
         extra_batched = {
+            "verify_integrity": args.verify_integrity,
             "manifest": args.manifest,
             "resumed_batches": stats["resumed_batches"],
             "failed_batches": stats["failed_batches"],
@@ -252,14 +263,19 @@ def run(args) -> dict:
         )
         # --telemetry: device counters from one untimed single-step
         # program (see benchmarks.collect_join_metrics); the timed
-        # loop above stays the seed program.
+        # loop above stays the seed program. --verify-integrity: one
+        # digest-verified untimed step with the same discipline.
         collect_join_metrics(comm, build, probe, join_opts)
+        extra_single = {}
+        if args.verify_integrity:
+            extra_single["integrity"] = collect_integrity(
+                comm, build, probe, join_opts)
 
     # Valid-row counts (post-filter), same semantics as the host path.
     return _report(args, comm, int(orders.num_valid()),
                    int(lineitem.num_valid()),
                    rows, matches, overflow, sec,
-                   extra_batched if args.batches > 1 else {})
+                   extra_batched if args.batches > 1 else extra_single)
 
 
 def _report(args, comm, orders_rows, lineitem_rows, rows,

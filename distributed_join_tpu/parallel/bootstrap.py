@@ -82,19 +82,22 @@ class BootstrapError(RuntimeError):
 
 def call_with_deadline(fn: Callable, deadline_s: float,
                        what: str = "backend init"):
-    """Run ``fn()`` under a watchdog thread and turn BOTH failure modes
-    of a dead environment — an exception (round 4's "UNAVAILABLE") and
-    a hang inside PJRT client init (observed round 5) — into a
-    structured :class:`BootstrapError`. The caller decides whether a
-    timed-out worker thread forces a hard exit (a hung init thread
-    blocks normal interpreter shutdown; see bench.py)."""
-    import concurrent.futures
+    """Run ``fn()`` under the shared hang watchdog
+    (:mod:`..watchdog` — promoted there from this module in PR 5) and
+    turn BOTH failure modes of a dead environment — an exception
+    (round 4's "UNAVAILABLE") and a hang inside PJRT client init
+    (observed round 5) — into a structured :class:`BootstrapError`.
+    The caller decides whether a timed-out worker thread forces a hard
+    exit (the watchdog detaches it from the atexit join, but it may
+    still hold backend locks; see bench.py)."""
+    from distributed_join_tpu.parallel.watchdog import (
+        HangError,
+        call_with_deadline as _guarded,
+    )
 
-    ex = concurrent.futures.ThreadPoolExecutor(1)
-    fut = ex.submit(fn)
     try:
-        return fut.result(timeout=deadline_s)
-    except concurrent.futures.TimeoutError:
+        return _guarded(fn, deadline_s, what=what)
+    except HangError:
         raise BootstrapError(
             f"{what} did not complete within {deadline_s:g}s "
             "(TPU relay down?)",

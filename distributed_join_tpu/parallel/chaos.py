@@ -1,0 +1,471 @@
+"""Seeded chaos-soak harness: randomized fault schedules x join
+configs, every trial verified against the host pandas oracle.
+
+``python -m distributed_join_tpu.parallel.chaos --trials 25 --seed 42``
+runs 25 deterministic trials on the 8-virtual-device CPU mesh. Each
+trial derives its OWN rng from ``(seed, trial_index)``, draws a join
+config (padded / ragged / skew / out-of-core) and a fault schedule
+(nothing, capacity squeezes, transient dispatch failures, or one of
+the :data:`..faults.CORRUPTION_MODES` data corruptions), runs the join
+with ``verify_integrity=True``, and grades the outcome against ground
+truth computed with pandas on the host:
+
+- ``ok`` / ``recovered`` — the result is oracle-exact (full content
+  comparison via the order-invariant table digest, not just the match
+  count — a flipped payload byte with an intact key count would fool
+  a count oracle); ``recovered`` means the retry ladder worked for it;
+- ``detected`` — the run refused to return corrupt rows: a structured
+  ``IntegrityError`` / ``PlanValidationError`` / ``FaultInjectedError``
+  surfaced. For a corrupting schedule this is a PASS — the acceptance
+  bar is "injected corruption is detected and survived, never silently
+  joined";
+- ``FAILED:silent_corruption`` — a trial RETURNED rows that disagree
+  with the oracle: the one unforgivable outcome;
+- ``FAILED:hang`` — the trial blew its watchdog deadline
+  (:mod:`..watchdog`); ``FAILED:crash`` — an unstructured error, or
+  any error on a fault-free trial.
+
+Every failure writes a minimal-repro JSON (``--repro-out``) holding
+the harness seed, the trial index, the exact config + fault plan, and
+the replay command — ``--trial K`` reruns exactly trial K of a seed,
+bit-for-bit (generators, schedule draws, and fault addressing are all
+keyed on the trial rng).
+
+The CI smoke lane (``scripts/run_tier1.sh chaos``) runs a fixed-seed
+~20-trial soak; exit code 0 = every trial survived, 1 = at least one
+failure (repro files written), 2 = bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import random
+import sys
+import time
+from typing import Optional
+
+from distributed_join_tpu.parallel.faults import (
+    CORRUPTION_MODES,
+    FaultInjectedError,
+    FaultInjectingCommunicator,
+    FaultPlan,
+    PlanValidationError,
+    retry_with_backoff,
+)
+
+CONFIGS = ("padded", "ragged", "skew", "out_of_core")
+
+# Discrete, small parameter sets: trials stay fast and programs repeat
+# across trials, so the persistent XLA cache absorbs most compiles.
+# rand_max stays >= 256 so key duplication can't organically overflow
+# a 3x-sized output block — organic overflow on a fault-free trial
+# would read as a phantom harness failure (the retry budget still
+# absorbs moderate skew).
+_BUILD_ROWS = (512, 1024)
+_PROBE_ROWS = (1024, 2048)
+_RAND_MAX = (256, 700, 5000)
+_SELECTIVITY = (0.3, 0.5)
+
+
+def random_fault_plan(rng: random.Random, *, corruption: bool = True,
+                      dispatch_failures: bool = True) -> FaultPlan:
+    """One seeded fault schedule. ``corruption=False`` restricts to
+    the recoverable faults (squeezes/transients) — the knob behind the
+    soak's ``--no-corruption`` control arm and the drivers'
+    ``--chaos-seed`` smoke wrap."""
+    kinds = ["none", "overflow"]
+    if dispatch_failures:
+        kinds.append("transient_dispatch")
+    if corruption:
+        kinds += ["corruption", "corruption"]  # corruption-heavy soak
+    kind = rng.choice(kinds)
+    seed = rng.randrange(1 << 16)
+    if kind == "overflow":
+        return FaultPlan(seed=seed,
+                         overflow_programs=rng.choice((1, 2)))
+    if kind == "transient_dispatch":
+        return FaultPlan(seed=seed, fail_dispatches=1)
+    if kind == "corruption":
+        return FaultPlan(
+            seed=seed,
+            corrupt_mode=rng.choice(CORRUPTION_MODES),
+            corrupt_collectives=rng.choice((1, 2)),
+        )
+    return FaultPlan(seed=seed)
+
+
+def _trial_rng(seed: int, trial: int) -> random.Random:
+    """Deterministic ACROSS processes: integer-mixed seeding (tuple/
+    str seeds route through PYTHONHASHSEED-randomized hashing)."""
+    return random.Random(seed * 1_000_003 + trial)
+
+
+def fault_label(plan: FaultPlan) -> str:
+    if plan.corrupt_mode is not None:
+        return plan.corrupt_mode
+    if plan.overflow_programs:
+        return "overflow"
+    if plan.fail_dispatches or plan.fail_after_dispatches is not None:
+        return "transient_dispatch"
+    return "none"
+
+
+def wrap_communicator(comm, seed: int,
+                      plan: Optional[FaultPlan] = None):
+    """Driver seam (``--chaos-seed N``): wrap a communicator in a
+    seeded fault schedule. Corruption modes are INCLUDED — pair with
+    ``--verify-integrity`` (the drivers do) so a corrupted run is
+    detected, not reported as a clean benchmark number."""
+    if plan is None:
+        plan = random_fault_plan(_trial_rng(seed, 0),
+                                 dispatch_failures=False)
+    return FaultInjectingCommunicator(comm, plan)
+
+
+def _plan_record(plan: FaultPlan) -> dict:
+    return {k: v for k, v in dataclasses.asdict(plan).items()
+            if v not in (None, 0, 0.0)}
+
+
+def _oracle_frame(build, probe):
+    """Ground truth on the host: the merged pandas frame (the
+    reference implementation this repo reproduces is, at trial scale,
+    exactly a pandas inner join)."""
+    return build.to_pandas().merge(probe.to_pandas(), on="key")
+
+
+def _content_digest(columns: dict) -> int:
+    from distributed_join_tpu.parallel.integrity import table_digest_np
+
+    return table_digest_np(columns)
+
+
+def _result_columns(res_table) -> dict:
+    """Valid rows of a (possibly device) result table as host numpy."""
+    import numpy as np
+
+    valid = np.asarray(res_table.valid)
+    return {n: np.asarray(c)[valid]
+            for n, c in res_table.columns.items()}
+
+
+def _frame_columns(frame, names) -> dict:
+    import numpy as np
+
+    return {n: np.asarray(frame[n].to_numpy()) for n in names}
+
+
+@dataclasses.dataclass
+class TrialOutcome:
+    verdict: str
+    error: Optional[str] = None
+    expected_total: Optional[int] = None
+    got_total: Optional[int] = None
+    retries: int = 0
+
+    @property
+    def failed(self) -> bool:
+        return self.verdict.startswith("FAILED")
+
+
+def _grade_result(got_cols, got_total, oracle_cols, oracle_total,
+                  corrupting: bool, retries: int) -> TrialOutcome:
+    content_ok = (
+        got_total == oracle_total
+        and _content_digest(got_cols) == _content_digest(oracle_cols)
+    )
+    if content_ok:
+        return TrialOutcome("recovered" if retries else "ok",
+                            expected_total=oracle_total,
+                            got_total=got_total, retries=retries)
+    return TrialOutcome(
+        "FAILED:silent_corruption" if corrupting
+        else "FAILED:wrong_result",
+        expected_total=oracle_total, got_total=got_total,
+        retries=retries,
+    )
+
+
+def run_trial(harness_seed: int, trial: int, n_ranks: int = 8,
+              corruption: bool = True,
+              deadline_s: Optional[float] = 300.0) -> dict:
+    """Run one trial; returns its JSON-shaped record. Deterministic in
+    (harness_seed, trial): the config draw, the generators, and the
+    fault schedule all derive from the trial rng."""
+    from distributed_join_tpu.parallel.watchdog import (
+        HangError,
+        call_with_deadline,
+    )
+
+    rng = _trial_rng(harness_seed, trial)
+    config = {
+        "mode": CONFIGS[trial % len(CONFIGS)],
+        "build_rows": rng.choice(_BUILD_ROWS),
+        "probe_rows": rng.choice(_PROBE_ROWS),
+        "rand_max": rng.choice(_RAND_MAX),
+        "selectivity": rng.choice(_SELECTIVITY),
+        "table_seed": rng.randrange(1 << 16),
+    }
+    plan = random_fault_plan(rng, corruption=corruption)
+    # Corrupting schedules sometimes run with NO retry budget — the
+    # IntegrityError raise path must soak too. Every other schedule
+    # keeps budget to absorb injected squeezes + moderate skew.
+    config["auto_retry"] = (
+        rng.choice((0, 2)) if plan.corrupt_mode is not None else 3
+    )
+    record = {
+        "trial": trial,
+        "config": config,
+        "fault": fault_label(plan),
+        "fault_plan": _plan_record(plan),
+    }
+    t0 = time.perf_counter()
+    try:
+        if deadline_s is not None:
+            out = call_with_deadline(
+                lambda: _run_trial_body(config, plan, n_ranks),
+                deadline_s, what=f"chaos trial {trial}",
+            )
+        else:
+            out = _run_trial_body(config, plan, n_ranks)
+    except HangError as exc:
+        out = TrialOutcome("FAILED:hang", error=str(exc))
+    except Exception as exc:  # noqa: BLE001 — grading seam
+        # Anything the trial body did not convert to a structured
+        # refusal is a crash VERDICT, not a soak abort: the trial is
+        # graded FAILED, its repro JSON is written, and the remaining
+        # trials still run.
+        out = TrialOutcome(
+            "FAILED:crash", error=f"{type(exc).__name__}: {exc}")
+    record.update(dataclasses.asdict(out))
+    record["verdict"] = out.verdict
+    record["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    from distributed_join_tpu import telemetry
+
+    telemetry.event("chaos_trial", trial=trial,
+                    verdict=out.verdict, mode=config["mode"])
+    return record
+
+
+def _run_trial_body(config, plan: FaultPlan, n_ranks: int
+                    ) -> TrialOutcome:
+    import distributed_join_tpu as dj
+    from distributed_join_tpu.parallel import integrity
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+    )
+
+    build, probe = generate_build_probe_tables(
+        seed=config["table_seed"],
+        build_nrows=config["build_rows"],
+        probe_nrows=config["probe_rows"],
+        rand_max=config["rand_max"],
+        selectivity=config["selectivity"],
+    )
+    oracle = _oracle_frame(build, probe)
+    oracle_total = len(oracle)
+    out_names = ["key", "build_payload", "probe_payload"]
+    oracle_cols = _frame_columns(oracle, out_names)
+    corrupting = plan.corrupt_mode is not None
+
+    comm = FaultInjectingCommunicator(
+        dj.make_communicator("tpu", n_ranks=n_ranks), plan)
+    mode = config["mode"]
+    injected = fault_label(plan) != "none"
+
+    def loud(kind: str, detail: Optional[str] = None) -> TrialOutcome:
+        # A structured refusal (IntegrityError, a still-flagged
+        # overflow, a surfaced injected fault) PASSES a trial whose
+        # schedule injected something — corruption detected, never
+        # silently joined. On a fault-free trial the same outcome is
+        # a harness catch: a false alarm or an organic failure.
+        return TrialOutcome(
+            "detected" if injected else f"FAILED:{kind}",
+            error=detail or kind, expected_total=oracle_total)
+
+    try:
+        if mode == "out_of_core":
+            return _run_out_of_core(build, probe, comm, oracle_cols,
+                                    oracle_total, corrupting, loud,
+                                    plan)
+        join_opts = dict(
+            out_capacity_factor=3.0,
+            shuffle_capacity_factor=3.0,
+            shuffle="ragged" if mode == "ragged" else "padded",
+        )
+        if mode == "skew":
+            join_opts["skew_threshold"] = 0.05
+
+        def attempt():
+            return dj.distributed_inner_join(
+                build, probe, comm,
+                auto_retry=config["auto_retry"],
+                verify_integrity=True, **join_opts,
+            )
+
+        # Driver-level transient retry (the drivers' own contract):
+        # injected dispatch failures are retried a couple of times
+        # before counting as a loud structured failure.
+        res, _ = retry_with_backoff(
+            attempt, max_attempts=3, backoff_s=0.01,
+            retry_on=(FaultInjectedError,),
+        )
+        retries = res.retry_report.n_attempts - 1
+        if bool(res.overflow):
+            return loud("overflow_after_ladder")
+        return _grade_result(
+            _result_columns(res.table), int(res.total),
+            oracle_cols, oracle_total, corrupting, retries,
+        )
+    except integrity.IntegrityError as exc:
+        return loud("false_integrity_alarm", f"IntegrityError: {exc}")
+    except (PlanValidationError, FaultInjectedError) as exc:
+        return loud("structured_error",
+                    f"{type(exc).__name__}: {exc}")
+
+
+def _run_out_of_core(build, probe, comm, oracle_cols, oracle_total,
+                     corrupting: bool, loud,
+                     plan: FaultPlan) -> TrialOutcome:
+    import numpy as np
+
+    from distributed_join_tpu.parallel.out_of_core import (
+        keyrange_batched_join,
+    )
+
+    fetched = []
+
+    def consumer(_b, res):
+        fetched.append(_result_columns(res.table))
+
+    total, overflow = keyrange_batched_join(
+        build, probe, comm, n_batches=3, warmup=False,
+        batch_retries=2, batch_retry_backoff_s=0.01,
+        verify_integrity=True, on_batch_result=consumer,
+        out_capacity_factor=3.0, shuffle_capacity_factor=3.0,
+    )
+    if overflow:
+        return loud("overflow_flagged")
+    got = {
+        n: np.concatenate([c[n] for c in fetched])
+        for n in (fetched[0] if fetched else {})
+    }
+    # A clean finish over an injected transient necessarily consumed a
+    # batch retry — grade it "recovered" so the verdict histogram
+    # reflects the retry machinery (the batch loop doesn't surface
+    # attempt counts).
+    retries = 1 if plan.fail_dispatches else 0
+    return _grade_result(got, int(total), oracle_cols, oracle_total,
+                         corrupting, retries=retries)
+
+
+# -- the soak loop ----------------------------------------------------
+
+
+def soak(seed: int, trials: int, n_ranks: int = 8,
+         corruption: bool = True, only_trial: Optional[int] = None,
+         deadline_s: Optional[float] = 300.0,
+         repro_out: Optional[str] = None) -> dict:
+    """Run the soak (or one replayed trial); returns the summary
+    record and writes a minimal-repro JSON per failed trial."""
+    indices = ([only_trial] if only_trial is not None
+               else list(range(trials)))
+    records, failures = [], []
+    for k in indices:
+        rec = run_trial(seed, k, n_ranks=n_ranks,
+                        corruption=corruption, deadline_s=deadline_s)
+        records.append(rec)
+        line = (f"trial {k:3d} [{rec['config']['mode']:11s}] "
+                f"fault={rec['fault']:17s} -> {rec['verdict']} "
+                f"({rec['elapsed_s']}s)")
+        print(line, flush=True)
+        if rec["verdict"].startswith("FAILED"):
+            failures.append(rec)
+            if repro_out:
+                path = repro_out.replace(
+                    ".json", f"_{seed}_{k}.json") if repro_out.endswith(
+                    ".json") else f"{repro_out}_{seed}_{k}.json"
+                repro = dict(rec)
+                repro["harness_seed"] = seed
+                repro["replay"] = (
+                    "python -m distributed_join_tpu.parallel.chaos "
+                    f"--seed {seed} --trial {k}"
+                )
+                with open(path, "w") as f:
+                    json.dump(repro, f, indent=2)
+                print(f"  repro written: {path}", flush=True)
+    verdicts: dict = {}
+    for rec in records:
+        verdicts[rec["verdict"]] = verdicts.get(rec["verdict"], 0) + 1
+    return {
+        "harness_seed": seed,
+        "n_ranks": n_ranks,
+        "trials": len(records),
+        "verdicts": verdicts,
+        "failures": len(failures),
+        "records": records,
+    }
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--trials", type=int, default=25)
+    p.add_argument("--seed", type=int, default=42,
+                   help="harness seed; every trial derives its own "
+                        "rng from (seed, trial) so any trial replays "
+                        "exactly")
+    p.add_argument("--trial", type=int, default=None,
+                   help="replay ONE trial of this seed (the repro "
+                        "workflow)")
+    p.add_argument("--n-ranks", type=int, default=8)
+    p.add_argument("--no-corruption", action="store_true",
+                   help="restrict schedules to recoverable faults "
+                        "(squeezes/transients) — the control arm")
+    p.add_argument("--trial-deadline-s", type=float, default=300.0,
+                   help="hang watchdog per trial (0 disables)")
+    p.add_argument("--repro-out", default="chaos_repro.json",
+                   help="minimal-repro JSON path stem for failed "
+                        "trials")
+    p.add_argument("--json-output", default=None,
+                   help="write the full soak summary record here")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.trials < 1 or args.n_ranks < 2:
+        print("chaos: need --trials >= 1 and --n-ranks >= 2",
+              file=sys.stderr)
+        return 2
+    # The soak is a CPU-mesh harness by design (deterministic,
+    # hardware-free); reuse the shared platform forcing + the
+    # persistent compile cache so repeat soaks replay their programs.
+    from distributed_join_tpu.benchmarks import force_cpu_platform
+
+    force_cpu_platform(args.n_ranks)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/djtpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      0.5)
+
+    summary = soak(
+        args.seed, args.trials, n_ranks=args.n_ranks,
+        corruption=not args.no_corruption,
+        only_trial=args.trial,
+        deadline_s=(args.trial_deadline_s or None),
+        repro_out=args.repro_out,
+    )
+    print(json.dumps({k: v for k, v in summary.items()
+                      if k != "records"}))
+    if args.json_output:
+        with open(args.json_output, "w") as f:
+            json.dump(summary, f, indent=2)
+    return 1 if summary["failures"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,7 +83,7 @@ def _varwidth_cols(table: Table) -> list:
 def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
                    mode: str = "padded",
                    compression_bits: Optional[int] = None,
-                   varwidth=None, tape=None):
+                   varwidth=None, tape=None, digest_tape=None):
     if mode == "ragged":
         # Exact-size exchange: receive buffer = the same total rows the
         # padded layout would flatten to, but wire bytes = actual rows.
@@ -92,6 +92,7 @@ def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
         return shuffle_ragged(
             comm, pt, n_ranks * capacity, bucket_start=batch * n_ranks,
             capacity_per_bucket=capacity, varwidth=varwidth, tape=tape,
+            digest_tape=digest_tape,
         )
     padded, counts, overflow, _ = pt.to_padded(
         capacity, bucket_start=batch * n_ranks, n_buckets=n_ranks
@@ -100,11 +101,11 @@ def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
     if compression_bits is not None:
         table, _, c_ovf = shuffle_padded_compressed(
             comm, padded, counts, capacity, bits=compression_bits,
-            via=via, tape=tape,
+            via=via, tape=tape, digest_tape=digest_tape,
         )
         return table, overflow | c_ovf
     table, _ = shuffle_padded(comm, padded, counts, capacity, via=via,
-                              tape=tape)
+                              tape=tape, digest_tape=digest_tape)
     return table, overflow
 
 
@@ -126,6 +127,7 @@ def make_join_step(
     compression_bits: Optional[int] = None,
     kernel_config=None,
     with_metrics: bool = False,
+    with_integrity: bool = False,
     metrics_static: Optional[dict] = None,
 ):
     """The raw per-rank join step (partition -> shuffle -> local join).
@@ -201,6 +203,16 @@ def make_join_step(
     step they time TRACING and carry the pipeline structure into the
     Chrome trace, while their ``jax.named_scope`` lines the same names
     up against real device timings in an XLA profile.
+
+    Wire integrity (docs/FAILURE_SEMANTICS.md "Integrity contract"):
+    ``with_integrity=True`` additionally computes order-invariant
+    per-(src-rank, dst-rank) payload digests inside each shuffle
+    (parallel/integrity.py) and ships them in the SAME aux Metrics
+    block — the step returns ``(JoinResult, Metrics)`` exactly as
+    ``with_metrics`` does, with no further collective (the digests
+    ride the step-end all_gather). Verify host-side with
+    ``integrity.verify_digests``; with both switches off this is still
+    the exact seed program.
     """
     n = comm.n_ranks
     k = over_decomposition
@@ -222,7 +234,10 @@ def make_join_step(
     keys = [key] if isinstance(key, str) else list(key)
 
     def step(build_local: Table, probe_local: Table):
-        tape = telemetry.MetricsTape() if with_metrics else None
+        # The integrity digests ride the same Metrics slot, so either
+        # switch materializes the tape (and the aux output).
+        tape = telemetry.MetricsTape() if (with_metrics
+                                           or with_integrity) else None
         if tape is not None:
             for mname, mval in (metrics_static or {}).items():
                 tape.add(mname, int(mval))
@@ -366,6 +381,10 @@ def make_join_step(
                     order_within=vp[0] + "#len" if vp else None)
             tb = tape.scoped("build") if tape is not None else None
             tp = tape.scoped("probe") if tape is not None else None
+            dtb = tape.scoped("build.integrity") if with_integrity \
+                else None
+            dtp = tape.scoped("probe.integrity") if with_integrity \
+                else None
             if tape is not None:
                 for t, pt, cap in ((tb, ptb, b_cap), (tp, ptp, p_cap)):
                     t.add("rows_partitioned",
@@ -382,11 +401,11 @@ def make_join_step(
                     recv_build, ovf_b = _batch_shuffle(
                         comm, ptb, b, n, b_cap, mode=shuffle,
                         compression_bits=compression_bits, varwidth=vb,
-                        tape=tb)
+                        tape=tb, digest_tape=dtb)
                     recv_probe, ovf_p = _batch_shuffle(
                         comm, ptp, b, n, p_cap, mode=shuffle,
                         compression_bits=compression_bits, varwidth=vp,
-                        tape=tp)
+                        tape=tp, digest_tape=dtp)
                 with telemetry.span("join", batch=b):
                     res = sort_merge_inner_join(
                         recv_build, recv_probe, keys_eff, out_cap,
@@ -419,12 +438,13 @@ def make_join_step(
         total = comm.psum(total)
         overflow = comm.psum(overflow.astype(jnp.int32)) > 0
         result = JoinResult(out, total=total, overflow=overflow)
-        return (result, metrics) if with_metrics else result
+        return (result, metrics) if tape is not None else result
 
     return step
 
 
-def make_distributed_join(comm: Communicator, with_metrics=None, **opts):
+def make_distributed_join(comm: Communicator, with_metrics=None,
+                          with_integrity: bool = False, **opts):
     """Compile a distributed inner join over ``comm``'s ranks.
 
     Returns a jitted ``fn(build: Table, probe: Table) -> JoinResult``
@@ -439,11 +459,17 @@ def make_distributed_join(comm: Communicator, with_metrics=None, **opts):
     like ``retry_report``, not a pytree field — the call signature and
     the JoinResult pytree are unchanged either way). With telemetry
     off this is exactly the seed program.
+
+    ``with_integrity=True`` weaves the wire-integrity digests into the
+    same aux Metrics block (``res.telemetry`` then always exists, even
+    with telemetry off) — verify with ``integrity.verify_join_result``
+    or use :func:`distributed_inner_join`'s ``verify_integrity``.
     """
     if with_metrics is None:
         with_metrics = telemetry.enabled()
-    step = make_join_step(comm, with_metrics=with_metrics, **opts)
-    if not with_metrics:
+    step = make_join_step(comm, with_metrics=with_metrics,
+                          with_integrity=with_integrity, **opts)
+    if not (with_metrics or with_integrity):
         return comm.spmd(step, sharded_out=JOIN_SHARDED_OUT)
     compiled = comm.spmd(step, sharded_out=JOIN_METRICS_SHARDED_OUT)
 
@@ -461,6 +487,7 @@ def distributed_inner_join(
     comm: Communicator,
     key: str = "key",
     auto_retry: int = 0,
+    verify_integrity: bool = False,
     **opts,
 ) -> JoinResult:
     """One-shot convenience: pad to rank-divisible capacity, shard the
@@ -478,8 +505,20 @@ def distributed_inner_join(
     host-side ``retry_report`` attribute (:class:`..faults.RetryReport`
     — which capacities doubled, why, per attempt), which the benchmark
     drivers embed in their JSON records.
+
+    ``verify_integrity``: compute in-graph wire digests
+    (parallel/integrity.py) and verify every (src, dst) pair host-side
+    before returning. A mismatch is a RETRYABLE rung distinct from
+    overflow — the ladder re-runs the SAME sizing (``retry_integrity``
+    in the report; transport corruption is transient, capacities are
+    innocent) up to the ``auto_retry`` budget, and raises
+    :class:`..integrity.IntegrityError` instead of returning corrupt
+    rows when the budget runs out. A verified clean result carries the
+    report as ``res.integrity_report``. Verification is skipped on an
+    overflowed attempt (clamped rows mismatch by design; the overflow
+    rung handles it).
     """
-    from distributed_join_tpu.parallel import faults
+    from distributed_join_tpu.parallel import faults, integrity
     from distributed_join_tpu.parallel.faults import CapacityLadder
 
     n = comm.n_ranks
@@ -524,6 +563,7 @@ def distributed_inner_join(
     )
     for attempt in range(auto_retry + 1):
         fn = make_distributed_join(comm, key=key,
+                                   with_integrity=verify_integrity,
                                    metrics_static={
                                        "retry_attempt_max": attempt},
                                    **ladder.sizing(), **opts)
@@ -539,16 +579,35 @@ def distributed_inner_join(
             # surface a recorded inconsistency as the loud error it is
             # rather than retrying a corrupted-metadata exchange.
             faults.check_plan_violations()
-        ladder.note(overflow)
-        if attempt == auto_retry or not overflow:
+        report = None
+        if verify_integrity and not overflow:
+            # Overflow attempts skip verification: a clamp drops rows
+            # by design and the overflow rung already forces a retry.
+            report = integrity.verify_join_result(res)
+        ladder.note(overflow,
+                    integrity_ok=None if report is None else report.ok)
+        failed = overflow or (report is not None and not report.ok)
+        if attempt == auto_retry or not failed:
             # retry_report is host-side metadata, not a pytree field:
             # JoinResult traces through shard_map, and the report only
             # exists outside the compiled program.
             object.__setattr__(res, "retry_report", ladder.report())
+            if report is not None:
+                object.__setattr__(res, "integrity_report", report)
             # Fold the device metrics of the FINAL attempt into the
             # telemetry session (one host fetch, after the retry loop
             # settled — the flag fetch above already synced).
             telemetry.emit_metrics(getattr(res, "telemetry", None))
+            if report is not None and not report.ok:
+                # Never hand corrupt rows back as a result.
+                raise integrity.IntegrityError(report)
             return res
-        ladder.escalate()
+        if overflow:
+            ladder.escalate()
+        else:
+            # Integrity mismatch: rerun the SAME sizing — the rows
+            # were wrong, not too many. Every retry recompiles, so a
+            # deterministic injected corruption budget (FaultPlan)
+            # exhausts and the rerun can verify clean.
+            ladder.hold("retry_integrity")
     raise AssertionError("unreachable")

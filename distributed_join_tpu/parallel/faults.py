@@ -98,6 +98,31 @@ class FaultPlan:
       ``seed % n_ranks`` of its gathered view, so every rank plans
       from a different count matrix — exactly the corruption
       :func:`validate_ragged_plan` exists to catch.
+    - ``corrupt_mode`` + ``corrupt_collectives``: DATA corruption —
+      the adversary the wire-integrity digests
+      (parallel/integrity.py) exist for, injected at the collective
+      seams so the join sees exactly what a corrupting transport
+      would deliver. The first N eligible collectives (counted at
+      trace time, cumulative over the wrapper's lifetime — every
+      retry recompiles, so a retry ladder can outlast a finite
+      budget) are perturbed on rank ``corrupt_rank`` (default
+      ``seed % n_ranks``):
+
+      * ``"bit_flip"`` — one bit of one element of a received data
+        block flips (seed-addressed; padded blocks via
+        ``all_to_all``, ragged buffers via ``ragged_all_to_all``);
+      * ``"row_truncate"`` / ``"row_duplicate"`` — a received row
+        count drops/gains 1, so the receiver silently loses a real
+        row or adopts a garbage one. Padded mode perturbs the target
+        rank's received count vector; ragged mode perturbs one entry
+        of the gathered count MATRIX identically on every rank — a
+        consistent lie that sails through
+        :func:`validate_ragged_plan` (whose whole check is
+        cross-rank consistency) and is caught only by the digests;
+      * ``"misroute"`` — rows land at the wrong rank: the target
+        sender's blocks rotate one destination over (padded: the
+        received block axis rolls; ragged: two entries of its
+        ``input_offsets`` swap).
     """
 
     seed: int = 0
@@ -106,6 +131,13 @@ class FaultPlan:
     fail_after_dispatches: Optional[int] = None
     dispatch_delay_s: float = 0.0
     corrupt_plan_gathers: int = 0
+    corrupt_mode: Optional[str] = None
+    corrupt_collectives: int = 0
+    corrupt_rank: Optional[int] = None
+
+
+CORRUPTION_MODES = ("bit_flip", "row_truncate", "row_duplicate",
+                    "misroute")
 
 
 class FaultInjectingCommunicator(Communicator):
@@ -122,10 +154,17 @@ class FaultInjectingCommunicator(Communicator):
     def __init__(self, inner: Communicator, plan: FaultPlan):
         self._inner = inner
         self.plan = plan
+        if (plan.corrupt_mode is not None
+                and plan.corrupt_mode not in CORRUPTION_MODES):
+            raise ValueError(
+                f"unknown corrupt_mode {plan.corrupt_mode!r}; "
+                f"pick one of {CORRUPTION_MODES}"
+            )
         self.name = f"faulty({inner.name})"
         self._programs_built = 0
         self._dispatches = 0
         self._plan_gathers = 0
+        self._corruptions = 0
 
     # -- delegation ---------------------------------------------------
 
@@ -134,10 +173,11 @@ class FaultInjectingCommunicator(Communicator):
         return self._inner.n_ranks
 
     def all_to_all(self, x):
-        return self._inner.all_to_all(x)
+        return self._corrupt_exchanged(self._inner.all_to_all(x))
 
     def ppermute_all_to_all(self, x):
-        return self._inner.ppermute_all_to_all(x)
+        return self._corrupt_exchanged(
+            self._inner.ppermute_all_to_all(x))
 
     def axis_index(self):
         return self._inner.axis_index()
@@ -147,9 +187,6 @@ class FaultInjectingCommunicator(Communicator):
 
     def psum(self, x):
         return self._inner.psum(x)
-
-    def ragged_all_to_all(self, *args, **kwargs):
-        return self._inner.ragged_all_to_all(*args, **kwargs)
 
     def finalize(self) -> None:
         self._inner.finalize()
@@ -161,8 +198,110 @@ class FaultInjectingCommunicator(Communicator):
 
     # -- injection seams ----------------------------------------------
 
+    def _corrupt_rank(self) -> int:
+        """The rank whose traffic the corruption modes hit (static)."""
+        t = self.plan.corrupt_rank
+        return (self.plan.seed if t is None else t) % self.n_ranks
+
+    def _corrupt_budget(self) -> bool:
+        """Trace-time budget: True for the first
+        ``corrupt_collectives`` eligible collectives over the
+        wrapper's lifetime (retries recompile, so a finite budget
+        exhausts and a retried program runs clean)."""
+        if (self.plan.corrupt_mode is None
+                or self._corruptions >= self.plan.corrupt_collectives):
+            return False
+        self._corruptions += 1
+        return True
+
+    def rearm_corruption(self) -> None:
+        """Reset the corruption budget so the NEXT traced program
+        carries the schedule again. The drivers' verification seam
+        (``benchmarks.collect_integrity``) calls this before tracing
+        its verified step: the budget was spent corrupting the timed
+        program traced earlier, and without rearming, the separate
+        verification program would trace clean and bless benchmark
+        numbers the corruption already touched."""
+        self._corruptions = 0
+
+    def _corrupt_exchanged(self, y):
+        """Corruption of an all_to_all/ppermute result as the receiver
+        sees it. 1-D int32 (n,) vectors are the padded shuffle's count
+        exchange (truncate/duplicate seam: the target rank's received
+        count for one sender slips by 1); >=2-D blocks are the data
+        plane (bit_flip / misroute seams)."""
+        mode = self.plan.corrupt_mode
+        if mode is None:
+            return y
+        n = self.n_ranks
+        if (mode in ("row_truncate", "row_duplicate") and y.ndim == 1
+                and y.dtype == jnp.int32 and y.shape[0] == n
+                and self._corrupt_budget()):
+            j = (self.plan.seed // n) % n
+            delta = jnp.int32(-1 if mode == "row_truncate" else 1)
+            active = (self.axis_index()
+                      == jnp.int32(self._corrupt_rank()))
+            y = y.at[j].add(delta * active.astype(jnp.int32))
+            return jnp.maximum(y, 0)
+        if mode == "bit_flip" and y.ndim >= 2 \
+                and self._corrupt_budget():
+            active = (self.axis_index()
+                      == jnp.int32(self._corrupt_rank()))
+            return _flip_one_bit(y, self.plan.seed, active)
+        if mode == "misroute" and y.ndim >= 2 and n > 1 \
+                and self._corrupt_budget():
+            # The received sender-block axis rotates by one on the
+            # target rank: every block is attributed to the wrong
+            # source — rows that hash elsewhere enter the local join.
+            active = (self.axis_index()
+                      == jnp.int32(self._corrupt_rank()))
+            return jnp.where(active, jnp.roll(y, 1, axis=0), y)
+        return y
+
+    def ragged_all_to_all(self, operand, output, input_offsets,
+                          send_sizes, output_offsets, recv_sizes):
+        mode = self.plan.corrupt_mode
+        n = self.n_ranks
+        if mode == "misroute" and n > 1 and self._corrupt_budget():
+            # The target SENDER reads two destinations' rows from each
+            # other's bucket offsets — its rows land at wrong ranks.
+            d1 = self.plan.seed % n
+            d2 = (d1 + 1 + (self.plan.seed // n) % (n - 1)) % n
+            swapped = input_offsets.at[d1].set(
+                input_offsets[d2]).at[d2].set(input_offsets[d1])
+            active = (self.axis_index()
+                      == jnp.int32(self._corrupt_rank()))
+            input_offsets = jnp.where(active, swapped, input_offsets)
+        out = self._inner.ragged_all_to_all(
+            operand, output, input_offsets, send_sizes,
+            output_offsets, recv_sizes,
+        )
+        if mode == "bit_flip" and self._corrupt_budget():
+            active = (self.axis_index()
+                      == jnp.int32(self._corrupt_rank()))
+            out = _flip_one_bit(out, self.plan.seed, active)
+        return out
+
     def all_gather(self, x):
         g = self._inner.all_gather(x)
+        if (self.plan.corrupt_mode in ("row_truncate", "row_duplicate")
+                and x.ndim == 1 and x.dtype == jnp.int32
+                and x.shape[0] == self.n_ranks
+                and self._corrupt_budget()):
+            # The ragged plan's count-matrix gather, perturbed
+            # IDENTICALLY on every rank: a consistent lie about how
+            # many rows the target sender routes to one destination.
+            # validate_ragged_plan only checks cross-rank consistency,
+            # so this sails through it — the wire digests are the only
+            # layer that can catch it (sender digests commit to the
+            # TRUE local counts before any exchange).
+            n = self.n_ranks
+            row = self._corrupt_rank()
+            col = (self.plan.seed // n) % n
+            delta = -1 if self.plan.corrupt_mode == "row_truncate" \
+                else 1
+            g2 = g.reshape(n, n).at[row, col].add(jnp.int32(delta))
+            g = jnp.maximum(g2, 0).reshape(g.shape)
         if (x.ndim == 1 and x.dtype == jnp.int32
                 and x.shape[0] == self.n_ranks
                 and self._plan_gathers < self.plan.corrupt_plan_gathers):
@@ -223,6 +362,36 @@ class FaultInjectingCommunicator(Communicator):
             return compiled(*args, **kwargs)
 
         return dispatch
+
+
+def _flip_one_bit(block, seed: int, active):
+    """One bit of one (seed-addressed) element of ``block`` flips where
+    ``active`` (a traced bool — the corrupt rank predicate) holds: the
+    minimal in-flight payload corruption. Integer dtypes (and f32, via
+    bitcast) flip a real bit; f64 — whose bitcast the TPU x64 rewriter
+    can't lower — degrades to an additive nudge, which serves the same
+    adversarial purpose."""
+    dt = block.dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        if dt == jnp.float32:
+            u = jax.lax.bitcast_convert_type(block, jnp.uint32)
+            return jax.lax.bitcast_convert_type(
+                _flip_one_bit(u, seed, active), dt)
+        flat = block.reshape(-1)
+        idx = seed % flat.shape[0]
+        bumped = flat.at[idx].add(jnp.where(active, dt.type(1.0),
+                                            dt.type(0.0)))
+        return bumped.reshape(block.shape)
+    flat = block.reshape(-1)
+    idx = seed % flat.shape[0]
+    nbits = dt.itemsize * 8
+    bit = (seed // max(flat.shape[0], 1)) % nbits
+    # left_shift wraps into the sign bit for bit == nbits-1 — exactly a
+    # bit flip there too (two's complement).
+    mask = jnp.left_shift(jnp.asarray(1, dt), bit)
+    flipped = flat.at[idx].set(
+        jnp.where(active, flat[idx] ^ mask, flat[idx]))
+    return flipped.reshape(block.shape)
 
 
 # -- ragged-plan validation -------------------------------------------
@@ -453,7 +622,10 @@ def retry_with_backoff(
 class RetryAttempt:
     """One rung of the ladder: the sizing that ran and what happened.
     ``action`` is what produced this attempt's sizing ("initial",
-    "widen_compression_bits", "double_capacities")."""
+    "widen_compression_bits", "double_capacities", or
+    "retry_integrity" — a same-sizing rerun after a wire-integrity
+    mismatch). ``integrity_ok`` is the digest verdict when the attempt
+    was verified (None: verification off, or skipped on overflow)."""
 
     attempt: int
     action: str
@@ -465,6 +637,7 @@ class RetryAttempt:
     hh_build_capacity: Optional[int]
     hh_probe_capacity: Optional[int]
     hh_out_capacity: Optional[int]
+    integrity_ok: Optional[bool] = None
 
     def as_record(self) -> dict:
         return dataclasses.asdict(self)
@@ -558,7 +731,8 @@ class CapacityLadder:
             hh_out_capacity=self.hh_out,
         )
 
-    def note(self, overflow: Optional[bool]) -> None:
+    def note(self, overflow: Optional[bool],
+             integrity_ok: Optional[bool] = None) -> None:
         """Record the outcome of running the current rung. The attempt
         also lands in the telemetry event log (the RetryReport's
         per-attempt record, streamed as it happens — a killed run
@@ -574,6 +748,7 @@ class CapacityLadder:
             hh_build_capacity=self.hh_build,
             hh_probe_capacity=self.hh_probe,
             hh_out_capacity=self.hh_out,
+            integrity_ok=integrity_ok,
         )
         self._attempts.append(att)
         from distributed_join_tpu import telemetry
@@ -600,6 +775,15 @@ class CapacityLadder:
                 self.hh_out = (max(self.hh_out * 2, self.p_local)
                                if self.p_local else self.hh_out * 2)
         self._action = "double_capacities"
+        return self._action
+
+    def hold(self, action: str = "retry_integrity") -> str:
+        """Advance to a rung with the SAME sizing — the retry that
+        answers a wire-integrity mismatch (corruption is transient;
+        the capacities were right) rather than an overflow. The rerun
+        still recompiles, so a finite injected corruption budget
+        (FaultPlan.corrupt_collectives) exhausts across holds."""
+        self._action = action
         return self._action
 
     def report(self) -> RetryReport:

@@ -1,0 +1,326 @@
+"""Wire-integrity verification: in-graph shuffle checksums.
+
+The reference trusts its transport — exact-size NCCL/UCX buffers mean
+a delivered shuffle is assumed correct (SURVEY.md §2) — and the TPU
+port inherited that assumption: PR 1's fault layer can *re-run* a join
+but cannot tell whether a completed join delivered the right rows.
+This module closes that gap with order-invariant per-(src-rank,
+dst-rank) payload digests computed INSIDE the compiled SPMD step:
+
+- every sender digests the rows it routes to each destination
+  (:func:`padded_block_digests` over the padded layout,
+  :func:`segment_digests` over the ragged bucket layout);
+- every receiver digests the rows it believes it received from each
+  source, using its own (possibly corrupted) counts/plan — so a
+  truncated, duplicated, bit-flipped, or misrouted delivery disagrees
+  with what the sender committed to;
+- the 2n per-side digests ride the existing :class:`telemetry.Metrics`
+  all_gather at step end (``<side>.integrity.sent_to_j`` /
+  ``recv_from_j`` names) — no extra collective, no host callback, and
+  telemetry-off + integrity-off remains the exact seed program;
+- :func:`verify_digests` checks, host-side, that rank s's
+  ``sent_to_d`` equals rank d's ``recv_from_s`` for every pair,
+  producing a structured :class:`IntegrityReport`;
+  ``distributed_inner_join(verify_integrity=True)`` raises
+  :class:`IntegrityError` instead of returning corrupt rows.
+
+Digest construction: per-row Murmur3-finalizer hash over every column
+(``ops.hashing`` — the same primitives that route the rows), folded
+once more through ``fmix64``, then SUMMED over the rows of each
+(src, dst) bucket. Addition is commutative, so the digest is invariant
+to row order (receivers repack rows) while any changed, missing,
+duplicated, or foreign row shifts the sum. Digests are truncated to 63
+bits so they travel exactly in the Metrics block's int64 lanes;
+cross-batch accumulation wraps identically on both sides, so equality
+is preserved end to end (collision odds ~2^-63 per pair).
+
+Coverage contract (docs/FAILURE_SEMANTICS.md "Integrity contract"):
+the digests cover the shuffle data plane — every row and byte that
+rides ``all_to_all``/``ragged_all_to_all``, variable-width string
+planes included. The skew path's heavy-hitter broadcast and the local
+join itself are outside the checked channel, and verification is only
+meaningful on a non-overflowed result (an overflow clamps rows by
+design and already demands a retry).
+
+Host mirrors (``*_np``) reuse :mod:`..out_of_core`'s numpy hash
+mirrors so the chaos harness (:mod:`..chaos`) can oracle whole joined
+tables without a device in the loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_join_tpu.ops.hashing import (
+    fmix32,
+    fmix64,
+    hash_combine,
+)
+
+# Digests travel in the Metrics block's int64 lanes; the top bit is
+# masked so unsigned->signed conversion is exact (equality survives
+# identical int64 wrap on both sides across batch accumulation).
+_FOLD63 = (1 << 63) - 1
+
+_SENT_RE = re.compile(r"^(?P<channel>.+)\.integrity\.sent_to_(?P<dst>\d+)$")
+
+
+class IntegrityError(RuntimeError):
+    """A completed shuffle delivered rows that do not match what their
+    senders committed to — corruption crossed the wire. Carries the
+    structured :class:`IntegrityReport` as ``.report``. Distinct from
+    overflow (a sizing problem a capacity retry fixes): retrying an
+    integrity mismatch re-runs the SAME sizing, because the data was
+    wrong, not small."""
+
+    def __init__(self, report: "IntegrityReport"):
+        self.report = report
+        pairs = ", ".join(
+            f"{m['channel']}[{m['src']}->{m['dst']}]"
+            for m in report.mismatches[:4]
+        )
+        more = ("" if len(report.mismatches) <= 4
+                else f" (+{len(report.mismatches) - 4} more)")
+        super().__init__(
+            f"wire integrity violated on {len(report.mismatches)} of "
+            f"{report.checked_pairs} (src,dst) digest pairs: {pairs}"
+            f"{more} — the shuffle delivered rows its senders did not "
+            "send; do not trust this result"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegrityReport:
+    """Host-side verdict of one verified join/exchange.
+
+    ``mismatches`` holds one dict per failed (src, dst) pair:
+    ``{"channel", "src", "dst", "sent", "recv"}`` where ``channel`` is
+    the digest namespace (``build``/``probe`` for the join shuffles).
+    ``checked_pairs`` counts pairs compared; 0 means the program
+    carried no digests (e.g. the single-rank path has no wire) and the
+    report is vacuously ok."""
+
+    ok: bool
+    checked_pairs: int
+    channels: tuple
+    mismatches: tuple
+
+    def as_record(self) -> dict:
+        """JSON-shaped record drivers embed under ``"integrity"``."""
+        return {
+            "ok": self.ok,
+            "checked_pairs": self.checked_pairs,
+            "channels": list(self.channels),
+            "mismatches": [dict(m) for m in self.mismatches],
+        }
+
+
+# -- device-side digests ----------------------------------------------
+
+
+def _digest_column(col: jax.Array) -> jax.Array:
+    """Per-row uint64 hash of one column (leading axis = rows).
+
+    1-D columns reuse the join's own per-dtype hash dispatch; wider
+    columns (fixed-width string bytes, packed word planes) fold every
+    trailing lane through ``hash_combine`` — lane INDEX matters, so a
+    byte moving within a row changes the digest."""
+    from distributed_join_tpu.ops.hashing import _hash_one
+
+    if col.ndim == 1:
+        return _hash_one(col)
+    flat = col.reshape(col.shape[0], -1)
+    if flat.dtype == jnp.uint8 and flat.shape[1] % 4 == 0:
+        # Byte columns fold 4x fewer lanes as u32 words.
+        flat = lax.bitcast_convert_type(
+            flat.reshape(col.shape[0], -1, 4), jnp.uint32
+        )
+    acc = None
+    for w in range(flat.shape[1]):
+        lane = flat[:, w]
+        h = (fmix32(lane).astype(jnp.uint64)
+             if lane.dtype.itemsize < 8 else fmix64(lane))
+        # Mix the lane index in so transposed/shifted bytes differ.
+        h = hash_combine(h, jnp.uint64(w + 1))
+        acc = h if acc is None else hash_combine(acc, h)
+    return fmix64(acc)
+
+
+def row_digests(columns: dict) -> jax.Array:
+    """(rows,) uint64 — one order-invariant-summable digest per row
+    over EVERY column, combined in sorted-name order (both sides of an
+    exchange hold the same column set, so the order is shared)."""
+    acc = None
+    for name in sorted(columns):
+        h = _digest_column(columns[name])
+        acc = h if acc is None else hash_combine(acc, h)
+    return fmix64(acc)
+
+
+def fold63(digest: jax.Array) -> jax.Array:
+    """uint64 digest -> int64 metric lane (top bit masked; see module
+    docstring for why equality survives the fold + wrap)."""
+    return (digest & jnp.uint64(_FOLD63)).astype(jnp.int64)
+
+
+def padded_block_digests(columns: dict, counts: jax.Array) -> jax.Array:
+    """(n,) int64 digests of a padded (n, capacity, ...) block layout —
+    entry j sums the per-row digests of block j's first ``counts[j]``
+    rows (sender: rows routed to destination j; receiver: rows believed
+    received from source j). Padding slots hold clipped-gather garbage
+    and are excluded by the count mask."""
+    n, capacity = next(iter(columns.values())).shape[:2]
+    flat = {name: c.reshape((n * capacity,) + c.shape[2:])
+            for name, c in columns.items()}
+    rd = row_digests(flat).reshape(n, capacity)
+    lane = jnp.arange(capacity, dtype=jnp.int32)
+    valid = lane[None, :] < counts[:, None]
+    return fold63(jnp.sum(jnp.where(valid, rd, jnp.uint64(0)), axis=1))
+
+
+def segment_digests(digests: jax.Array, starts: jax.Array,
+                    sizes: jax.Array) -> jax.Array:
+    """(n,) int64 digests of n row segments ``[starts[j], starts[j] +
+    sizes[j])`` of a flat per-row digest vector (the ragged layouts:
+    sender buckets, receiver sender-blocks). Interval sums off one
+    exclusive prefix sum; out-of-range segments clamp to empty."""
+    rows = digests.shape[0]
+    cs = jnp.concatenate(
+        [jnp.zeros((1,), jnp.uint64), jnp.cumsum(digests)]
+    )
+    lo = jnp.clip(starts.astype(jnp.int32), 0, rows)
+    hi = jnp.clip(
+        starts.astype(jnp.int32) + sizes.astype(jnp.int32), lo, rows
+    )
+    return fold63(cs[hi] - cs[lo])
+
+
+def record_pair_digests(digest_tape, sent: jax.Array,
+                        recv: jax.Array) -> None:
+    """Accumulate one exchange's per-peer digest vectors onto the
+    metrics tape (``sent_to_j`` / ``recv_from_j`` under the tape's
+    prefix). Sums across the k over-decomposition batches — the digest
+    is order-invariant, so the per-(src,dst) check holds over the whole
+    step."""
+    n = sent.shape[0]
+    for j in range(n):
+        digest_tape.add(f"sent_to_{j}", sent[j])
+        digest_tape.add(f"recv_from_{j}", recv[j])
+
+
+# -- host-side verification -------------------------------------------
+
+
+def verify_digests(metrics, channels: Optional[Sequence[str]] = None
+                   ) -> IntegrityReport:
+    """Check every ``<channel>.integrity.sent_to_d`` against its
+    ``recv_from_s`` partner across the gathered per-rank metric block.
+
+    ``metrics`` is a :class:`telemetry.Metrics` or its ``to_dict()``
+    form (ONE host transfer either way). The invariant: the digest
+    rank s reported for (s -> d) must equal the digest rank d reported
+    for (s -> d); any disagreement means rows changed in flight —
+    bit-flips, truncation, duplication, or misrouting, attributed to
+    the exact (src, dst, channel) that disagreed."""
+    d = metrics.to_dict() if hasattr(metrics, "to_dict") else metrics
+    per_rank = d["per_rank"]
+    n = int(d["n_ranks"])
+    found = sorted({
+        m.group("channel") for name in per_rank
+        for m in (_SENT_RE.match(name),) if m is not None
+    })
+    if channels is not None:
+        found = [c for c in found if c in set(channels)]
+    mismatches = []
+    checked = 0
+    for channel in found:
+        for src in range(n):
+            for dst in range(n):
+                sent = per_rank[
+                    f"{channel}.integrity.sent_to_{dst}"][src]
+                recv = per_rank[
+                    f"{channel}.integrity.recv_from_{src}"][dst]
+                checked += 1
+                if sent != recv:
+                    mismatches.append({
+                        "channel": channel, "src": src, "dst": dst,
+                        "sent": int(sent), "recv": int(recv),
+                    })
+    return IntegrityReport(
+        ok=not mismatches,
+        checked_pairs=checked,
+        channels=tuple(found),
+        mismatches=tuple(mismatches),
+    )
+
+
+def verify_join_result(res) -> IntegrityReport:
+    """Verify a join result produced with ``with_integrity=True`` (its
+    metrics block carries the digests as ``res.telemetry``)."""
+    metrics = getattr(res, "telemetry", None)
+    if metrics is None:
+        raise ValueError(
+            "result carries no metrics block — build the join with "
+            "with_integrity=True (or verify_integrity=True on "
+            "distributed_inner_join)"
+        )
+    return verify_digests(metrics)
+
+
+# -- numpy mirror (chaos-harness oracle) ------------------------------
+
+
+def row_digests_np(columns: dict):
+    """numpy mirror of :func:`row_digests` — NOT used for wire
+    verification (both ends of the wire digest on device); it lets the
+    chaos harness compare a fetched join OUTPUT against the pandas
+    oracle as an order-invariant multiset, catching payload corruption
+    a match-count oracle would miss. Bit-exact with the device digest
+    for integer and float32 columns (the f64 caveat of
+    ``out_of_core._hash_one_np`` applies — chaos configs stay int)."""
+    import numpy as np
+
+    from distributed_join_tpu.parallel.out_of_core import (
+        _hash_one_np,
+        fmix32_np,
+        fmix64_np,
+        hash_combine_np,
+    )
+
+    acc = None
+    for name in sorted(columns):
+        col = np.asarray(columns[name])
+        if col.ndim == 1:
+            h = _hash_one_np(col)
+        else:
+            flat = col.reshape(col.shape[0], -1)
+            if flat.dtype == np.uint8 and flat.shape[1] % 4 == 0:
+                flat = flat.reshape(col.shape[0], -1, 4).view(
+                    np.uint32).reshape(col.shape[0], -1)
+            h = None
+            for w in range(flat.shape[1]):
+                lane = flat[:, w]
+                lh = (fmix32_np(lane).astype(np.uint64)
+                      if lane.dtype.itemsize < 8 else fmix64_np(lane))
+                lh = hash_combine_np(lh, np.uint64(w + 1))
+                h = lh if h is None else hash_combine_np(h, lh)
+            h = fmix64_np(h)
+        acc = h if acc is None else hash_combine_np(acc, h)
+    return fmix64_np(acc)
+
+
+def table_digest_np(columns: dict) -> int:
+    """Order-invariant 63-bit multiset digest of a host table (dict of
+    equal-length numpy columns) — the chaos oracle's comparison unit."""
+    import numpy as np
+
+    if not columns or next(iter(columns.values())).shape[0] == 0:
+        return 0
+    rd = row_digests_np(columns)
+    return int(np.sum(rd, dtype=np.uint64) & np.uint64(_FOLD63))

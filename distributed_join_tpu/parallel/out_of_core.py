@@ -147,6 +147,8 @@ def batched_join_host(
     batch_retries: int = 0,
     batch_retry_backoff_s: float = 1.0,
     on_batch_failure: str = "raise",
+    verify_integrity: bool = False,
+    batch_deadline_s: Optional[float] = None,
     **join_opts,
 ) -> Tuple[int, bool]:
     """Join pre-binned HOST batches (lists of numpy column dicts, e.g.
@@ -175,6 +177,24 @@ def batched_join_host(
       out-of-core run on one bad batch.
     - ``stats`` additionally receives ``resumed_batches`` (ids skipped
       via the manifest) and ``failed_batches``.
+    - ``verify_integrity``: each batch's join carries the in-graph
+      wire digests (parallel/integrity.py; the compiled program's aux
+      Metrics block) and is verified at its settle point. A mismatch
+      is a batch failure under the same contract as a dispatch
+      failure: ``"raise"`` propagates the
+      :class:`..integrity.IntegrityError`; ``"continue"`` abandons the
+      batch (its corrupt total is NOT counted) and records it in
+      ``failed_batches`` + the manifest's failure log — never folded
+      silently into the returned total.
+    - ``batch_deadline_s``: bound each batch's result fetch under the
+      shared hang watchdog (:mod:`..watchdog`): a deadlocked
+      collective (or a wedged relay) surfaces as a structured
+      ``HangError`` batch failure — same degradation contract —
+      instead of blocking the loop forever. Worker teardown on the
+      error path is also bounded (``watchdog.shutdown_bounded``), so
+      an orphaned stage/fetch worker reports a
+      ``worker_shutdown_timeout`` event rather than hanging the
+      interpreter at exit.
 
     This is the out-of-core hot path (VERDICT r1 weak #5: the r1 loop
     was fully serial). The pipeline, per loop iteration:
@@ -301,17 +321,43 @@ def batched_join_host(
     # program even under an active telemetry session — out-of-core
     # observability is host-side by design (phase counters, per-batch
     # spans/events above), and an aux device block nobody fetches
-    # would still be computed every batch.
+    # would still be computed every batch. verify_integrity is the
+    # exception: its digests ARE fetched, at each batch's settle.
     fn = make_distributed_join(comm, key=key, with_metrics=False,
+                               with_integrity=verify_integrity,
                                **join_opts)
     pool = ThreadPoolExecutor(max_workers=1)
     fetch_pool = ThreadPoolExecutor(max_workers=1)
 
-    def _fetch(b, res):
+    # {pending index -> IntegrityReport} verified on the fetch worker
+    # (reused by _settle so each batch's digests are checked once).
+    verified_reports: dict = {}
+
+    def _fetch(i, b, res):
         # Runs ON the fetch worker, in batch order (1 worker). The
         # consumer's D2H pulls overlap the NEXT batch's device compute
         # — mirror image of the staging thread. numpy materialization
         # and the transfer both release the GIL.
+        if verify_integrity and getattr(res, "telemetry", None) is not None:
+            # Verify BEFORE the consumer sees a single row: a wire-
+            # corrupted batch must be abandoned, not persisted by a
+            # materializing consumer and only flagged at settle. The
+            # overflow fetch + digest transfer ride this worker, so
+            # the overlap the fetch thread exists for is preserved.
+            # (Overflowed batches skip the check — clamped rows
+            # mismatch by design and the flag already demands a
+            # retry; the consumer contract for flagged batches is
+            # unchanged.)
+            if not bool(res.overflow):
+                from distributed_join_tpu.parallel import integrity
+
+                rep = integrity.verify_digests(res.telemetry)
+                verified_reports[i] = rep
+                if not rep.ok:
+                    telemetry.event(
+                        "batch_integrity_mismatch", batch=b,
+                        mismatches=len(rep.mismatches))
+                    return  # _settle fails the batch under contract
         with telemetry.span("fetch", batch=b):
             tf = time.perf_counter()
             on_batch_result(b, res)
@@ -364,16 +410,44 @@ def batched_join_host(
         # happens under 'continue', which returned above.
         raise last
 
+    def _fetch_scalars(i):
+        """The one host sync per batch: total + overflow flag, under
+        the hang watchdog when a batch deadline is configured (a
+        deadlocked collective never sequences this fetch — HangError
+        is a batch failure, not an eternity)."""
+        if batch_deadline_s is None:
+            return int(totals[i]), bool(overflows[i])
+        from distributed_join_tpu.parallel.watchdog import (
+            call_with_deadline,
+        )
+
+        return call_with_deadline(
+            lambda: (int(totals[i]), bool(overflows[i])),
+            batch_deadline_s,
+            what=f"out-of-core batch {pending[i]} result fetch",
+        )
+
     def _settle(i):
-        """Force pending[i]'s total to host (the device sync) and
-        persist its manifest record. A failure HERE (result fetch) is
-        a batch failure too — same degradation contract as dispatch."""
+        """Force pending[i]'s total to host (the device sync), verify
+        its wire digests when asked, and persist its manifest record.
+        A failure HERE (result fetch, hang, integrity mismatch) is a
+        batch failure too — same degradation contract as dispatch."""
         if totals[i] is None or isinstance(totals[i], int):
             return
         b = pending[i]
         try:
-            totals[i] = int(totals[i])
-            overflows[i] = bool(overflows[i])
+            totals[i], overflows[i] = _fetch_scalars(i)
+            if (verify_integrity and not overflows[i]
+                    and metrics_refs[i] is not None):
+                from distributed_join_tpu.parallel import integrity
+
+                rep = verified_reports.get(i)
+                if rep is None:
+                    rep = integrity.verify_digests(metrics_refs[i])
+                if not rep.ok:
+                    # A corrupt batch total must never fold into the
+                    # returned sum — surface or abandon, per contract.
+                    raise integrity.IntegrityError(rep)
         except Exception as exc:  # noqa: BLE001 - degradation seam
             if manifest is not None:
                 manifest.record_failure(
@@ -403,7 +477,17 @@ def batched_join_host(
         res = _dispatch(pending[0], *nxt)
         if res is not None:
             try:
-                int(res.total)
+                if batch_deadline_s is None:
+                    int(res.total)
+                else:
+                    from distributed_join_tpu.parallel.watchdog import (
+                        call_with_deadline,
+                    )
+
+                    call_with_deadline(
+                        lambda: int(res.total), batch_deadline_s,
+                        what="out-of-core warmup result fetch",
+                    )
             except Exception as exc:  # noqa: BLE001 - degradation seam
                 # Same contract as _settle: an async device failure
                 # that only surfaces at the scalar fetch is a batch
@@ -434,10 +518,12 @@ def batched_join_host(
     if pending:
         fut = (pool.submit(lambda: nxt) if nxt is not None
                else pool.submit(stage, pending[0]))
-    # All three lists are positionally aligned with `pending`;
+    # All four lists are positionally aligned with `pending`;
     # totals[i] is a device scalar until _settle(i) fetches it, None
-    # for a failed/abandoned batch.
-    totals, overflows, fetch_futs = [], [], []
+    # for a failed/abandoned batch. metrics_refs holds only the small
+    # aux Metrics block (verify_integrity) — never the output table,
+    # so backpressure still bounds device residency.
+    totals, overflows, fetch_futs, metrics_refs = [], [], [], []
     try:
         for i, b in enumerate(pending):
             bt, pt = fut.result()
@@ -452,8 +538,12 @@ def batched_join_host(
                 failed.discard(b)
             totals.append(res.total if res is not None else None)
             overflows.append(res.overflow if res is not None else None)
+            metrics_refs.append(
+                getattr(res, "telemetry", None)
+                if res is not None else None
+            )
             fetch_futs.append(
-                fetch_pool.submit(_fetch, b, res)
+                fetch_pool.submit(_fetch, i, b, res)
                 if (on_batch_result is not None and res is not None)
                 else None
             )
@@ -492,10 +582,18 @@ def batched_join_host(
         overflow = any(bool(o) for o in overflows if o is not None)
         _phase_add("fetch_wait_s", time.perf_counter() - tf)
     finally:
-        # Also on error: an orphaned worker would hang the interpreter
-        # at exit via ThreadPoolExecutor's atexit join.
-        pool.shutdown(wait=False, cancel_futures=True)
-        fetch_pool.shutdown(wait=False, cancel_futures=True)
+        # Also on error: an orphaned worker (wedged in a dead backend
+        # put/fetch) would hang the interpreter at exit via
+        # ThreadPoolExecutor's atexit join. Bounded teardown instead:
+        # join each worker briefly, then report a
+        # worker_shutdown_timeout event and detach it from the atexit
+        # join (watchdog.shutdown_bounded).
+        from distributed_join_tpu.parallel.watchdog import (
+            shutdown_bounded,
+        )
+
+        shutdown_bounded(pool, "out_of_core.stage")
+        shutdown_bounded(fetch_pool, "out_of_core.fetch")
     # Fold in the batches a prior (killed) run already completed —
     # totals only: overflowed entries were filtered back into
     # `pending` above, so `completed` carries no overflow.
@@ -530,12 +628,15 @@ def keyrange_batched_join(
     batch_retries: int = 0,
     batch_retry_backoff_s: float = 1.0,
     on_batch_failure: str = "raise",
+    verify_integrity: bool = False,
+    batch_deadline_s: Optional[float] = None,
     **join_opts,
 ) -> Tuple[int, bool]:
     """Join arbitrarily large host-resident tables in ``n_batches``
     device-sized pieces; returns (total_matches, any_overflow).
-    ``manifest_path``/``batch_retries``/``on_batch_failure`` are the
-    checkpoint/resume + per-batch recovery knobs of
+    ``manifest_path``/``batch_retries``/``on_batch_failure``/
+    ``verify_integrity``/``batch_deadline_s`` are the checkpoint/
+    resume + per-batch recovery/verification knobs of
     :func:`batched_join_host` (binning is deterministic — the same
     tables and ``n_batches`` always rebuild the same batches, which is
     what makes resuming against the manifest sound).
@@ -584,5 +685,7 @@ def keyrange_batched_join(
         manifest_path=manifest_path, batch_retries=batch_retries,
         batch_retry_backoff_s=batch_retry_backoff_s,
         on_batch_failure=on_batch_failure,
+        verify_integrity=verify_integrity,
+        batch_deadline_s=batch_deadline_s,
         **join_opts,
     )

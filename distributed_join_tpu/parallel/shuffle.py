@@ -45,7 +45,7 @@ from distributed_join_tpu.table import Table
 
 def shuffle_padded(
     comm: Communicator, padded_columns, counts: jax.Array, capacity: int,
-    via: str = "all_to_all", tape=None,
+    via: str = "all_to_all", tape=None, digest_tape=None,
 ) -> Tuple[Table, jax.Array]:
     """Shuffle a pre-padded (n_ranks, capacity) block; returns the
     received rows as a masked Table plus the received counts.
@@ -62,12 +62,28 @@ def shuffle_padded(
     ``n_ranks x capacity`` block per column, pad included, because
     that IS what rides the wire (the ~1/load-factor inflation the
     module docstring describes, now measurable per run). Metadata
-    (the count exchange) is not billed; see docs/OBSERVABILITY.md."""
+    (the count exchange) is not billed; see docs/OBSERVABILITY.md.
+
+    ``digest_tape`` (a ``MetricsTape`` view, or None) receives the
+    wire-integrity digests (parallel/integrity.py): per destination
+    the digest of the rows this rank ROUTED there (computed on the
+    pre-exchange block) and per source the digest of the rows it
+    BELIEVES it received (the post-exchange block under its own —
+    possibly corrupted — received counts); the host-side pair check
+    is ``integrity.verify_digests``."""
     a2a = (
         comm.ppermute_all_to_all if via == "ppermute" else comm.all_to_all
     )
     recv_counts = comm.all_to_all(counts)
     recv_cols = {n: a2a(c) for n, c in padded_columns.items()}
+    if digest_tape is not None:
+        from distributed_join_tpu.parallel import integrity
+
+        integrity.record_pair_digests(
+            digest_tape,
+            integrity.padded_block_digests(padded_columns, counts),
+            integrity.padded_block_digests(recv_cols, recv_counts),
+        )
     if tape is not None:
         tape.add("rows_shuffled", jnp.sum(counts.astype(jnp.int64)))
         tape.add("rows_received",
@@ -81,6 +97,7 @@ def shuffle_padded(
 def shuffle_padded_compressed(
     comm: Communicator, padded_columns, counts: jax.Array, capacity: int,
     bits: int, block: int = 256, via: str = "all_to_all", tape=None,
+    digest_tape=None,
 ) -> Tuple[Table, jax.Array, jax.Array]:
     """Padded shuffle with the FoR+bitpack codec on the wire.
 
@@ -171,6 +188,19 @@ def shuffle_padded_compressed(
             )
 
         recv_cols[name] = jax.vmap(_dec)(rwords, rframes)
+    if digest_tape is not None:
+        # Sender digests run on the ORIGINAL block (valid slots are
+        # untouched by the codec's pad-fill trick); receiver digests
+        # on the decoded block. A lossy encode (residual wider than
+        # ``bits``) would mismatch, but it also fires c_ovf — and
+        # verification is only consulted on non-overflowed results.
+        from distributed_join_tpu.parallel import integrity
+
+        integrity.record_pair_digests(
+            digest_tape,
+            integrity.padded_block_digests(padded_columns, counts),
+            integrity.padded_block_digests(recv_cols, recv_counts),
+        )
     if tape is not None:
         tape.add("rows_shuffled", jnp.sum(counts.astype(jnp.int64)))
         tape.add("rows_received",
@@ -247,6 +277,7 @@ def shuffle_ragged(
     capacity_per_bucket: int | None = None,
     varwidth=None,
     tape=None,
+    digest_tape=None,
 ) -> Tuple[Table, jax.Array]:
     """Exact-size shuffle of ``n_ranks`` buckets starting at
     ``bucket_start``: wire bytes = actual rows, not padded capacity.
@@ -373,6 +404,32 @@ def shuffle_ragged(
         # clamps nothing and must leave delivered data intact
         # (ADVICE r5 / ragged_plan's contract).
         out_cols[name] = jnp.where(row_clamped, 0, unsorted)
+    if digest_tape is not None:
+        # Wire-integrity digests (parallel/integrity.py). Sender side:
+        # per-destination segment sums over the bucket-sorted layout's
+        # TRUE local counts — committed before any plan/count exchange
+        # could lie. Receiver side: segment sums over the assembled
+        # output under the boundaries the receiver PLANS with
+        # (start/allowed derive from the gathered count matrix), so a
+        # consistently corrupted metadata exchange — which
+        # validate_ragged_plan cannot see — still disagrees with the
+        # sender's commitment. Rows align across columns here: the row
+        # exchange packs in partition order per sender block and the
+        # extra varwidth columns were just unsorted back to it. An
+        # ACTUAL clamp breaks alignment by design, but it also raises
+        # the overflow flag, and verification is only consulted on
+        # non-overflowed results.
+        from distributed_join_tpu.parallel import integrity
+
+        me = comm.axis_index()
+        rd_sent = integrity.row_digests(sorted_table.columns)
+        rd_recv = integrity.row_digests(out_cols)
+        integrity.record_pair_digests(
+            digest_tape,
+            integrity.segment_digests(rd_sent, offsets, counts),
+            integrity.segment_digests(rd_recv, start[:, me],
+                                      allowed[:, me]),
+        )
     valid = jnp.arange(out_capacity, dtype=jnp.int32) < total_recv
     return Table(out_cols, valid), overflow
 

@@ -46,7 +46,10 @@ class Metrics:
     def to_dict(self) -> dict:
         """Host-side summary (ONE device transfer): per-rank values
         plus the per-metric cross-rank reduction (sum, or min/max by
-        name suffix)."""
+        name suffix). Wire-integrity digest lanes (``*.integrity.*``,
+        parallel/integrity.py) are per-(rank, peer) checksums — no
+        cross-rank reduction is meaningful, so they appear only in
+        ``per_rank`` (where ``verify_digests`` reads them)."""
         import numpy as np
 
         vals = np.asarray(self.values)
@@ -54,6 +57,8 @@ class Metrics:
                     for i, n in enumerate(self.names)}
         reduced = {}
         for n, v in per_rank.items():
+            if ".integrity." in n:
+                continue
             if n.endswith("_min"):
                 reduced[n] = min(v)
             elif n.endswith("_max"):

@@ -13,6 +13,10 @@
 #   scripts/run_tier1.sh lint       # joinlint: AST SPMD-hazard rules
 #                                   # + jaxpr collective-schedule check
 #                                   # vs results/schedules/ goldens
+#   scripts/run_tier1.sh chaos      # fixed-seed ~20-trial chaos soak
+#                                   # (faults x configs, pandas-oracle
+#                                   # verified, wire digests on) +
+#                                   # -m chaos unit suite
 #
 # Notes:
 # - tests/conftest.py points the persistent XLA compile cache at
@@ -92,8 +96,26 @@ case "$lane" in
       JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
       python -m distributed_join_tpu.analysis.lint
     ;;
+  chaos)
+    # Chaos smoke (docs/FAILURE_SEMANTICS.md "Integrity contract"):
+    # the -m chaos unit suite, then a fixed-seed 20-trial soak on the
+    # 8-virtual-device CPU mesh — randomized fault schedules
+    # (including every corruption mode) x join configs, every trial
+    # graded against the pandas oracle with wire digests on. Exit 1 =
+    # a trial returned wrong rows silently or hung (minimal-repro
+    # JSON written under /tmp); replay one trial with
+    # `python -m distributed_join_tpu.parallel.chaos --seed 42
+    # --trial K`.
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m chaos --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      python -m distributed_join_tpu.parallel.chaos \
+      --trials 20 --seed 42 --repro-out /tmp/djtpu_chaos_repro.json
+    ;;
   *)
-    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint]" >&2
+    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos]" >&2
     exit 2
     ;;
 esac

@@ -1,0 +1,17 @@
+"""Known-bad joinlint fixture: DJL003 callback-discipline.
+
+Never executed — parsed by tests/test_lint.py. Integrity-ADJACENT
+code that is not the registered ``parallel/integrity.py`` /
+``parallel/chaos.py`` seam: a would-be digest helper smuggling a host
+callback into the compiled step. The seam registration is per-file,
+not per-topic — this must still flag.
+"""
+
+import jax
+
+
+def digest_via_host(rows):
+    # Looks like wire verification, but runs a host callback inside
+    # the compiled program — the exact pattern the in-graph digests
+    # exist to avoid.
+    return jax.pure_callback(lambda v: v.sum(), rows[:1], rows)

@@ -1,0 +1,309 @@
+"""Wire-integrity primitives, the shared hang watchdog, and the seeded
+chaos-soak harness (parallel/{integrity,watchdog,chaos}.py) on the
+8-virtual-device CPU mesh.
+
+The full-size soak lives in ``scripts/run_tier1.sh chaos`` (20 trials
+through the CLI); this suite covers the machinery — digest parity
+between the device and numpy mirrors, the host-side pair verifier, the
+watchdog's structured HangError + bounded pool teardown, driver flag
+plumbing, and a small deterministic soak slice.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_join_tpu.parallel import chaos, integrity, watchdog
+from distributed_join_tpu.parallel.faults import (
+    CORRUPTION_MODES,
+    FaultInjectingCommunicator,
+    FaultPlan,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# -- digest primitives ------------------------------------------------
+
+
+def _host_cols():
+    rng = np.random.default_rng(3)
+    return {
+        "key": rng.integers(0, 1 << 40, 64, dtype=np.int64),
+        "payload": rng.integers(-1000, 1000, 64, dtype=np.int32),
+        "bytes": rng.integers(0, 256, (64, 8), dtype=np.uint8)
+        .astype(np.uint8),
+    }
+
+
+def test_device_and_numpy_row_digests_agree():
+    """The chaos oracle's contract: the numpy mirror is bit-exact with
+    the device digest for integer + byte columns, so a host-side
+    multiset digest can grade a device-computed join output."""
+    cols = _host_cols()
+    dev = np.asarray(
+        integrity.row_digests({k: jnp.asarray(v)
+                               for k, v in cols.items()}))
+    host = integrity.row_digests_np(cols)
+    assert dev.dtype == np.uint64 and host.dtype == np.uint64
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_table_digest_is_order_invariant_and_content_sensitive():
+    cols = _host_cols()
+    d0 = integrity.table_digest_np(cols)
+    perm = np.random.default_rng(5).permutation(64)
+    shuffled = {k: v[perm] for k, v in cols.items()}
+    assert integrity.table_digest_np(shuffled) == d0
+    tampered = {k: v.copy() for k, v in cols.items()}
+    tampered["payload"][17] ^= 1
+    assert integrity.table_digest_np(tampered) != d0
+    dropped = {k: v[1:] for k, v in cols.items()}
+    assert integrity.table_digest_np(dropped) != d0
+
+
+def test_verify_digests_pairs_and_attribution():
+    """Hand-built 2-rank metric block: rank s's sent_to_d must meet
+    rank d's recv_from_s — one flipped lane is attributed to exactly
+    its (channel, src, dst)."""
+    per_rank = {
+        "t.integrity.sent_to_0": [10, 20],
+        "t.integrity.sent_to_1": [11, 21],
+        "t.integrity.recv_from_0": [10, 11],
+        "t.integrity.recv_from_1": [20, 21],
+    }
+    rep = integrity.verify_digests(
+        {"n_ranks": 2, "per_rank": per_rank})
+    assert rep.ok and rep.checked_pairs == 4
+    assert rep.channels == ("t",)
+
+    per_rank["t.integrity.recv_from_1"] = [20, 99]  # dst 1 <- src 1
+    rep = integrity.verify_digests(
+        {"n_ranks": 2, "per_rank": per_rank})
+    assert not rep.ok
+    assert rep.mismatches == (
+        {"channel": "t", "src": 1, "dst": 1, "sent": 21, "recv": 99},
+    )
+    json.dumps(rep.as_record())
+
+
+def test_integrity_error_message_names_pairs():
+    rep = integrity.IntegrityReport(
+        ok=False, checked_pairs=4, channels=("t",),
+        mismatches=({"channel": "t", "src": 1, "dst": 0,
+                     "sent": 1, "recv": 2},),
+    )
+    err = integrity.IntegrityError(rep)
+    assert "t[1->0]" in str(err) and err.report is rep
+
+
+# -- the shared hang watchdog -----------------------------------------
+
+
+def test_call_with_deadline_raises_structured_hang_error():
+    release = threading.Event()
+    try:
+        with pytest.raises(watchdog.HangError, match="0.2s") as ei:
+            watchdog.call_with_deadline(release.wait, 0.2,
+                                        what="stuck fetch")
+        rec = ei.value.record()
+        assert rec["error"] == "HangError"
+        assert rec["what"] == "stuck fetch"
+        assert rec["deadline_s"] == 0.2
+        json.dumps(rec)
+    finally:
+        release.set()
+
+
+def test_call_with_deadline_passes_results_and_exceptions():
+    assert watchdog.call_with_deadline(lambda: 42, 5.0) == 42
+    with pytest.raises(KeyError):
+        watchdog.call_with_deadline(
+            lambda: (_ for _ in ()).throw(KeyError("x")), 5.0)
+
+
+def test_shutdown_bounded_reports_wedged_worker():
+    from concurrent.futures import ThreadPoolExecutor
+
+    release = threading.Event()
+    pool = ThreadPoolExecutor(1)
+    pool.submit(release.wait)
+    time.sleep(0.05)  # let the worker pick the task up
+    try:
+        with pytest.warns(UserWarning, match="did not exit"):
+            assert not watchdog.shutdown_bounded(
+                pool, "test.pool", timeout_s=0.2)
+    finally:
+        release.set()
+    idle = ThreadPoolExecutor(1)
+    idle.submit(lambda: None).result()
+    assert watchdog.shutdown_bounded(idle, "test.idle", timeout_s=5.0)
+
+
+def test_resolve_guard_deadline_flag_env_precedence(monkeypatch):
+    class A:
+        guard_deadline_s = None
+
+    monkeypatch.delenv(watchdog.ENV_GUARD_DEADLINE, raising=False)
+    assert watchdog.resolve_guard_deadline(A()) is None
+    monkeypatch.setenv(watchdog.ENV_GUARD_DEADLINE, "120")
+    assert watchdog.resolve_guard_deadline(A()) == 120.0
+    A.guard_deadline_s = 60.0
+    assert watchdog.resolve_guard_deadline(A()) == 60.0
+    A.guard_deadline_s = 0.0  # explicit 0 = unguarded
+    assert watchdog.resolve_guard_deadline(A()) is None
+
+
+# -- the soak harness -------------------------------------------------
+
+
+def test_fault_plan_draw_is_deterministic_and_labeled():
+    p1 = chaos.random_fault_plan(chaos._trial_rng(9, 3))
+    p2 = chaos.random_fault_plan(chaos._trial_rng(9, 3))
+    assert p1 == p2
+    assert chaos.fault_label(FaultPlan()) == "none"
+    assert chaos.fault_label(FaultPlan(overflow_programs=1)) == \
+        "overflow"
+    assert chaos.fault_label(
+        FaultPlan(corrupt_mode="misroute", corrupt_collectives=1)
+    ) == "misroute"
+    labels = {
+        chaos.fault_label(chaos.random_fault_plan(
+            chaos._trial_rng(11, k)))
+        for k in range(40)
+    }
+    assert "none" in labels
+    assert labels & set(CORRUPTION_MODES), "no corruption drawn in 40"
+
+
+def test_wrap_communicator_is_seeded_fault_injection():
+    import distributed_join_tpu as dj
+
+    comm = chaos.wrap_communicator(
+        dj.make_communicator("tpu", n_ranks=8), seed=4)
+    assert isinstance(comm, FaultInjectingCommunicator)
+    comm2 = chaos.wrap_communicator(
+        dj.make_communicator("tpu", n_ranks=8), seed=4)
+    assert comm.plan == comm2.plan
+
+
+def test_run_trial_is_deterministic():
+    r1 = chaos.run_trial(123, 0, deadline_s=None)
+    r2 = chaos.run_trial(123, 0, deadline_s=None)
+    for k in ("verdict", "config", "fault", "fault_plan",
+              "expected_total", "got_total"):
+        assert r1[k] == r2[k], k
+
+
+def test_soak_slice_survives_and_grades():
+    """Four trials (one per config family): no FAILED verdicts, every
+    record carries the replay identity, and the verdict histogram
+    accounts for every trial."""
+    summary = chaos.soak(42, 4, repro_out=None)
+    assert summary["failures"] == 0
+    assert summary["trials"] == 4
+    assert sum(summary["verdicts"].values()) == 4
+    modes = [r["config"]["mode"] for r in summary["records"]]
+    assert modes == list(chaos.CONFIGS)
+    for rec in summary["records"]:
+        assert rec["verdict"] in ("ok", "recovered", "detected")
+        json.dumps(rec)
+
+
+def test_failed_trial_writes_minimal_repro(tmp_path, monkeypatch):
+    """Force a failure verdict and check the repro artifact contract
+    (seed, trial, config, plan, replay command)."""
+    def fake_run_trial(seed, trial, **kw):
+        return {"trial": trial, "config": {"mode": "padded"},
+                "fault": "bit_flip", "fault_plan": {"seed": 1},
+                "verdict": "FAILED:silent_corruption",
+                "error": None, "expected_total": 1, "got_total": 2,
+                "retries": 0, "elapsed_s": 0.0}
+
+    monkeypatch.setattr(chaos, "run_trial", fake_run_trial)
+    out = str(tmp_path / "repro.json")
+    summary = chaos.soak(7, 1, repro_out=out)
+    assert summary["failures"] == 1
+    path = str(tmp_path / "repro_7_0.json")
+    repro = json.load(open(path))
+    assert repro["harness_seed"] == 7
+    assert "--seed 7 --trial 0" in repro["replay"]
+    assert repro["verdict"] == "FAILED:silent_corruption"
+
+
+def test_unstructured_trial_error_grades_as_crash(monkeypatch):
+    """An exception the trial body didn't convert to a structured
+    refusal must become a FAILED:crash VERDICT (repro written,
+    remaining trials still run), never a soak abort."""
+    def boom(config, plan, n_ranks):
+        raise ValueError("unexpected")
+
+    monkeypatch.setattr(chaos, "_run_trial_body", boom)
+    rec = chaos.run_trial(5, 1, deadline_s=None)
+    assert rec["verdict"] == "FAILED:crash"
+    assert "ValueError" in rec["error"]
+
+
+def test_chaos_cli_rejects_bad_usage():
+    assert chaos.main(["--trials", "0"]) == 2
+
+
+def test_collect_integrity_rearms_spent_corruption_budget():
+    """The driver seam's chaos contract: even when the corruption
+    budget was exhausted tracing an EARLIER (timed) program, the
+    verification step re-faces the schedule and refuses — a chaos-
+    seeded driver run must never bless corrupt numbers with
+    integrity.ok=true."""
+    import distributed_join_tpu as dj
+    from distributed_join_tpu.benchmarks import collect_integrity
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+    )
+
+    b, p = generate_build_probe_tables(
+        seed=11, build_nrows=512, probe_nrows=1024, rand_max=256,
+        selectivity=0.5)
+    plan = FaultPlan(seed=5, corrupt_mode="bit_flip",
+                     corrupt_collectives=1)
+    comm = FaultInjectingCommunicator(
+        dj.make_communicator("tpu", n_ranks=8), plan)
+    # The "timed" program: spends the whole corruption budget.
+    dj.distributed_inner_join(b, p, comm, out_capacity_factor=3.0)
+    assert comm._corruptions == plan.corrupt_collectives
+    join_opts = dict(key="key", out_capacity_factor=3.0)
+    with pytest.raises(integrity.IntegrityError):
+        collect_integrity(comm, *_sharded(comm, b, p), join_opts)
+
+
+def _sharded(comm, b, p):
+    import jax
+
+    b = b.pad_to(-(-b.capacity // 8) * 8)
+    p = p.pad_to(-(-p.capacity // 8) * 8)
+    out = comm.device_put_sharded((b, p))
+    jax.block_until_ready(out)
+    return out
+
+
+# -- driver plumbing --------------------------------------------------
+
+
+def test_robustness_flags_parse_on_every_driver():
+    from distributed_join_tpu.benchmarks import (
+        all_to_all,
+        distributed_join,
+        tpch_join,
+    )
+
+    for mod in (distributed_join, tpch_join, all_to_all):
+        args = mod.parse_args(
+            ["--verify-integrity", "--chaos-seed", "3",
+             "--guard-deadline-s", "900"])
+        assert args.verify_integrity is True
+        assert args.chaos_seed == 3
+        assert args.guard_deadline_s == 900.0

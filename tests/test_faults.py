@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import distributed_join_tpu as dj
 from distributed_join_tpu.parallel import bootstrap, faults
 from distributed_join_tpu.parallel.faults import (
+    CORRUPTION_MODES,
     CapacityLadder,
     FaultInjectedError,
     FaultInjectingCommunicator,
@@ -25,6 +26,7 @@ from distributed_join_tpu.parallel.faults import (
     ManifestMismatchError,
     retry_with_backoff,
 )
+from distributed_join_tpu.parallel.integrity import IntegrityError
 from distributed_join_tpu.parallel.out_of_core import keyrange_batched_join
 from distributed_join_tpu.utils.generators import (
     generate_build_probe_tables,
@@ -239,6 +241,101 @@ def test_plan_validation_off_by_default():
     with faults.validate_plans():
         assert faults.plan_validation_enabled()
     assert not faults.plan_validation_enabled()
+
+
+# -- wire integrity: every corruption mode must be DETECTED -----------
+
+
+@pytest.mark.parametrize("shuffle", ["padded", "ragged"])
+@pytest.mark.parametrize("mode", CORRUPTION_MODES)
+def test_corruption_mode_is_detected_never_silently_joined(
+        mode, shuffle):
+    """The acceptance bar of the integrity layer: each corruption mode,
+    in each shuffle layout, either raises IntegrityError or (had it
+    landed on padding) leaves an oracle-exact result — it must never
+    return wrong rows as success. seed=5 is chosen so every one of
+    these 8 combinations actually corrupts live data and DETECTS."""
+    b, p = _small_tables()
+    comm = _comm8(FaultPlan(seed=5, corrupt_mode=mode,
+                            corrupt_collectives=1))
+    with pytest.raises(IntegrityError, match="wire integrity"):
+        dj.distributed_inner_join(
+            b, p, comm, verify_integrity=True, shuffle=shuffle,
+            out_capacity_factor=3.0,
+        )
+
+
+def test_unknown_corruption_mode_is_loud():
+    with pytest.raises(ValueError, match="corrupt_mode"):
+        _comm8(FaultPlan(corrupt_mode="rowhammer"))
+
+
+def test_clean_join_verifies_and_reports():
+    """No faults: the verified join returns oracle-exact rows and a
+    structured all-pairs-checked report (n^2 pairs per side)."""
+    b, p = _small_tables()
+    res = dj.distributed_inner_join(
+        b, p, _comm8(), verify_integrity=True, out_capacity_factor=3.0,
+    )
+    assert int(res.total) == _oracle(b, p)
+    rep = res.integrity_report
+    assert rep.ok and not rep.mismatches
+    assert rep.checked_pairs == 2 * 8 * 8  # build + probe, all pairs
+    assert set(rep.channels) == {"build", "probe"}
+    json.dumps(rep.as_record())
+
+
+def test_integrity_mismatch_is_a_retry_rung_distinct_from_overflow():
+    """A finite corruption budget + auto_retry: the ladder re-runs the
+    SAME sizing (retry_integrity — capacities are innocent), the rerun
+    verifies clean, and the report carries the per-attempt verdicts."""
+    b, p = _small_tables()
+    comm = _comm8(FaultPlan(seed=5, corrupt_mode="bit_flip",
+                            corrupt_collectives=1))
+    res = dj.distributed_inner_join(
+        b, p, comm, verify_integrity=True, auto_retry=2,
+        out_capacity_factor=3.0,
+    )
+    assert int(res.total) == _oracle(b, p)
+    assert res.integrity_report.ok
+    rep = res.retry_report
+    assert [a.action for a in rep.attempts] == \
+        ["initial", "retry_integrity"]
+    assert [a.integrity_ok for a in rep.attempts] == [False, True]
+    # same sizing on both rungs: integrity retries never escalate
+    assert rep.attempts[0].shuffle_capacity_factor == \
+        rep.attempts[1].shuffle_capacity_factor
+    assert rep.attempts[0].out_capacity_factor == \
+        rep.attempts[1].out_capacity_factor
+
+
+def test_integrity_digests_identical_with_telemetry_on_and_off(
+        tmp_path):
+    """Checksum parity on the telemetry-off path: the digest lanes are
+    a function of the data and the wire alone — an active telemetry
+    session must not change a single digest value (and digest lanes
+    never leak into the reduced counter view)."""
+    from distributed_join_tpu import telemetry
+
+    b, p = _small_tables(seed=41)
+
+    def digest_lanes():
+        res = dj.distributed_inner_join(
+            b, p, _comm8(), verify_integrity=True,
+            out_capacity_factor=3.0,
+        )
+        d = res.telemetry.to_dict()
+        assert not any(".integrity." in k for k in d["reduced"])
+        return {k: v for k, v in d["per_rank"].items()
+                if ".integrity." in k}
+
+    telemetry.finalize()
+    off = digest_lanes()
+    assert off, "integrity lanes missing from the metrics block"
+    with telemetry.session(str(tmp_path / "tel")):
+        on = digest_lanes()
+    telemetry.finalize()
+    assert off == on
 
 
 # -- bootstrap retry / backoff ----------------------------------------
@@ -501,6 +598,91 @@ def test_overflowed_manifest_batches_rerun_on_resume(
         manifest_path=manifest_path, stats=stats, **_OOC_OPTS,
     )
     assert total == total0 and stats["resumed_batches"] == [0, 1, 2, 3]
+
+
+def test_manifest_refuses_resume_after_capacity_change(
+        tmp_path, ooc_tables):
+    """Resume-after-capacity-change: re-invoking against a manifest
+    whose batching CAPACITIES no longer match (here: the probe side
+    grew, changing per-batch rows and the padded batch capacity) must
+    refuse loudly — merging partial totals across different batchings
+    would be silent corruption of the resumed sum."""
+    b, p = ooc_tables
+    manifest_path = str(tmp_path / "m.json")
+    comm = _comm8(FaultPlan(fail_after_dispatches=2))
+    with pytest.raises(FaultInjectedError):
+        keyrange_batched_join(
+            b, p, comm, n_batches=4, warmup=False,
+            manifest_path=manifest_path, **_OOC_OPTS,
+        )
+    # More probe rows -> different per-batch row counts AND a larger
+    # padded batch capacity: the fingerprint must refuse both.
+    _, p2 = _small_tables(seed=29, build=1500, probe=3200,
+                          rand_max=700)
+    with pytest.raises(ManifestMismatchError, match="different"):
+        keyrange_batched_join(
+            b, p2, _comm8(), n_batches=4, warmup=False,
+            manifest_path=manifest_path, **_OOC_OPTS,
+        )
+
+
+def test_out_of_core_integrity_raise_and_degrade(ooc_tables,
+                                                 ooc_reference):
+    """verify_integrity in the batch loop: corruption woven into the
+    ONE compiled batch program poisons every batch — 'raise' surfaces
+    IntegrityError at the first settle; 'continue' abandons every
+    corrupt batch (totals NEVER silently folded in) and records them."""
+    b, p = ooc_tables
+    plan = FaultPlan(seed=5, corrupt_mode="bit_flip",
+                     corrupt_collectives=1)
+    with pytest.raises(IntegrityError):
+        keyrange_batched_join(
+            b, p, _comm8(plan), n_batches=4, warmup=False,
+            verify_integrity=True, **_OOC_OPTS,
+        )
+    stats = {}
+    total, overflow = keyrange_batched_join(
+        b, p, _comm8(plan), n_batches=4, warmup=False,
+        verify_integrity=True, on_batch_failure="continue",
+        stats=stats, **_OOC_OPTS,
+    )
+    assert stats["failed_batches"] == [0, 1, 2, 3]
+    assert total == 0 and not overflow
+
+
+def test_out_of_core_consumer_never_sees_corrupt_rows(ooc_tables):
+    """With verify_integrity on, the fetch worker verifies digests
+    BEFORE invoking on_batch_result: a materializing consumer must
+    receive zero rows from a wire-corrupted batch (not persist them
+    only for settle to flag the batch afterwards)."""
+    b, p = ooc_tables
+    plan = FaultPlan(seed=5, corrupt_mode="bit_flip",
+                     corrupt_collectives=1)
+    delivered = []
+    stats = {}
+    total, _ = keyrange_batched_join(
+        b, p, _comm8(plan), n_batches=4, warmup=False,
+        verify_integrity=True, on_batch_failure="continue",
+        on_batch_result=lambda i, res: delivered.append(i),
+        stats=stats, **_OOC_OPTS,
+    )
+    # ONE compiled program serves all batches, so the woven corruption
+    # poisons every batch: nothing may reach the consumer.
+    assert delivered == []
+    assert stats["failed_batches"] == [0, 1, 2, 3]
+    assert total == 0
+
+
+def test_out_of_core_clean_run_verifies(ooc_tables, ooc_reference):
+    b, p = ooc_tables
+    total0, _ = ooc_reference
+    stats = {}
+    total, overflow = keyrange_batched_join(
+        b, p, _comm8(), n_batches=4, warmup=False,
+        verify_integrity=True, stats=stats, **_OOC_OPTS,
+    )
+    assert total == total0 and not overflow
+    assert stats["failed_batches"] == []
 
 
 def test_manifest_refuses_mismatched_config(tmp_path, ooc_tables):

@@ -57,6 +57,7 @@ def lint_fixture(name):
     ("bad_collective_divergence.py", "DJL001"),
     ("bad_hidden_sync.py", "DJL002"),
     ("bad_callback.py", "DJL003"),
+    ("bad_callback_integrity_neighbor.py", "DJL003"),
     ("bad_recompile.py", "DJL004"),
     ("bad_tape_parity.py", "DJL005"),
     ("bad_unused_import.py", "DJL006"),
@@ -74,6 +75,22 @@ def test_known_bad_fixture_flags_its_rule(fixture, rule):
 def test_known_good_fixture_is_clean():
     findings = lint_fixture("good_clean.py")
     assert findings == [], "; ".join(f.format() for f in findings)
+
+
+def test_callback_seam_is_per_file_not_per_topic():
+    """The PR-5 seam registration (parallel/integrity.py, chaos.py)
+    sanctions exactly those FILES: the identical callback source lints
+    clean AT the seam path and flags one directory-sibling over."""
+    src = ("import jax\n\n\n"
+           "def tap(x):\n"
+           "    return jax.pure_callback(lambda v: v, x, x)\n")
+    linter = Linter(FIXTURES)
+    at_seam = linter.lint_source(
+        src, "distributed_join_tpu/parallel/integrity.py")
+    assert [f for f in at_seam if f.rule == "DJL003"] == []
+    outside = linter.lint_source(
+        src, "distributed_join_tpu/parallel/integrity_extras.py")
+    assert any(f.rule == "DJL003" for f in outside)
 
 
 def test_divergence_covers_branch_and_early_exit():
