@@ -411,6 +411,12 @@ SANCTIONED_CALLBACK_FILES = (
 )
 SANCTIONED_CALLBACK_DIRS = (
     "distributed_join_tpu/telemetry/",
+    # The serving layer (PR 6): request-side host taps (admission
+    # probes, per-request accounting) are host code AROUND the
+    # compiled program today; any future in-graph callback there must
+    # follow the same error-token discipline, so the seam is
+    # registered rather than grown later as a blanket noqa.
+    "distributed_join_tpu/service/",
 )
 
 

@@ -274,6 +274,53 @@ def add_robustness_args(parser) -> None:
     )
 
 
+# Launcher-level flags every spawned driver understands, as
+# (flag, args-attribute, takes_value) triples: the telemetry set
+# (add_telemetry_args) AND the robustness set (add_robustness_args).
+# PR 5's --verify-integrity/--chaos-seed/--guard-deadline-s used to be
+# silently dropped by tpu-launch; one table now defines what forwards.
+FORWARDED_CHILD_FLAGS = (
+    ("--telemetry", "telemetry", True),
+    ("--trace", "trace", False),
+    ("--diagnose", "diagnose", False),
+    ("--verify-integrity", "verify_integrity", False),
+    ("--chaos-seed", "chaos_seed", True),
+    ("--guard-deadline-s", "guard_deadline_s", True),
+)
+
+
+def extract_forwarded_flags(args, command) -> list:
+    """Return the extra child argv for every launcher-level telemetry
+    + robustness flag set on ``args`` (skipping any that ``command``,
+    the child argv, already carries) and strip them from ``args`` so
+    the launcher process itself stays flagless — its env-fallback
+    telemetry rank would collide with child rank 0's files, and a
+    guard deadline belongs to the child runs, not the spawn-and-reap
+    loop."""
+    def has(flag):
+        return any(c == flag or c.startswith(flag + "=")
+                   for c in command)
+
+    extra = []
+    for flag, attr, takes_value in FORWARDED_CHILD_FLAGS:
+        val = getattr(args, attr, None)
+        if takes_value:
+            if val is not None and not has(flag):
+                extra += [flag, str(val)]
+            setattr(args, attr, None)
+        else:
+            if val and not has(flag):
+                extra.append(flag)
+            setattr(args, attr, False)
+    # 0, not None: None would let resolve_guard_deadline fall through
+    # to the DJTPU_GUARD_DEADLINE_S env var and arm a watchdog around
+    # the launcher's own spawn-and-reap loop — which then hard-exits
+    # mid-reap while children (each already guarded, the env rides
+    # into their processes) are still writing records.
+    args.guard_deadline_s = 0
+    return extra
+
+
 def maybe_chaos_communicator(comm, args):
     """Driver seam for ``--chaos-seed``: wrap (or pass through) the
     communicator according to the flag."""

@@ -28,7 +28,11 @@ import subprocess
 import sys
 import time
 
-from distributed_join_tpu.benchmarks import add_telemetry_args
+from distributed_join_tpu.benchmarks import (
+    add_robustness_args,
+    add_telemetry_args,
+    extract_forwarded_flags,
+)
 from distributed_join_tpu.parallel.bootstrap import (
     ENV_COORDINATOR,
     ENV_CPU_DEVICES,
@@ -48,14 +52,18 @@ def parse_args(argv=None):
     p.add_argument("--cpu-devices-per-process", type=int, default=None,
                    help="emulate this many virtual CPU devices per "
                         "process (no-TPU validation path, gloo transport)")
-    # --telemetry/--trace/--diagnose at the launcher are FORWARDED to
-    # every spawned driver process (one shared session directory; the
-    # per-rank file names keep the processes apart, and the drivers'
-    # own rank-0 gating elects the summary/diagnosis writer). The
-    # launcher itself must NOT open a session — its env-fallback rank
-    # would collide with child rank 0's files — so the flags are moved
-    # off the args before run_guarded sees them (_extract_telemetry).
+    # Telemetry (--telemetry/--trace/--diagnose) and robustness
+    # (--verify-integrity/--chaos-seed/--guard-deadline-s) flags at
+    # the launcher are FORWARDED to every spawned driver process (one
+    # shared session directory; the per-rank file names keep the
+    # processes apart, and the drivers' own rank-0 gating elects the
+    # summary/diagnosis writer). The launcher itself must NOT open a
+    # session (its env-fallback rank would collide with child rank
+    # 0's files) or guard its own spawn-and-reap loop — so the flags
+    # are moved off the args before run_guarded sees them
+    # (benchmarks.extract_forwarded_flags, the one forwarding table).
     add_telemetry_args(p)
+    add_robustness_args(p)
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="driver command to launch (prefix with --)")
     args = p.parse_args(argv)
@@ -64,28 +72,8 @@ def parse_args(argv=None):
         cmd = cmd[1:]
     if not cmd:
         p.error("no driver command given (append: -- <driver> [args...])")
-    args.command = cmd + _extract_telemetry(args)
+    args.command = cmd + extract_forwarded_flags(args, cmd)
     return args
-
-
-def _extract_telemetry(args) -> list:
-    """Move the launcher-level telemetry flags into child-command
-    argv (skipping any the command already carries) and strip them
-    from ``args`` so ``run_guarded``'s ``configure_from_args`` sees a
-    flagless launcher process."""
-    def has(flag):
-        return any(c == flag or c.startswith(flag + "=")
-                   for c in args.command)
-
-    extra = []
-    if args.telemetry is not None and not has("--telemetry"):
-        extra += ["--telemetry", args.telemetry]
-    if args.trace and not has("--trace"):
-        extra.append("--trace")
-    if args.diagnose and not has("--diagnose"):
-        extra.append("--diagnose")
-    args.telemetry, args.trace, args.diagnose = None, False, False
-    return extra
 
 
 def _env_for(args, pid: int) -> dict:

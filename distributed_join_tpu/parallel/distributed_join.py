@@ -488,6 +488,7 @@ def distributed_inner_join(
     key: str = "key",
     auto_retry: int = 0,
     verify_integrity: bool = False,
+    program_cache=None,
     **opts,
 ) -> JoinResult:
     """One-shot convenience: pad to rank-divisible capacity, shard the
@@ -517,9 +518,28 @@ def distributed_inner_join(
     report as ``res.integrity_report``. Verification is skipped on an
     overflowed attempt (clamped rows mismatch by design; the overflow
     rung handles it).
+
+    ``program_cache``: a :class:`..service.programs.JoinProgramCache`.
+    When given, every attempt resolves its executable THROUGH the
+    cache instead of building a fresh closure — a repeat query, or a
+    retry rung whose exact sizing (the ladder's ``sizing()`` plus the
+    attempt index) was seen before, dispatches the resident program
+    with zero new traces (the serving warm path, docs/SERVICE.md).
+    Exception: an integrity-mismatch rung EVICTS the attempt's entry
+    before the same-sizing rerun — injected corruption is woven at
+    trace time, so only a re-trace is guaranteed to face a fresh
+    schedule, and a possibly-tainted resident program must not keep
+    serving. Default None: build per call, the historical behavior.
     """
     from distributed_join_tpu.parallel import faults, integrity
     from distributed_join_tpu.parallel.faults import CapacityLadder
+
+    if program_cache is not None and program_cache.comm is not comm:
+        # The cache compiles over ITS communicator's mesh; silently
+        # running this join on a different mesh would be a wrong-shard
+        # answer, not a slow one.
+        raise ValueError(
+            "program_cache was built for a different communicator")
 
     n = comm.n_ranks
 
@@ -561,12 +581,21 @@ def distributed_inner_join(
         hh_out_capacity=hh_out_cap,
         local_probe_rows=probe.capacity // n,
     )
+    last_sig = None
     for attempt in range(auto_retry + 1):
-        fn = make_distributed_join(comm, key=key,
-                                   with_integrity=verify_integrity,
-                                   metrics_static={
-                                       "retry_attempt_max": attempt},
-                                   **ladder.sizing(), **opts)
+        if program_cache is not None:
+            fn, _ = program_cache.get(
+                build, probe, key=key,
+                with_integrity=verify_integrity,
+                metrics_static={"retry_attempt_max": attempt},
+                **ladder.sizing(), **opts)
+            last_sig = fn.signature
+        else:
+            fn = make_distributed_join(comm, key=key,
+                                       with_integrity=verify_integrity,
+                                       metrics_static={
+                                           "retry_attempt_max": attempt},
+                                       **ladder.sizing(), **opts)
         if faults.plan_validation_enabled():
             # The violation record is process-global; drop leftovers
             # from earlier unchecked programs so what check() raises
@@ -599,15 +628,24 @@ def distributed_inner_join(
             # settled — the flag fetch above already synced).
             telemetry.emit_metrics(getattr(res, "telemetry", None))
             if report is not None and not report.ok:
-                # Never hand corrupt rows back as a result.
+                # Never hand corrupt rows back as a result. The
+                # budget-exhausted rung is as tainted as a retried
+                # one: a resident (or persisted) program that failed
+                # verification must not serve the next same-signature
+                # request.
+                if program_cache is not None and last_sig is not None:
+                    program_cache.evict(last_sig)
                 raise integrity.IntegrityError(report)
             return res
         if overflow:
             ladder.escalate()
         else:
             # Integrity mismatch: rerun the SAME sizing — the rows
-            # were wrong, not too many. Every retry recompiles, so a
+            # were wrong, not too many. Every retry recompiles (a
+            # cached entry for this rung is evicted first), so a
             # deterministic injected corruption budget (FaultPlan)
             # exhausts and the rerun can verify clean.
+            if program_cache is not None and last_sig is not None:
+                program_cache.evict(last_sig)
             ladder.hold("retry_integrity")
     raise AssertionError("unreachable")

@@ -1,0 +1,53 @@
+"""Join-as-a-service — the warm serving layer (ROADMAP open item 3).
+
+Every path into the join so far rebuilt the world per call: a fresh
+closure, a fresh trace, a fresh XLA compile — acceptable for a
+benchmark that amortizes compilation over timed iterations, fatal for
+a service answering heavy traffic (the reference holds its
+Communicator and compiled kernels resident across iterations,
+SURVEY.md). This package makes the warm path run-only:
+
+- :mod:`.programs` — :class:`~.programs.JoinProgramCache`: compiled
+  join executables memoized under a canonical
+  :class:`~.programs.JoinSignature` (schemas, capacities, key, shuffle
+  mode, the full capacity contract including the retry-ladder rung,
+  skew policy, compression, telemetry/integrity switches), with
+  optional on-disk persistence over the AOT serialization path;
+- :mod:`.batching` — micro-batching of K small joins into ONE padded
+  SPMD step, the batch id riding as an extra key column so matches
+  can never cross requests, unpacked per request at settle;
+- :mod:`.server` — :class:`~.server.JoinService` (admission, watchdog
+  deadlines, per-request telemetry spans, the retry ladder routed
+  through the cache) and the resident TCP daemon
+  (``tpu-join-service`` / ``python -m
+  distributed_join_tpu.service.server``) that keeps the mesh and the
+  cache warm between requests.
+
+Contract doc: docs/SERVICE.md. CI: the ``service`` lane of
+``scripts/run_tier1.sh`` plus the ``service_smoke`` counter-signature
+baseline gated by the ``perfgate`` lane.
+"""
+
+from distributed_join_tpu.service.programs import (
+    JoinProgramCache,
+    JoinSignature,
+)
+from distributed_join_tpu.service.batching import (
+    MicroBatch,
+    SEGMENT_COLUMN,
+    combine,
+    split,
+)
+
+# server (JoinService, ServiceConfig, the daemon) is deliberately NOT
+# imported here: it is a `python -m` entry point, and importing it from
+# the package __init__ would double-execute the module under runpy.
+
+__all__ = [
+    "JoinProgramCache",
+    "JoinSignature",
+    "MicroBatch",
+    "SEGMENT_COLUMN",
+    "combine",
+    "split",
+]

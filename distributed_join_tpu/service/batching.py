@@ -1,0 +1,160 @@
+"""Micro-batching: K small joins as ONE padded SPMD step.
+
+A small join through the service pays the full dispatch cost — host
+padding, device placement, program launch, the shuffle collectives'
+fixed latency — for a few thousand rows of work. Serving traffic is
+full of such queries, and the north star names the fix: "batching of
+small joins into one SPMD step". This module implements it:
+
+- :func:`combine` packs K same-schema requests into one build/probe
+  pair: each request padded to a uniform per-request slot (so the
+  combined shape — and therefore the cached program — depends only on
+  (slot, K), not on which requests arrived), plus a ``#batch``
+  int32 segment column on both sides;
+- the segment column rides as an EXTRA KEY COLUMN (the composite-key
+  machinery of ``ops/join.py``), so two rows are join-equal only when
+  their keys match AND they belong to the same request — **matches can
+  never cross requests**, even under adversarial key collisions
+  (tests/test_service.py grades exactly that against per-request
+  pandas oracles);
+- :func:`split` unpacks the settled result per request: the segment
+  column comes back as a key column of the output, so per-request
+  match counts (and rows) are one host-side bincount/filter.
+
+The batch id could equally ride the key's high bits; a separate column
+keeps ANY key dtype (including packed string keys) batchable and costs
+4 bytes/row on the wire.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from distributed_join_tpu.table import Table
+
+# NOT '__'-prefixed: the join reserves that namespace for its internal
+# lanes; '#' keeps the name out of any user schema by convention (the
+# strings layer's '#len' companions live there too).
+SEGMENT_COLUMN = "#batch"
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclasses.dataclass(frozen=True)
+class MicroBatch:
+    """One combined build/probe pair and the plan to unpack it."""
+
+    build: Table
+    probe: Table
+    key: tuple                  # combined key, SEGMENT_COLUMN last
+    n_requests: int
+    slot_build_rows: int
+    slot_probe_rows: int
+
+
+def _check_uniform(tables: Sequence[Table], side: str) -> None:
+    want = {n: (c.dtype, c.shape[1:])
+            for n, c in tables[0].columns.items()}
+    for i, t in enumerate(tables[1:], start=1):
+        got = {n: (c.dtype, c.shape[1:]) for n, c in t.columns.items()}
+        if got != want:
+            raise ValueError(
+                f"micro-batch {side} schemas differ: request 0 has "
+                f"{sorted(want)}, request {i} has {sorted(got)} — a "
+                "batch shares one compiled program, so every request "
+                "must share one schema"
+            )
+    if SEGMENT_COLUMN in want:
+        raise ValueError(
+            f"{side} tables already carry {SEGMENT_COLUMN!r} — the "
+            "segment column is batching-internal"
+        )
+
+
+def _stack(tables: Sequence[Table], slot: int) -> Table:
+    padded = [t.pad_to(slot) for t in tables]
+    cols = {
+        name: jnp.concatenate([t.columns[name] for t in padded])
+        for name in padded[0].column_names
+    }
+    # Padding rows carry their slot's segment id too, but their valid
+    # bit is False — they can never join.
+    cols[SEGMENT_COLUMN] = jnp.repeat(
+        jnp.arange(len(tables), dtype=jnp.int32), slot)
+    return Table(cols, jnp.concatenate([t.valid for t in padded]))
+
+
+def combine(requests: Sequence, key="key", *,
+            slot_build_rows=None, slot_probe_rows=None) -> MicroBatch:
+    """Pack ``requests`` — a sequence of ``(build, probe)`` Table
+    pairs joining on the same ``key`` — into one :class:`MicroBatch`.
+
+    Slots default to the largest request (rounded up to 8); pass
+    ``slot_*_rows`` explicitly to pin the combined shape across calls
+    whose largest request varies, so they share one cached program.
+    """
+    if not requests:
+        raise ValueError("micro-batch needs at least one request")
+    builds = [b for b, _ in requests]
+    probes = [p for _, p in requests]
+    _check_uniform(builds, "build")
+    _check_uniform(probes, "probe")
+    keys = [key] if isinstance(key, str) else list(key)
+    for kname in keys:
+        if kname not in builds[0].columns \
+                or kname not in probes[0].columns:
+            raise ValueError(f"key column {kname!r} missing from the "
+                             "batched tables")
+    b_slot = _round_up(
+        slot_build_rows or max(b.capacity for b in builds), 8)
+    p_slot = _round_up(
+        slot_probe_rows or max(p.capacity for p in probes), 8)
+    if any(b.capacity > b_slot for b in builds) \
+            or any(p.capacity > p_slot for p in probes):
+        raise ValueError(
+            f"a request exceeds the batch slot "
+            f"(build {b_slot}, probe {p_slot} rows)"
+        )
+    return MicroBatch(
+        build=_stack(builds, b_slot),
+        probe=_stack(probes, p_slot),
+        key=tuple(keys) + (SEGMENT_COLUMN,),
+        n_requests=len(requests),
+        slot_build_rows=b_slot,
+        slot_probe_rows=p_slot,
+    )
+
+
+def split(res, batch: MicroBatch, with_rows: bool = False) -> list:
+    """Unpack a settled batched :class:`JoinResult` per request.
+
+    Returns one dict per request: ``matches`` (that request's match
+    count), ``overflow`` (the SHARED flag — output capacity is pooled
+    across the batch, so an overflow taints every request; the retry
+    ladder has already escalated before a caller sees it), and — with
+    ``with_rows`` — the request's output rows as host numpy columns
+    (segment column dropped). Host-side by design: settle is where the
+    result leaves the device anyway."""
+    import numpy as np
+
+    valid = np.asarray(res.table.valid)
+    seg = np.asarray(res.table.columns[SEGMENT_COLUMN])
+    counts = np.bincount(seg[valid], minlength=batch.n_requests)
+    overflow = bool(res.overflow)
+    out = []
+    for i in range(batch.n_requests):
+        entry = {"matches": int(counts[i]), "overflow": overflow}
+        if with_rows:
+            take = valid & (seg == i)
+            entry["rows"] = {
+                name: np.asarray(col)[take]
+                for name, col in res.table.columns.items()
+                if name != SEGMENT_COLUMN
+            }
+        out.append(entry)
+    return out

@@ -1,0 +1,629 @@
+"""Join-as-a-service: the resident daemon that keeps the mesh warm.
+
+The benchmark drivers bootstrap, compile, run, and exit; a service for
+heavy traffic cannot pay bootstrap + trace + compile per query. This
+module turns the PR 1-5 layers into serving machinery around one
+long-lived process:
+
+- :class:`JoinService` — the in-process engine: ONE communicator (the
+  mesh bootstrapped once, exactly as ``benchmarks/launch.py`` /
+  ``parallel/bootstrap.py`` set it up), ONE
+  :class:`~..service.programs.JoinProgramCache` (the warm path is a
+  lookup + dispatch), request admission (a bounded pending count —
+  loud :class:`AdmissionError` refusals instead of an unbounded
+  queue), per-request watchdog deadlines (``parallel/watchdog.py`` —
+  a wedged collective becomes a structured ``HangError``, not a dead
+  server), per-request telemetry spans, and the capacity retry ladder
+  + wire-integrity verification routed through the cache
+  (``distributed_inner_join(program_cache=...)``).
+- :func:`JoinService.join_batched` — K small requests micro-batched
+  into one padded SPMD step (:mod:`..service.batching`), unpacked per
+  request at settle.
+- the TCP daemon (``tpu-join-service`` / ``python -m
+  distributed_join_tpu.service.server``): one JSON object per line in,
+  one per line out. The wire carries QUERIES (table generator specs +
+  join options — the serving demo), not table bytes; embed
+  :class:`JoinService` directly for resident data. ``--smoke`` runs
+  the CI acceptance protocol (docs/SERVICE.md): cold query, warm
+  repeat (must add zero traces), 16 small joins sequential vs batched
+  (batched must win wall clock), emitting a JSON record whose counter
+  signature the ``perfgate`` lane gates against
+  ``results/baselines/service_smoke.json``.
+
+End-of-run ``--diagnose`` and the telemetry/robustness flags work
+exactly as on the drivers (``run_guarded`` owns them); the
+``--guard-deadline-s`` flag is re-pointed at PER-REQUEST deadlines —
+guarding the whole daemon would kill a healthy resident server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import socket
+import socketserver
+import sys
+import threading
+import time
+from typing import Optional
+
+from distributed_join_tpu import telemetry
+from distributed_join_tpu.service import batching
+from distributed_join_tpu.service.programs import JoinProgramCache
+
+
+class AdmissionError(RuntimeError):
+    """The service refused the request at admission (pending queue or
+    batch size over the configured bound) — a structured, retryable
+    refusal instead of an unbounded queue hiding an overloaded mesh."""
+
+
+@dataclasses.dataclass
+class ServiceConfig:
+    """Serving policy knobs (the per-run driver flags, made resident).
+
+    ``request_deadline_s`` is the per-request watchdog bound (None =
+    unguarded); ``auto_retry``/``verify_integrity`` are the ladder and
+    wire-integrity contracts of ``distributed_inner_join``, applied to
+    every request; ``persist_dir`` arms the cache's on-disk AOT tier.
+    """
+
+    auto_retry: int = 2
+    verify_integrity: bool = False
+    request_deadline_s: Optional[float] = None
+    max_pending: int = 8
+    max_batch_requests: int = 64
+    max_programs: int = 128
+    persist_dir: Optional[str] = None
+
+
+class JoinService:
+    """The in-process serving engine. Thread-safe: admission is a
+    bounded counter, execution serializes on one lock (one mesh runs
+    one program at a time; queueing beyond ``max_pending`` is refused,
+    not buffered)."""
+
+    def __init__(self, comm, config: Optional[ServiceConfig] = None):
+        self.comm = comm
+        self.config = config or ServiceConfig()
+        self.cache = JoinProgramCache(
+            comm, persist_dir=self.config.persist_dir,
+            max_entries=self.config.max_programs)
+        self._exec_lock = threading.Lock()
+        self._admit_lock = threading.Lock()
+        self._pending = 0
+        self.served = 0
+        self.rejected = 0
+        self.failed = 0
+        # Set (to the HangError description) when a request blew its
+        # deadline: the timed-out join keeps running on its detached
+        # watchdog worker, so dispatching ANOTHER program onto the
+        # same mesh would interleave two SPMD programs on one device
+        # set. Fail-stop: every later join is refused loudly (ping/
+        # stats still answer) until an operator restarts the server —
+        # the serving analog of the drivers' hard exit after HangError.
+        self.poisoned: Optional[str] = None
+
+    # -- admission -----------------------------------------------------
+
+    def _admit(self):
+        with self._admit_lock:
+            if self.poisoned is not None:
+                self.rejected += 1
+                telemetry.event("request_rejected", reason="poisoned")
+                raise AdmissionError(
+                    "mesh poisoned by a hung request "
+                    f"({self.poisoned}); restart the server"
+                )
+            if self._pending >= self.config.max_pending:
+                self.rejected += 1
+                telemetry.event("request_rejected", reason="pending",
+                                pending=self._pending)
+                raise AdmissionError(
+                    f"{self._pending} requests already pending "
+                    f"(max_pending={self.config.max_pending}); "
+                    "retry with backoff"
+                )
+            self._pending += 1
+
+    def _release(self):
+        with self._admit_lock:
+            self._pending -= 1
+
+    # -- the request paths --------------------------------------------
+
+    def join(self, build, probe, key="key", **opts):
+        """One admitted, watchdog-guarded, span-wrapped join through
+        the program cache. Returns the ``JoinResult`` (with
+        ``retry_report`` / ``integrity_report`` attributes exactly as
+        ``distributed_inner_join`` attaches them)."""
+        from distributed_join_tpu.parallel.distributed_join import (
+            distributed_inner_join,
+        )
+        from distributed_join_tpu.parallel.watchdog import (
+            call_with_deadline,
+        )
+
+        self._admit()
+        try:
+            with self._exec_lock:
+                # Re-check under the EXEC lock: a request admitted
+                # before a hang can be parked here while the hanging
+                # request poisons the mesh and releases this lock —
+                # it must not dispatch alongside the detached worker.
+                with self._admit_lock:
+                    if self.poisoned is not None:
+                        self.rejected += 1
+                        telemetry.event("request_rejected",
+                                        reason="poisoned")
+                        raise AdmissionError(
+                            "mesh poisoned by a hung request "
+                            f"({self.poisoned}); restart the server")
+                rid = self.served + self.failed
+
+                def run_once():
+                    return distributed_inner_join(
+                        build, probe, self.comm, key=key,
+                        auto_retry=self.config.auto_retry,
+                        verify_integrity=self.config.verify_integrity,
+                        program_cache=self.cache, **opts)
+
+                deadline = self.config.request_deadline_s
+                traces0 = self.cache.traces
+                try:
+                    with telemetry.span("request", id=rid) as sp:
+                        if deadline is None:
+                            res = run_once()
+                        else:
+                            res = call_with_deadline(
+                                run_once, deadline,
+                                what=f"request {rid}")
+                        if sp is not None:
+                            sp.sync_on(res.total)
+                except Exception as exc:
+                    self.failed += 1
+                    from distributed_join_tpu.parallel.watchdog import (
+                        HangError,
+                    )
+
+                    if isinstance(exc, HangError):
+                        with self._admit_lock:
+                            self.poisoned = str(exc)
+                    raise
+                self.served += 1
+                # Trace accounting captured UNDER the exec lock — a
+                # concurrent connection's cold compile must not be
+                # misattributed to this request (host-side attribute,
+                # the retry_report pattern).
+                object.__setattr__(res, "new_traces",
+                                   self.cache.traces - traces0)
+                return res
+        finally:
+            self._release()
+
+    def join_batched(self, requests, key="key", *,
+                     slot_build_rows=None, slot_probe_rows=None,
+                     with_rows: bool = False, **opts):
+        """Micro-batch ``requests`` (``(build, probe)`` pairs sharing
+        one schema and ``key``) into one SPMD step and unpack per
+        request. Returns ``batching.split``'s per-request records."""
+        if len(requests) > self.config.max_batch_requests:
+            with self._admit_lock:
+                self.rejected += 1
+            telemetry.event("request_rejected", reason="batch_size",
+                            batch=len(requests))
+            raise AdmissionError(
+                f"batch of {len(requests)} exceeds max_batch_requests="
+                f"{self.config.max_batch_requests}"
+            )
+        mb = batching.combine(
+            requests, key=key, slot_build_rows=slot_build_rows,
+            slot_probe_rows=slot_probe_rows)
+        res = self.join(mb.build, mb.probe, key=list(mb.key), **opts)
+        results = batching.split(res, mb, with_rows=with_rows)
+        for r in results:
+            # the batch shares one program resolution; the count is
+            # replicated per request for the wire's convenience
+            r["new_traces"] = getattr(res, "new_traces", 0)
+        return results
+
+    def stats(self) -> dict:
+        return {
+            "served": self.served,
+            "failed": self.failed,
+            "rejected": self.rejected,
+            "pending": self._pending,
+            "poisoned": self.poisoned,
+            "cache": self.cache.stats(),
+        }
+
+
+# -- the wire protocol -------------------------------------------------
+
+# Join options a wire request may set (everything else a query could
+# name is a server-side policy, not a per-request knob).
+_WIRE_JOIN_OPTS = (
+    "shuffle", "over_decomposition", "shuffle_capacity_factor",
+    "out_capacity_factor", "compression_bits", "skew_threshold",
+)
+
+
+def _tables_from_spec(spec: dict):
+    """Generate the (build, probe) pair a wire query names. The demo
+    data plane: deterministic generator tables keyed by the request's
+    seed — a resident deployment embeds :class:`JoinService` and hands
+    it real device tables instead."""
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+    )
+
+    return generate_build_probe_tables(
+        seed=int(spec.get("seed", 42)),
+        build_nrows=int(spec["build_nrows"]),
+        probe_nrows=int(spec["probe_nrows"]),
+        rand_max=(int(spec["rand_max"]) if spec.get("rand_max")
+                  else None),
+        selectivity=float(spec.get("selectivity", 0.3)),
+        unique_build_keys=bool(spec.get("unique_build_keys", False)),
+    )
+
+
+def _join_opts_from_spec(spec: dict) -> dict:
+    return {k: spec[k] for k in _WIRE_JOIN_OPTS if spec.get(k)
+            is not None}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    """One JSON object per line in -> one JSON object per line out."""
+
+    def handle(self):
+        for raw in self.rfile:
+            line = raw.decode("utf-8").strip()
+            if not line:
+                continue
+            req = None
+            try:
+                req = json.loads(line)
+                resp = self._dispatch(req)
+            except Exception as exc:  # noqa: BLE001 - wire boundary:
+                # a bad request must answer THAT client, not kill the
+                # daemon serving everyone else.
+                resp = {"ok": False, "error": type(exc).__name__,
+                        "message": str(exc)}
+            self.wfile.write(
+                (json.dumps(resp) + "\n").encode("utf-8"))
+            self.wfile.flush()
+            if isinstance(req, dict) and req.get("op") == "shutdown" \
+                    and resp.get("ok"):
+                return
+
+    def _dispatch(self, req: dict) -> dict:
+        service: JoinService = self.server.service
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "op": "ping"}
+        if op == "stats":
+            return {"ok": True, **service.stats()}
+        if op == "shutdown":
+            # shutdown() must not run on the handler thread (it joins
+            # the serve_forever loop, which is waiting on us).
+            threading.Thread(target=self.server.shutdown,
+                             daemon=True).start()
+            return {"ok": True, "op": "shutdown"}
+        if op == "join":
+            build, probe = _tables_from_spec(req)
+            t0 = time.perf_counter()
+            res = service.join(build, probe,
+                               **_join_opts_from_spec(req))
+            matches = int(res.total)
+            elapsed = time.perf_counter() - t0
+            retry = res.retry_report.as_record()
+            return {
+                "ok": True,
+                "matches": matches,
+                "overflow": bool(res.overflow),
+                "elapsed_s": elapsed,
+                # accounted under the service's exec lock, so a
+                # concurrent connection's compile is never billed here
+                "new_traces": getattr(res, "new_traces", 0),
+                "retry": retry,
+                "cache": service.cache.stats(),
+            }
+        if op == "batch":
+            specs = req.get("requests") or []
+            pairs = [_tables_from_spec(s) for s in specs]
+            t0 = time.perf_counter()
+            results = service.join_batched(
+                pairs,
+                slot_build_rows=req.get("slot_build_rows"),
+                slot_probe_rows=req.get("slot_probe_rows"),
+                **_join_opts_from_spec(req))
+            elapsed = time.perf_counter() - t0
+            return {
+                "ok": True,
+                "requests": results,
+                "matches": sum(r["matches"] for r in results),
+                "elapsed_s": elapsed,
+                "new_traces": (results[0]["new_traces"]
+                               if results else 0),
+                "cache": service.cache.stats(),
+            }
+        raise ValueError(f"unknown op {op!r} (ops: ping, stats, join, "
+                         "batch, shutdown)")
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, service: JoinService):
+        super().__init__(addr, _Handler)
+        self.service = service
+
+
+def start_daemon(service: JoinService, host: str = "127.0.0.1",
+                 port: int = 0):
+    """Bind + serve on a background thread; returns ``(server, port)``.
+    ``server.shutdown()`` (or the wire ``shutdown`` op) stops it."""
+    server = _Server((host, port), service)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, server.server_address[1]
+
+
+class ServiceClient:
+    """Line-protocol client over one persistent connection (the smoke
+    protocol and tests; also a template for real callers)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 600.0):
+        self._sock = socket.create_connection((host, port), timeout_s)
+        self._file = self._sock.makefile("rw", encoding="utf-8",
+                                         newline="\n")
+
+    def send(self, payload: dict) -> dict:
+        self._file.write(json.dumps(payload) + "\n")
+        self._file.flush()
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("service closed the connection")
+        return json.loads(line)
+
+    def close(self) -> None:
+        self._file.close()
+        self._sock.close()
+
+
+# -- the CLI daemon ----------------------------------------------------
+
+
+def parse_args(argv=None):
+    from distributed_join_tpu.benchmarks import (
+        add_platform_arg,
+        add_robustness_args,
+        add_telemetry_args,
+    )
+
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="TCP port (0 = ephemeral; the bound port is "
+                        "printed on the 'listening' line)")
+    p.add_argument("--n-ranks", type=int, default=None,
+                   help="mesh size; default all visible devices")
+    p.add_argument("--communicator", default="tpu")
+    p.add_argument("--auto-retry", type=int, default=2,
+                   help="capacity-ladder budget applied to every "
+                        "request (rungs reuse cached executables)")
+    p.add_argument("--max-pending", type=int, default=8,
+                   help="admission bound: requests beyond this many "
+                        "pending are refused, not queued")
+    p.add_argument("--max-batch-requests", type=int, default=64)
+    p.add_argument("--max-programs", type=int, default=128,
+                   help="resident-executable bound (LRU-evicted in "
+                        "memory; persisted blobs survive): the wire "
+                        "lets every request pick its own table shape, "
+                        "and each shape is a compiled program")
+    p.add_argument("--persist-dir", default=None, metavar="DIR",
+                   help="persist compiled executables under DIR (the "
+                        "AOT serialization tier): a restarted server "
+                        "skips even the first trace")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the CI smoke protocol against an "
+                        "in-process daemon instead of serving: warm "
+                        "cache discipline + batched-vs-sequential "
+                        "(docs/SERVICE.md), JSON record on stdout")
+    p.add_argument("--smoke-small-rows", type=int, default=256,
+                   help="rows per small join in the smoke's batched-"
+                        "vs-sequential comparison")
+    p.add_argument("--smoke-batch", type=int, default=16,
+                   help="small joins per smoke micro-batch")
+    p.add_argument("--smoke-no-wall-gate", action="store_true",
+                   help="report the batched-vs-sequential wall clocks "
+                        "but do not FAIL on them (the perfgate lane "
+                        "gates counters only; the service lane keeps "
+                        "the strict timing gate)")
+    p.add_argument("--json-output", default=None)
+    add_platform_arg(p)
+    add_telemetry_args(p)
+    add_robustness_args(p)
+    return p.parse_args(argv)
+
+
+def _service_from_args(args) -> JoinService:
+    from distributed_join_tpu.benchmarks import (
+        apply_platform,
+        maybe_chaos_communicator,
+    )
+    from distributed_join_tpu.parallel.communicator import (
+        make_communicator,
+    )
+
+    apply_platform(args.platform, args.n_ranks)
+    comm = maybe_chaos_communicator(
+        make_communicator(args.communicator, n_ranks=args.n_ranks),
+        args)
+    cfg = ServiceConfig(
+        auto_retry=args.auto_retry,
+        verify_integrity=args.verify_integrity,
+        request_deadline_s=args.request_deadline_s,
+        max_pending=args.max_pending,
+        max_batch_requests=args.max_batch_requests,
+        max_programs=args.max_programs,
+        persist_dir=args.persist_dir,
+    )
+    return JoinService(comm, cfg)
+
+
+def run(args) -> dict:
+    service = _service_from_args(args)
+    from distributed_join_tpu.benchmarks import report
+
+    if args.smoke:
+        record = run_smoke(service, args)
+    else:
+        server = _Server((args.host, args.port), service)
+        port = server.server_address[1]
+        print(f"join-service listening on {args.host}:{port}",
+              flush=True)
+        try:
+            # Serve on THIS thread; the wire shutdown op (handled on a
+            # connection thread) calls server.shutdown(), which makes
+            # serve_forever return.
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.server_close()
+        record = {"benchmark": "service", **service.stats()}
+    report(
+        f"join-service: {record.get('served', 0)} request(s) served, "
+        f"{record['cache']['traces']} trace(s), "
+        f"{record['cache']['hits']} cache hit(s)",
+        record, args.json_output,
+    )
+    return record
+
+
+def run_smoke(service: JoinService, args) -> dict:
+    """The acceptance protocol, end to end THROUGH the daemon's TCP
+    loop (docs/SERVICE.md "CI smoke"):
+
+    1. cold query Q compiles; the identical warm repeat must add ZERO
+       traces and report a cache hit;
+    2. N small joins, warmed, timed sequentially (N dispatches of one
+       cached program) vs micro-batched (ONE dispatch) — the batch
+       must win wall clock and return the same per-request matches.
+
+    Raises RuntimeError on any violation (run_guarded turns it into a
+    failure record with rc != 0)."""
+    server, port = start_daemon(service, "127.0.0.1", 0)
+    client = ServiceClient("127.0.0.1", port)
+    violations = []
+
+    def send_ok(payload, what):
+        resp = client.send(payload)
+        if not resp.get("ok"):
+            # surface the service's OWN error, not a downstream
+            # KeyError on the missing response fields
+            raise RuntimeError(f"{what} failed: {resp}")
+        return resp
+
+    try:
+        q = {"op": "join", "build_nrows": 4096, "probe_nrows": 4096,
+             "seed": 42, "selectivity": 0.3,
+             "out_capacity_factor": 3.0}
+        cold = send_ok(q, "cold query")
+        warm = send_ok(q, "warm query")
+        if warm["new_traces"] != 0:
+            violations.append(
+                f"warm repeat traced {warm['new_traces']} new "
+                "program(s); the warm path must be run-only")
+        if warm["matches"] != cold["matches"]:
+            violations.append("warm matches != cold matches")
+
+        rows = args.smoke_small_rows
+        small = [
+            {"op": "join", "build_nrows": rows, "probe_nrows": rows,
+             "seed": 100 + i, "selectivity": 0.5,
+             "rand_max": max(rows // 2, 1),
+             "out_capacity_factor": 3.0}
+            for i in range(args.smoke_batch)
+        ]
+        batch_req = {
+            "op": "batch", "out_capacity_factor": 3.0,
+            "requests": [{k: v for k, v in s.items() if k != "op"}
+                         for s in small],
+        }
+        # Warm both shapes (compile happens here, outside the timing).
+        seq_warm = [send_ok(s, "sequential warm-up") for s in small]
+        send_ok(batch_req, "batch warm-up")
+        # Timed, all-warm passes.
+        t0 = time.perf_counter()
+        seq = [send_ok(s, "timed sequential") for s in small]
+        seq_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        batched = send_ok(batch_req, "timed batch")
+        batched_s = time.perf_counter() - t0
+        if any(r["new_traces"] for r in seq) or batched["new_traces"]:
+            violations.append("timed pass traced new programs")
+        seq_matches = [r["matches"] for r in seq]
+        batch_matches = [r["matches"] for r in batched["requests"]]
+        if seq_matches != batch_matches:
+            violations.append(
+                f"batched per-request matches {batch_matches} != "
+                f"sequential {seq_matches} — cross-request "
+                "contamination or lost rows")
+        if batched_s >= seq_s and not args.smoke_no_wall_gate:
+            violations.append(
+                f"batched step ({batched_s:.4f}s) did not beat "
+                f"{len(small)} sequential warm calls ({seq_s:.4f}s)")
+        stats = client.send({"op": "stats"})
+        client.send({"op": "shutdown"})
+    finally:
+        client.close()
+        server.server_close()
+    record = {
+        "benchmark": "service_smoke",
+        "n_ranks": service.comm.n_ranks,
+        "warm_new_traces": warm["new_traces"],
+        "matches_per_join": cold["matches"],
+        "small_rows": args.smoke_small_rows,
+        "batch_requests": args.smoke_batch,
+        "sequential_s": seq_s,
+        "batched_s": batched_s,
+        "batched_speedup": seq_s / batched_s if batched_s else None,
+        "batch_matches": batch_matches,
+        "served": stats["served"],
+        "cache": stats["cache"],
+        "violations": violations,
+        # the warmup responses keep the smoke honest in the record
+        "warmup_sequential_matches": [r["matches"] for r in seq_warm],
+    }
+    if violations:
+        from distributed_join_tpu.benchmarks import report
+
+        report("service smoke FAILED", record, args.json_output)
+        raise RuntimeError("service smoke violations: "
+                           + "; ".join(violations))
+    return record
+
+
+def main(argv=None):
+    from distributed_join_tpu.benchmarks import run_guarded
+    from distributed_join_tpu.parallel.watchdog import (
+        resolve_guard_deadline,
+    )
+
+    args = parse_args(argv)
+    # --guard-deadline-s bounds each REQUEST, not the daemon: resolve
+    # it now, then zero the flag so run_guarded leaves the (healthy,
+    # long-lived) server unguarded. An explicit 0 also stops
+    # resolve_guard_deadline falling through to the env var.
+    args.request_deadline_s = resolve_guard_deadline(args)
+    args.guard_deadline_s = 0
+    return run_guarded(run, args, benchmark="service")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

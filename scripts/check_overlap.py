@@ -49,9 +49,12 @@ def analyze_schedule(hlo: str) -> dict:
             continue
         if re.search(r"= \S* all-to-all\(", ln):
             n_sync_a2a += 1
+        # the done op's operand may be type-annotated on newer
+        # toolchains — "-done((u32[104]{...}, ...) %start.62)" — so
+        # scan past any type prefix to the %name
         m = re.search(
             r"(all-to-all|all-gather|collective-permute)-done"
-            r"\(%?([\w.-]+)\)", ln)
+            r"\((?:[^%]*%)?([\w.-]+)\)", ln)
         if m and m.group(2) in starts:
             # real ops between start and done, excluding trivial ones
             between = [
@@ -71,55 +74,37 @@ def analyze_schedule(hlo: str) -> dict:
 def aot_tpu_main(args):
     """AOT-compile the full 8-rank join for a chipless v5e:2x4
     topology and compare the padded (grouped all-to-all) vs ppermute
-    (collective-permute chain) shuffle schedules. Writes
-    results/overlap_hlo_tpu_ppermute.json."""
-    import numpy as np
-
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import topologies
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    from distributed_join_tpu.parallel.communicator import TpuCommunicator
-    from distributed_join_tpu.parallel.distributed_join import (
-        make_distributed_join,
+    (collective-permute chain) shuffle schedules. Thin wrapper over
+    the service layer's persistence-path compiler
+    (service/programs.aot_compile_chipless); each mode's schedule
+    lands in its OWN results file — the ppermute-named JSON carries
+    ppermute only."""
+    from distributed_join_tpu.service.programs import (
+        AOT_TOPOLOGY,
+        aot_compile_chipless,
     )
-    from distributed_join_tpu.table import Table
 
-    topo = topologies.get_topology_desc(
-        platform="tpu", topology_name="v5e:2x4"
-    )
-    mesh = Mesh(np.array(topo.devices).reshape(8), ("ranks",))
-    comm = TpuCommunicator(mesh=mesh)
-    rows = args.rows_per_rank * 8
-    sh = NamedSharding(mesh, P("ranks"))
-
-    def tbl(payload):
-        return Table(
-            {"key": jax.ShapeDtypeStruct((rows,), jnp.int64, sharding=sh),
-             payload: jax.ShapeDtypeStruct((rows,), jnp.int64,
-                                           sharding=sh)},
-            jax.ShapeDtypeStruct((rows,), jnp.bool_, sharding=sh),
-        )
-
-    report = {
-        "topology": "v5e:2x4 (8 devices), chipless AOT",
-        "over_decomposition": 2,
-        "modes": {},
-    }
-    for mode in ("padded", "ppermute"):
-        fn = make_distributed_join(
-            comm, key="key", over_decomposition=2,
-            out_capacity_factor=3.0, shuffle=mode,
-        )
-        hlo = fn.lower(tbl("build_payload"), tbl("probe_payload")).compile().as_text()
+    reports = {}
+    for mode, path in (
+        ("padded", "results/overlap_hlo_tpu_padded.json"),
+        ("ppermute", "results/overlap_hlo_tpu_ppermute.json"),
+    ):
+        hlo = aot_compile_chipless(
+            shuffle=mode, rows_per_rank=args.rows_per_rank,
+        ).as_text()
         sched = analyze_schedule(hlo)
         sched["total_hlo_lines"] = len(hlo.splitlines())
-        report["modes"][mode] = sched
+        report = {
+            "topology": f"{AOT_TOPOLOGY} (8 devices), chipless AOT",
+            "over_decomposition": 2,
+            "shuffle": mode,
+            "schedule": sched,
+        }
         print(mode, json.dumps(sched))
-    with open("results/overlap_hlo_tpu_ppermute.json", "w") as f:
-        json.dump(report, f, indent=2)
-    return report
+        with open(path, "w") as f:
+            json.dump(report, f, indent=2)
+        reports[mode] = report
+    return reports
 
 
 def main():

@@ -17,6 +17,12 @@
 #                                   # (faults x configs, pandas-oracle
 #                                   # verified, wire digests on) +
 #                                   # -m chaos unit suite
+#   scripts/run_tier1.sh service    # join-as-a-service: -m service
+#                                   # unit suite + the daemon smoke
+#                                   # (warm second query = zero new
+#                                   # traces, batched 16-way beats 16
+#                                   # sequential warm calls) on the
+#                                   # CPU mesh
 #
 # Notes:
 # - tests/conftest.py points the persistent XLA compile cache at
@@ -77,9 +83,24 @@ case "$lane" in
     python -m distributed_join_tpu.telemetry.analyze check \
       "$tmp/tel/summary.json" "$tmp/tel/diagnosis.json" \
       "$tmp/tel/trace.rank0.json" "$tmp/tel/events.rank0.jsonl"
-    # no exec: the EXIT trap must still clean $tmp
     python -m distributed_join_tpu.telemetry.analyze compare \
       "$tmp/record.json" --baseline cpu_mesh_smoke
+    # The service smoke's counter signature is part of the same gate
+    # (docs/SERVICE.md): the final micro-batched join's device
+    # counters are deterministic on the CPU mesh, and a changed
+    # partitioner/wire/batching seam moves them. --smoke-no-wall-gate
+    # keeps this lane's "wall time is never gated here" contract —
+    # the strict batched-beats-sequential gate lives in the service
+    # lane.
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.service.server --smoke \
+      --smoke-no-wall-gate --platform cpu --n-ranks 8 \
+      --telemetry "$tmp/svc_tel" \
+      --json-output "$tmp/service_smoke.json"
+    # no exec: the EXIT trap must still clean $tmp
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/service_smoke.json" --baseline service_smoke
     exit $?
     ;;
   lint)
@@ -114,8 +135,26 @@ case "$lane" in
       python -m distributed_join_tpu.parallel.chaos \
       --trials 20 --seed 42 --repro-out /tmp/djtpu_chaos_repro.json
     ;;
+  service)
+    # Join-as-a-service (docs/SERVICE.md): the -m service unit suite
+    # (cache-key discipline, warm-path program-count locks, retry-rung
+    # reuse, batching isolation, daemon protocol), then the daemon
+    # smoke through the real TCP loop — a warm second query must add
+    # zero traces and a 16-way micro-batch must beat 16 sequential
+    # warm calls on wall clock. The smoke's record carries the counter
+    # signature the perfgate lane gates against
+    # results/baselines/service_smoke.json.
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m service --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.service.server --smoke \
+      --platform cpu --n-ranks 8
+    ;;
   *)
-    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos]" >&2
+    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service]" >&2
     exit 2
     ;;
 esac

@@ -410,6 +410,37 @@ def test_launch_forwards_telemetry_flags():
     assert "parentdir" not in args2.command
 
 
+def test_launch_forwards_robustness_flags():
+    """PR 5's robustness flags ride the same forwarding table as the
+    telemetry flags — the launcher used to silently drop them."""
+    from distributed_join_tpu.benchmarks import launch
+
+    args = launch.parse_args([
+        "--num-processes", "2", "--verify-integrity",
+        "--chaos-seed", "7", "--guard-deadline-s", "30",
+        "--", "tpu-distributed-join", "--iterations", "1",
+    ])
+    cmd = args.command
+    assert "--verify-integrity" in cmd
+    assert cmd[cmd.index("--chaos-seed") + 1] == "7"
+    assert cmd[cmd.index("--guard-deadline-s") + 1] == "30.0"
+    # ... and are stripped from the launcher itself: its own
+    # spawn-and-reap loop must stay unguarded and chaos-free
+    assert not args.verify_integrity
+    assert args.chaos_seed is None
+    # 0 (not None): the 0 sentinel also blocks the
+    # DJTPU_GUARD_DEADLINE_S env fallback from guarding the launcher
+    assert args.guard_deadline_s == 0
+
+    # explicit child flags win; nothing forwards twice
+    args2 = launch.parse_args([
+        "--num-processes", "2", "--chaos-seed", "7",
+        "--", "drv", "--chaos-seed", "9",
+    ])
+    assert args2.command.count("--chaos-seed") == 1
+    assert "7" not in args2.command
+
+
 # -- bench.py CPU-mesh proxy ------------------------------------------
 
 

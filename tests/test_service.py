@@ -1,0 +1,474 @@
+"""Join-as-a-service (distributed_join_tpu/service/) on the
+8-virtual-device CPU mesh.
+
+Three contracts (docs/SERVICE.md):
+
+- **Cache-key discipline.** Distinct signatures — telemetry on/off,
+  integrity on/off, differing schemas, shuffle modes, ladder-rung
+  sizings — map to distinct cache entries; identical signatures HIT,
+  and a hit provably adds zero traced programs (the CountingComm
+  program-count lock, extending tests/test_telemetry.py's).
+- **Warm path is run-only.** A repeat ``distributed_inner_join``
+  through the cache, and a repeat retry-ladder rung, build zero new
+  programs; an integrity-mismatch rung EVICTS and re-traces (the
+  injected-corruption budget exhausts across the re-trace).
+- **Batching isolation.** K small joins micro-batched into one SPMD
+  step return exactly the per-request pandas-oracle matches under
+  adversarial cross-request key collisions — matches never cross
+  requests — and same-slot batches share one cached program.
+"""
+
+import pytest
+
+import jax.numpy as jnp
+
+import distributed_join_tpu as dj
+from distributed_join_tpu import telemetry
+from distributed_join_tpu.parallel.communicator import TpuCommunicator
+from distributed_join_tpu.parallel.faults import (
+    FaultInjectingCommunicator,
+    FaultPlan,
+)
+from distributed_join_tpu.service import batching
+from distributed_join_tpu.service.programs import (
+    JoinProgramCache,
+    JoinSignature,
+)
+from distributed_join_tpu.table import Table
+from distributed_join_tpu.utils.generators import (
+    generate_build_probe_tables,
+)
+
+pytestmark = pytest.mark.service
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_session():
+    telemetry.finalize()
+    yield
+    telemetry.finalize()
+
+
+class CountingComm(TpuCommunicator):
+    """Counts built SPMD programs — a cache hit must add zero."""
+
+    def __init__(self, n_ranks: int = 8):
+        super().__init__(n_ranks=n_ranks)
+        self.programs_built = 0
+
+    def spmd(self, fn, *, sharded_out=None):
+        self.programs_built += 1
+        return super().spmd(fn, sharded_out=sharded_out)
+
+
+def _tables(seed=11):
+    return generate_build_probe_tables(
+        seed=seed, build_nrows=512, probe_nrows=1024, rand_max=256,
+        selectivity=0.5,
+    )
+
+
+def _oracle(build, probe) -> int:
+    return len(build.to_pandas().merge(probe.to_pandas(), on="key"))
+
+
+# -- cache-key discipline ---------------------------------------------
+
+
+def test_cache_hit_adds_zero_programs(tmp_path):
+    """Cold miss builds exactly one program; the identical signature —
+    including with DIFFERENT table contents of the same shape — hits
+    without building; a telemetry session keys a SEPARATE
+    (instrumented) entry whose result carries ``res.telemetry``."""
+    b, p = _tables()
+    want = _oracle(b, p)
+    comm = CountingComm()
+    cache = JoinProgramCache(comm)
+
+    e1, hit1 = cache.get(b, p, key="key", out_capacity_factor=4.0)
+    assert not hit1 and comm.programs_built == 1
+    res = e1(b, p)
+    assert int(res.total) == want
+    assert not hasattr(res, "telemetry")
+
+    e2, hit2 = cache.get(b, p, key="key", out_capacity_factor=4.0)
+    assert hit2 and e2 is e1 and comm.programs_built == 1
+
+    # same shape, different data: still the same program (seed 12 is
+    # overflow-free at these capacities, like seed 11)
+    b3, p3 = _tables(seed=12)
+    e3, hit3 = cache.get(b3, p3, key="key", out_capacity_factor=4.0)
+    assert hit3 and e3 is e1 and comm.programs_built == 1
+    assert int(e3(b3, p3).total) == _oracle(b3, p3)
+
+    # an active session resolves with_metrics=True -> a DISTINCT entry
+    with telemetry.session(str(tmp_path / "tel")):
+        e4, hit4 = cache.get(b, p, key="key", out_capacity_factor=4.0)
+        assert not hit4 and comm.programs_built == 2
+        res4 = e4(b, p)
+        assert int(res4.total) == want
+        assert hasattr(res4, "telemetry")
+    assert cache.stats()["entries"] == 2
+    assert cache.stats()["hits"] == 2
+
+
+def test_distinct_signatures_distinct_entries():
+    """Every serving-relevant knob keys its own entry (programs are
+    BUILT per distinct signature, never silently shared). Entries are
+    not dispatched here — the discipline under test is the key."""
+    b, p = _tables()
+    comm = CountingComm()
+    cache = JoinProgramCache(comm)
+    base = dict(key="key", out_capacity_factor=4.0)
+
+    variants = [
+        dict(base),
+        dict(base, with_metrics=True),              # telemetry on
+        dict(base, with_integrity=True),            # integrity on
+        dict(base, shuffle="ragged"),               # shuffle mode
+        dict(base, shuffle="ppermute"),
+        dict(base, out_capacity_factor=8.0),        # ladder rung
+        dict(base, shuffle_capacity_factor=3.2),    # ladder rung
+        dict(base, over_decomposition=2),
+        dict(base, compression_bits=16),
+        dict(base, skew_threshold=0.01),            # skew policy
+        dict(base, metrics_static={"retry_attempt_max": 1}),
+    ]
+    sigs = []
+    for i, opts in enumerate(variants, start=1):
+        sigs.append(cache.signature(b, p, **opts))
+        _, hit = cache.get(b, p, **opts)
+        assert not hit and comm.programs_built == i
+    assert len(set(sigs)) == len(variants)
+
+    # a differing schema is a differing signature too
+    b2 = Table(dict(b.columns,
+                    extra=jnp.zeros(b.capacity, jnp.int32)), b.valid)
+    assert cache.signature(b2, p, **base) != sigs[0]
+    # ... and an unknown option is a loud error, not a silent alias
+    with pytest.raises(TypeError):
+        JoinSignature.of(comm, b, p, not_a_join_option=1)
+
+    # every variant re-keyed identically is a pure hit
+    built = comm.programs_built
+    for opts in variants:
+        _, hit = cache.get(b, p, **opts)
+        assert hit
+    assert comm.programs_built == built
+
+
+def test_cache_lru_bound():
+    """A bounded cache evicts least-recently-used entries instead of
+    growing with every distinct request shape (the long-lived server's
+    resource bound)."""
+    b, p = _tables()
+    comm = CountingComm()
+    cache = JoinProgramCache(comm, max_entries=2)
+    opts = [dict(key="key", out_capacity_factor=f)
+            for f in (2.0, 3.0, 4.0)]
+    for o in opts:
+        cache.get(b, p, **o)
+    assert len(cache) == 2
+    assert cache.lru_evictions == 1
+    _, hit_new = cache.get(b, p, **opts[2])
+    assert hit_new                       # newest stayed resident
+    _, hit_old = cache.get(b, p, **opts[0])
+    assert not hit_old                   # oldest was evicted
+
+
+# -- the warm path through distributed_inner_join ---------------------
+
+
+def test_repeat_query_is_run_only():
+    """A second identical join through the service cache executes with
+    zero new traces (the acceptance bar)."""
+    b, p = _tables()
+    want = _oracle(b, p)
+    comm = CountingComm()
+    cache = JoinProgramCache(comm)
+    r1 = dj.distributed_inner_join(b, p, comm, program_cache=cache,
+                                   out_capacity_factor=4.0)
+    assert comm.programs_built == 1
+    r2 = dj.distributed_inner_join(b, p, comm, program_cache=cache,
+                                   out_capacity_factor=4.0)
+    assert comm.programs_built == 1
+    assert int(r1.total) == int(r2.total) == want
+    assert cache.stats()["hits"] == 1
+
+
+def test_retry_rung_reuses_cached_executable():
+    """An injected capacity squeeze drives the ladder through two
+    rungs (two programs); the identical query repeated re-walks BOTH
+    rungs from cache — zero new programs — and still resolves (the
+    squeeze was baked into rung 0's program at trace time)."""
+    b, p = _tables()
+    want = _oracle(b, p)
+    inner = CountingComm()
+    comm = FaultInjectingCommunicator(
+        inner, FaultPlan(overflow_programs=1))
+    cache = JoinProgramCache(comm)
+    r1 = dj.distributed_inner_join(b, p, comm, auto_retry=2,
+                                   program_cache=cache,
+                                   out_capacity_factor=4.0)
+    assert r1.retry_report.n_attempts == 2
+    assert inner.programs_built == 2
+    r2 = dj.distributed_inner_join(b, p, comm, auto_retry=2,
+                                   program_cache=cache,
+                                   out_capacity_factor=4.0)
+    assert r2.retry_report.n_attempts == 2
+    assert inner.programs_built == 2          # both rungs were warm
+    assert int(r1.total) == int(r2.total) == want
+
+
+def test_integrity_rung_evicts_and_retraces():
+    """A wire-corruption verdict must NOT reuse the resident program:
+    the rung is evicted and re-traced (the injected trace-time budget
+    exhausts), and the rerun verifies clean."""
+    b, p = _tables()
+    inner = CountingComm()
+    comm = FaultInjectingCommunicator(
+        inner, FaultPlan(seed=3, corrupt_mode="bit_flip",
+                         corrupt_collectives=1))
+    cache = JoinProgramCache(comm)
+    res = dj.distributed_inner_join(b, p, comm, auto_retry=2,
+                                    verify_integrity=True,
+                                    program_cache=cache,
+                                    out_capacity_factor=4.0)
+    actions = [a.action for a in res.retry_report.attempts]
+    assert actions == ["initial", "retry_integrity"]
+    assert inner.programs_built == 2          # evict -> fresh trace
+    assert res.integrity_report.ok
+    assert int(res.total) == _oracle(b, p)
+
+
+def test_terminal_integrity_failure_evicts():
+    """When the retry budget exhausts on a still-corrupt wire, the
+    IntegrityError raise must not leave the tainted program resident —
+    the next same-signature request would otherwise be a cache hit on
+    a program that can never verify."""
+    from distributed_join_tpu.parallel import integrity
+
+    b, p = _tables()
+    inner = CountingComm()
+    comm = FaultInjectingCommunicator(
+        inner, FaultPlan(seed=3, corrupt_mode="bit_flip",
+                         corrupt_collectives=99))
+    cache = JoinProgramCache(comm)
+    with pytest.raises(integrity.IntegrityError):
+        dj.distributed_inner_join(b, p, comm, auto_retry=1,
+                                  verify_integrity=True,
+                                  program_cache=cache,
+                                  out_capacity_factor=4.0)
+    assert len(cache) == 0
+
+
+def test_persisted_program_restarts_with_zero_traces(tmp_path):
+    """The on-disk AOT tier: a FRESH cache (a restarted server) loads
+    the serialized executable and answers with zero traced programs."""
+    b, p = _tables()
+    want = _oracle(b, p)
+    d = str(tmp_path / "programs")
+    c1 = CountingComm()
+    cache1 = JoinProgramCache(c1, persist_dir=d)
+    e1, _ = cache1.get(b, p, key="key", out_capacity_factor=4.0)
+    if not e1.persisted:  # pragma: no cover - backend-dependent
+        pytest.skip("backend does not serialize executables")
+    assert c1.programs_built == 1
+    assert int(e1(b, p).total) == want
+
+    c2 = CountingComm()
+    cache2 = JoinProgramCache(c2, persist_dir=d)
+    e2, hit = cache2.get(b, p, key="key", out_capacity_factor=4.0)
+    assert not hit and e2.source == "disk"
+    assert c2.programs_built == 0             # no trace, no compile
+    assert int(e2(b, p).total) == want
+    assert cache2.stats()["disk_loads"] == 1
+
+
+# -- micro-batching ----------------------------------------------------
+
+
+def _request(i: int):
+    """Request i: keys 0..63 on the build side, probe keys 0..95
+    cycling — every request carries the SAME key values (the
+    adversarial collision case) but request-tagged payloads."""
+    build = Table.from_dense({
+        "key": jnp.arange(64, dtype=jnp.int64),
+        "build_payload": jnp.arange(64, dtype=jnp.int64) + 1000 * i,
+    })
+    probe = Table.from_dense({
+        "key": jnp.arange(128, dtype=jnp.int64) % 96,
+        "probe_payload": jnp.arange(128, dtype=jnp.int64) + 5000 * i,
+    })
+    return build, probe
+
+
+def test_batching_oracle_isolation_and_program_reuse():
+    """K colliding requests in ONE SPMD step: per-request matches
+    equal each request's OWN pandas oracle, every output row pairs
+    payloads of the same request (no cross-request matches), and a
+    second batch with different fill but the same slots hits the same
+    cached program."""
+    import numpy as np
+
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+
+    comm = CountingComm()
+    service = JoinService(comm, ServiceConfig(auto_retry=1))
+    requests = [_request(i) for i in range(3)]
+    oracles = [_oracle(b, p) for b, p in requests]
+
+    results = service.join_batched(
+        requests, slot_build_rows=64, slot_probe_rows=128,
+        with_rows=True, out_capacity_factor=4.0)
+    built = comm.programs_built
+    assert [r["matches"] for r in results] == oracles
+    for i, r in enumerate(results):
+        rows = r["rows"]
+        assert rows["build_payload"].size == oracles[i]
+        # payload ranges are request-tagged: a cross-request match
+        # would pair a build payload from one range with a probe
+        # payload from another
+        assert np.all((rows["build_payload"] >= 1000 * i)
+                      & (rows["build_payload"] < 1000 * i + 64))
+        assert np.all((rows["probe_payload"] >= 5000 * i)
+                      & (rows["probe_payload"] < 5000 * i + 128))
+        assert batching.SEGMENT_COLUMN not in rows
+
+    # different data, same slots -> the same compiled program
+    shifted = [_request(i + 7) for i in range(3)]
+    results2 = service.join_batched(
+        shifted, slot_build_rows=64, slot_probe_rows=128,
+        out_capacity_factor=4.0)
+    assert comm.programs_built == built
+    assert [r["matches"] for r in results2] \
+        == [_oracle(b, p) for b, p in shifted]
+    assert service.served == 2
+
+
+def test_batching_validation():
+    b0, p0 = _request(0)
+    with pytest.raises(ValueError):
+        batching.combine([], key="key")
+    # mismatched schemas refuse loudly
+    b1 = Table.from_dense({
+        "key": jnp.arange(64, dtype=jnp.int64),
+        "other": jnp.arange(64, dtype=jnp.int32),
+    })
+    with pytest.raises(ValueError):
+        batching.combine([(b0, p0), (b1, p0)], key="key")
+    # the segment column name is batching-internal
+    b2 = Table.from_dense({
+        "key": jnp.arange(64, dtype=jnp.int64),
+        batching.SEGMENT_COLUMN: jnp.arange(64, dtype=jnp.int32),
+    })
+    with pytest.raises(ValueError):
+        batching.combine([(b2, p0)], key="key")
+    # a request larger than the pinned slot refuses (silent truncation
+    # would drop rows)
+    with pytest.raises(ValueError):
+        batching.combine([(b0, p0)], key="key", slot_build_rows=32)
+
+
+# -- admission + the daemon -------------------------------------------
+
+
+def test_admission_bounds():
+    from distributed_join_tpu.service.server import (
+        AdmissionError,
+        JoinService,
+        ServiceConfig,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    service = JoinService(
+        comm, ServiceConfig(max_pending=2, max_batch_requests=4))
+    b, p = _request(0)
+    service._pending = 2                      # saturate admission
+    with pytest.raises(AdmissionError):
+        service.join(b, p)
+    service._pending = 0
+    with pytest.raises(AdmissionError):
+        service.join_batched([(b, p)] * 5)
+    assert service.rejected == 2
+    assert service.served == 0
+
+
+def test_hung_request_poisons_service():
+    """A request that blows its deadline leaves its join running on
+    the detached watchdog worker — the mesh must not take another
+    program. Fail-stop: later joins are refused until restart."""
+    import time
+
+    from distributed_join_tpu.parallel.watchdog import HangError
+    from distributed_join_tpu.service.server import (
+        AdmissionError,
+        JoinService,
+        ServiceConfig,
+    )
+
+    b, p = _tables()
+    comm = FaultInjectingCommunicator(
+        CountingComm(), FaultPlan(dispatch_delay_s=3.0))
+    service = JoinService(
+        comm, ServiceConfig(request_deadline_s=0.75, auto_retry=0))
+    with pytest.raises(HangError):
+        service.join(b, p, out_capacity_factor=4.0)
+    assert service.stats()["poisoned"]
+    with pytest.raises(AdmissionError):
+        service.join(b, p, out_capacity_factor=4.0)
+    assert service.failed == 1 and service.rejected == 1
+    # let the detached worker drain so it cannot interleave with the
+    # next test's programs
+    time.sleep(3.0)
+
+
+def test_daemon_warm_and_batched_over_tcp():
+    """The wire protocol end to end: a warm repeat answers with zero
+    new traces, stats report the cache, a micro-batch answers per
+    request, and shutdown stops the daemon."""
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceClient,
+        ServiceConfig,
+        start_daemon,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    service = JoinService(comm, ServiceConfig(auto_retry=1))
+    server, port = start_daemon(service)
+    client = ServiceClient("127.0.0.1", port)
+    try:
+        assert client.send({"op": "ping"})["ok"]
+        q = {"op": "join", "build_nrows": 256, "probe_nrows": 256,
+             "seed": 7, "selectivity": 0.5,
+             "out_capacity_factor": 4.0}
+        cold = client.send(q)
+        assert cold["ok"] and cold["new_traces"] >= 1
+        warm = client.send(q)
+        assert warm["ok"] and warm["new_traces"] == 0
+        assert warm["matches"] == cold["matches"]
+
+        specs = [dict(q, seed=20 + i) for i in range(3)]
+        for s in specs:
+            s.pop("op")
+        batch = client.send({"op": "batch", "requests": specs,
+                             "out_capacity_factor": 4.0})
+        assert batch["ok"] and len(batch["requests"]) == 3
+        assert batch["matches"] == sum(
+            r["matches"] for r in batch["requests"])
+
+        # unknown ops answer the client instead of killing the daemon
+        bad = client.send({"op": "nope"})
+        assert not bad["ok"] and bad["error"] == "ValueError"
+
+        stats = client.send({"op": "stats"})
+        assert stats["ok"] and stats["served"] == 3
+        assert stats["cache"]["hits"] >= 1
+        assert client.send({"op": "shutdown"})["ok"]
+    finally:
+        client.close()
+        server.server_close()
