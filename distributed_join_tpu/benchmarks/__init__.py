@@ -118,6 +118,7 @@ def run_guarded(run, args, benchmark: str) -> int:
     telemetry.configure_from_args(args)
     guard_s = resolve_guard_deadline(args)
     result = None
+    failure_record = None
     try:
         if guard_s is None:
             result = run(args)
@@ -141,6 +142,7 @@ def run_guarded(run, args, benchmark: str) -> int:
                     traceback.format_exc().splitlines()[-3:],
             }),
         })
+        failure_record = record
         line = json.dumps(record)
         print(line, flush=True)
         json_output = getattr(args, "json_output", None)
@@ -159,10 +161,12 @@ def run_guarded(run, args, benchmark: str) -> int:
             # the record above is already flushed. os._exit skips the
             # finally below, so flush the telemetry files first.
             # (--diagnose is skipped: neither outage class leaves
-            # settled join telemetry to read.) Only the bootstrap
-            # outage exits 0; a hang keeps rc 1 — automation must see
-            # a wedged benchmark as a failure.
-            telemetry.finalize()
+            # settled join telemetry to read. --history is NOT — a
+            # hang-prone workload is exactly the trend the history
+            # store must show, so the failure entry lands here.)
+            # Only the bootstrap outage exits 0; a hang keeps rc 1 —
+            # automation must see a wedged benchmark as a failure.
+            maybe_history(args, telemetry.finalize(), record=record)
             sys.stdout.flush()
             sys.stderr.flush()
             os._exit(0 if is_bootstrap else 1)
@@ -172,6 +176,12 @@ def run_guarded(run, args, benchmark: str) -> int:
         # that died is exactly the run whose trace you want.
         summary = telemetry.finalize()
         maybe_diagnose(args, summary, record=result)
+        # On the failure path result is None — the history entry must
+        # carry the failure record (outcome "failed" + the error), not
+        # a bogus healthy entry hashed from an empty workload.
+        maybe_history(args, summary,
+                      record=result if isinstance(result, dict)
+                      else failure_record)
 
 
 def maybe_diagnose(args, summary, record=None) -> None:
@@ -203,6 +213,63 @@ def maybe_diagnose(args, summary, record=None) -> None:
                      print_report=True)
     except Exception as exc:  # noqa: BLE001 — diagnosis is best-effort
         print(f"note: --diagnose failed: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+
+
+def maybe_history(args, summary, record=None) -> None:
+    """End-of-run ``--history FILE`` hook (next to :func:`maybe_
+    diagnose`): append one workload-history entry — workload
+    signature, counter signature, indicators, resolved retry knobs,
+    wall time (``telemetry/history.py``) — so offline/hardware runs
+    feed the same per-signature store the join service writes per
+    request. Rank 0 only; best-effort like diagnosis."""
+    import sys
+
+    path = getattr(args, "history", None)
+    if not path:
+        return
+    if not isinstance(record, dict):
+        # No record at all (e.g. SystemExit before run()): there is no
+        # workload identity to file the entry under — appending would
+        # collapse every such run into one empty-workload signature.
+        return
+    from distributed_join_tpu.parallel.bootstrap import is_coordinator
+
+    if not is_coordinator():
+        return
+    try:
+        from distributed_join_tpu.telemetry import history
+
+        # A failure record carries only benchmark/error; back-fill the
+        # workload identity from the driver's own args so a failed run
+        # files under the SAME signature as its healthy runs (the
+        # trend the autotuner needs: "this workload failed").
+        record = dict(record)
+        for key in history.WORKLOAD_KEYS:
+            if record.get(key) is None:
+                val = getattr(args, key, None)
+                if val is not None:
+                    record[key] = val
+        if record.get("n_ranks") is None:
+            # n_ranks is runtime-resolved (args default None = all
+            # visible devices), so a failure record would otherwise
+            # hash to a different signature than the workload's
+            # healthy runs. Read it from the ALREADY-initialized
+            # backend only — probing would re-initialize against the
+            # same dead relay on the bootstrap-outage path.
+            try:
+                from jax._src import xla_bridge
+
+                if getattr(xla_bridge, "_backends", None):
+                    import jax
+
+                    record["n_ranks"] = jax.device_count()
+            except Exception:  # pragma: no cover - private-API drift
+                pass
+        history.WorkloadHistory(path).append(history.run_entry(
+            record=record, summary=summary))
+    except Exception as exc:  # noqa: BLE001 — history is best-effort
+        print(f"note: --history failed: {type(exc).__name__}: {exc}",
               file=sys.stderr)
 
 
@@ -241,6 +308,15 @@ def add_telemetry_args(parser) -> None:
              "indicators + knob recommendations, written to "
              "DIR/diagnosis.json and printed on rank 0. Implies "
              "--telemetry",
+    )
+    parser.add_argument(
+        "--history", default=None, metavar="FILE",
+        help="at end of run, append one workload-history entry "
+             "(telemetry/history.py: workload signature, counter "
+             "signature, indicators, resolved retry knobs, wall time) "
+             "to FILE — the same per-signature store the join service "
+             "writes per request and `telemetry.analyze history` "
+             "summarizes. Implies --telemetry; rank 0 only",
     )
 
 
@@ -283,6 +359,7 @@ FORWARDED_CHILD_FLAGS = (
     ("--telemetry", "telemetry", True),
     ("--trace", "trace", False),
     ("--diagnose", "diagnose", False),
+    ("--history", "history", True),
     ("--verify-integrity", "verify_integrity", False),
     ("--chaos-seed", "chaos_seed", True),
     ("--guard-deadline-s", "guard_deadline_s", True),

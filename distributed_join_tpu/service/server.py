@@ -19,6 +19,20 @@ long-lived process:
 - :func:`JoinService.join_batched` — K small requests micro-batched
   into one padded SPMD step (:mod:`..service.batching`), unpacked per
   request at settle.
+- the live observability layer (docs/OBSERVABILITY.md "Live service
+  metrics"): every request gets a ``request_id`` minted at admission
+  and threaded through the telemetry span, every event it emits, the
+  per-rank JSONL, the Perfetto trace, and the wire response; a
+  lock-protected :class:`~..telemetry.live.LiveMetrics` (latency
+  histograms with derivable p50/p95/p99, per-op and per-signature
+  counters, rolling QPS) behind the ``metrics`` wire op (JSON +
+  Prometheus text exposition) and the client ``--watch`` console; a
+  :class:`~..telemetry.live.FlightRecorder` ring dumped as
+  ``flightrecorder.json`` on poison or terminal error; and a
+  :class:`~..telemetry.history.WorkloadHistory` store
+  (``history.jsonl`` under the cache's ``persist_dir``) recording
+  each request's counter signature, indicators, resolved knobs, and
+  wall time — ROADMAP item 5's autotuner substrate.
 - the TCP daemon (``tpu-join-service`` / ``python -m
   distributed_join_tpu.service.server``): one JSON object per line in,
   one per line out. The wire carries QUERIES (table generator specs +
@@ -26,9 +40,11 @@ long-lived process:
   :class:`JoinService` directly for resident data. ``--smoke`` runs
   the CI acceptance protocol (docs/SERVICE.md): cold query, warm
   repeat (must add zero traces), 16 small joins sequential vs batched
-  (batched must win wall clock), emitting a JSON record whose counter
-  signature the ``perfgate`` lane gates against
-  ``results/baselines/service_smoke.json``.
+  (batched must win wall clock), a live-metrics scrape (quantiles
+  must be non-degenerate), and a poison drill on a throwaway service
+  (an induced hang must dump a schema-valid flight recorder),
+  emitting a JSON record whose counter signature the ``perfgate``
+  lane gates against ``results/baselines/service_smoke.json``.
 
 End-of-run ``--diagnose`` and the telemetry/robustness flags work
 exactly as on the drivers (``run_guarded`` owns them); the
@@ -41,6 +57,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import socket
 import socketserver
 import sys
@@ -51,6 +68,8 @@ from typing import Optional
 from distributed_join_tpu import telemetry
 from distributed_join_tpu.service import batching
 from distributed_join_tpu.service.programs import JoinProgramCache
+from distributed_join_tpu.telemetry import history as tel_history
+from distributed_join_tpu.telemetry import live as tel_live
 
 
 class AdmissionError(RuntimeError):
@@ -67,6 +86,11 @@ class ServiceConfig:
     unguarded); ``auto_retry``/``verify_integrity`` are the ladder and
     wire-integrity contracts of ``distributed_inner_join``, applied to
     every request; ``persist_dir`` arms the cache's on-disk AOT tier.
+    ``history_dir`` (default: ``persist_dir``) arms the per-request
+    workload-history store; ``flight_records`` sizes the postmortem
+    ring, and ``flight_recorder_path`` pins where a poison/terminal
+    dump lands (default: the telemetry session dir, else the history
+    dir, else cwd).
     """
 
     auto_retry: int = 2
@@ -76,6 +100,9 @@ class ServiceConfig:
     max_batch_requests: int = 64
     max_programs: int = 128
     persist_dir: Optional[str] = None
+    history_dir: Optional[str] = None
+    flight_records: int = 256
+    flight_recorder_path: Optional[str] = None
 
 
 class JoinService:
@@ -93,9 +120,25 @@ class JoinService:
         self._exec_lock = threading.Lock()
         self._admit_lock = threading.Lock()
         self._pending = 0
+        self._pending_hwm = 0
+        self._request_seq = 0
+        # Per-service nonce in every minted id: a client-supplied id
+        # (echoed verbatim) can then never collide with the minted
+        # namespace, and ids stay unique across server restarts too.
+        self._id_stamp = os.urandom(3).hex()
         self.served = 0
         self.rejected = 0
         self.failed = 0
+        self.live = tel_live.LiveMetrics()
+        self.recorder = tel_live.FlightRecorder(
+            self.config.flight_records)
+        self.flight_recorder_dumped: Optional[str] = None
+        hist_dir = self.config.history_dir or self.config.persist_dir
+        # explicit join: the dir may not exist yet, and history_path
+        # only maps EXISTING directories to their history.jsonl
+        self.history = (tel_history.WorkloadHistory(
+            os.path.join(hist_dir, tel_history.HISTORY_FILENAME))
+            if hist_dir else None)
         # Set (to the HangError description) when a request blew its
         # deadline: the timed-out join keeps running on its detached
         # watchdog worker, so dispatching ANOTHER program onto the
@@ -107,11 +150,35 @@ class JoinService:
 
     # -- admission -----------------------------------------------------
 
-    def _admit(self):
+    def _mint_request_id(self, request_id) -> str:
+        """Admission-lock-held: the one place request ids come from.
+        A client-supplied id is honored (capped) so callers can thread
+        their own correlation keys end to end."""
+        self._request_seq += 1
+        if request_id:
+            rid = str(request_id)
+            if len(rid) > 64:
+                # Cap length without aliasing: two long client ids
+                # sharing a 64-char prefix must stay distinct.
+                import hashlib
+
+                rid = (rid[:48] + "-"
+                       + hashlib.sha256(rid.encode()).hexdigest()[:15])
+            return rid
+        return f"req-{self._id_stamp}-{self._request_seq:06d}"
+
+    def _admit(self, op: str, request_id=None) -> str:
         with self._admit_lock:
+            rid = self._mint_request_id(request_id)
             if self.poisoned is not None:
                 self.rejected += 1
-                telemetry.event("request_rejected", reason="poisoned")
+                telemetry.event("request_rejected", reason="poisoned",
+                                request_id=rid)
+                self.live.record_request(op, "rejected")
+                self.recorder.record(request_id=rid, op=op,
+                                     signature=None,
+                                     outcome="rejected",
+                                     reason="poisoned")
                 raise AdmissionError(
                     "mesh poisoned by a hung request "
                     f"({self.poisoned}); restart the server"
@@ -119,13 +186,21 @@ class JoinService:
             if self._pending >= self.config.max_pending:
                 self.rejected += 1
                 telemetry.event("request_rejected", reason="pending",
-                                pending=self._pending)
+                                pending=self._pending, request_id=rid)
+                self.live.record_request(op, "rejected")
+                self.recorder.record(request_id=rid, op=op,
+                                     signature=None,
+                                     outcome="rejected",
+                                     reason="pending")
                 raise AdmissionError(
                     f"{self._pending} requests already pending "
                     f"(max_pending={self.config.max_pending}); "
                     "retry with backoff"
                 )
             self._pending += 1
+            if self._pending > self._pending_hwm:
+                self._pending_hwm = self._pending
+        return rid
 
     def _release(self):
         with self._admit_lock:
@@ -133,20 +208,32 @@ class JoinService:
 
     # -- the request paths --------------------------------------------
 
-    def join(self, build, probe, key="key", **opts):
+    def join(self, build, probe, key="key", *, request_id=None,
+             op: str = "join", **opts):
         """One admitted, watchdog-guarded, span-wrapped join through
         the program cache. Returns the ``JoinResult`` (with
         ``retry_report`` / ``integrity_report`` attributes exactly as
-        ``distributed_inner_join`` attaches them)."""
+        ``distributed_inner_join`` attaches them, plus the host-side
+        ``new_traces`` and ``request_id``)."""
         from distributed_join_tpu.parallel.distributed_join import (
             distributed_inner_join,
         )
         from distributed_join_tpu.parallel.watchdog import (
+            HangError,
             call_with_deadline,
         )
 
-        self._admit()
+        rid = self._admit(op, request_id)
+        t_start = time.perf_counter()
+        sig = None
+        outcome = "failed"
+        res = None
+        err: Optional[BaseException] = None
+        new_traces = cache_hits = 0
         try:
+            # Inside the try: anything raising after _admit must still
+            # release the pending-admission slot in the finally.
+            sig = self._workload_signature(build, probe, key, opts)
             with self._exec_lock:
                 # Re-check under the EXEC lock: a request admitted
                 # before a hang can be parked here while the hanging
@@ -155,12 +242,13 @@ class JoinService:
                 with self._admit_lock:
                     if self.poisoned is not None:
                         self.rejected += 1
+                        outcome = "rejected"
                         telemetry.event("request_rejected",
-                                        reason="poisoned")
+                                        reason="poisoned",
+                                        request_id=rid)
                         raise AdmissionError(
                             "mesh poisoned by a hung request "
                             f"({self.poisoned}); restart the server")
-                rid = self.served + self.failed
 
                 def run_once():
                     return distributed_inner_join(
@@ -171,8 +259,16 @@ class JoinService:
 
                 deadline = self.config.request_deadline_s
                 traces0 = self.cache.traces
+                hits0 = self.cache.hits
                 try:
-                    with telemetry.span("request", id=rid) as sp:
+                    # request_scope tags EVERY event/span this request
+                    # emits — ladder rungs, cache traces, watchdog
+                    # events, even from worker threads — with rid, so
+                    # one grep correlates wire response, JSONL, and
+                    # Perfetto views.
+                    with telemetry.request_scope(rid), \
+                            telemetry.span("request", request_id=rid,
+                                           op=op, signature=sig) as sp:
                         if deadline is None:
                             res = run_once()
                         else:
@@ -182,12 +278,10 @@ class JoinService:
                         if sp is not None:
                             sp.sync_on(res.total)
                 except Exception as exc:
-                    self.failed += 1
-                    from distributed_join_tpu.parallel.watchdog import (
-                        HangError,
-                    )
-
+                    new_traces = self.cache.traces - traces0
+                    cache_hits = self.cache.hits - hits0
                     if isinstance(exc, HangError):
+                        outcome = "hang"
                         with self._admit_lock:
                             self.poisoned = str(exc)
                     raise
@@ -196,47 +290,244 @@ class JoinService:
                 # concurrent connection's cold compile must not be
                 # misattributed to this request (host-side attribute,
                 # the retry_report pattern).
-                object.__setattr__(res, "new_traces",
-                                   self.cache.traces - traces0)
+                new_traces = self.cache.traces - traces0
+                cache_hits = self.cache.hits - hits0
+                outcome = "served"
+                object.__setattr__(res, "new_traces", new_traces)
+                object.__setattr__(res, "request_id", rid)
                 return res
+        except BaseException as exc:
+            err = exc
+            # Counted HERE (not in the dispatch-level handler) so a
+            # request that dies before dispatch — e.g. signature
+            # computation on a malformed input — is a failure too;
+            # poisoned-recheck refusals already counted as rejected.
+            # Under the admit lock: pre-dispatch failures run outside
+            # the exec lock, so concurrent increments would race.
+            if outcome != "rejected":
+                if isinstance(exc, Exception):
+                    with self._admit_lock:
+                        self.failed += 1
+                else:
+                    # Ctrl-C / SystemExit mid-request is an ABORT of
+                    # the process, not a failure of the workload —
+                    # it must not pollute the per-signature failure
+                    # trend the history store exists to show.
+                    outcome = "aborted"
+            raise
         finally:
+            # Release the admission slot BEFORE the bookkeeping
+            # fan-out: _observe does file I/O (history append, a
+            # poison-time flight dump) and uses no admission state —
+            # holding the slot through it would reject concurrent
+            # requests for no reason.
             self._release()
+            self._observe(rid, op, sig, outcome, res, err,
+                          time.perf_counter() - t_start,
+                          new_traces, cache_hits)
 
     def join_batched(self, requests, key="key", *,
                      slot_build_rows=None, slot_probe_rows=None,
-                     with_rows: bool = False, **opts):
+                     with_rows: bool = False, request_id=None, **opts):
         """Micro-batch ``requests`` (``(build, probe)`` pairs sharing
         one schema and ``key``) into one SPMD step and unpack per
         request. Returns ``batching.split``'s per-request records."""
         if len(requests) > self.config.max_batch_requests:
             with self._admit_lock:
+                rid = self._mint_request_id(request_id)
                 self.rejected += 1
             telemetry.event("request_rejected", reason="batch_size",
-                            batch=len(requests))
+                            batch=len(requests), request_id=rid)
+            self.live.record_request("batch", "rejected")
+            self.recorder.record(request_id=rid, op="batch",
+                                 signature=None, outcome="rejected",
+                                 reason="batch_size")
             raise AdmissionError(
                 f"batch of {len(requests)} exceeds max_batch_requests="
                 f"{self.config.max_batch_requests}"
             )
-        mb = batching.combine(
-            requests, key=key, slot_build_rows=slot_build_rows,
-            slot_probe_rows=slot_probe_rows)
-        res = self.join(mb.build, mb.probe, key=list(mb.key), **opts)
+        try:
+            mb = batching.combine(
+                requests, key=key, slot_build_rows=slot_build_rows,
+                slot_probe_rows=slot_probe_rows)
+        except Exception as exc:
+            # A malformed batch (schema mismatch, oversize slot) dies
+            # BEFORE self.join's accounting — it must still be
+            # visible to operators as a failure, not only to the one
+            # client that sent it.
+            with self._admit_lock:
+                rid = self._mint_request_id(request_id)
+                self.failed += 1
+            error = f"{type(exc).__name__}: {exc}"
+            telemetry.event("request_failed", reason="batch_combine",
+                            request_id=rid, error=error)
+            self.live.record_request("batch", "failed")
+            self.recorder.record(request_id=rid, op="batch",
+                                 signature=None, outcome="failed",
+                                 reason="batch_combine", error=error)
+            raise
+        res = self.join(mb.build, mb.probe, key=list(mb.key),
+                        request_id=request_id, op="batch", **opts)
         results = batching.split(res, mb, with_rows=with_rows)
         for r in results:
             # the batch shares one program resolution; the count is
             # replicated per request for the wire's convenience
             r["new_traces"] = getattr(res, "new_traces", 0)
+            r["request_id"] = getattr(res, "request_id", None)
         return results
 
+    # -- live observability -------------------------------------------
+
+    def _workload_signature(self, build, probe, key, opts) -> str:
+        """The stable workload identity the live layer keys on (flight
+        records, per-signature counters, history lines): the program
+        cache's canonical signature digest, truncated. Coarser than
+        the per-rung entries the cache stores (the ladder resolves its
+        sizing at dispatch) — one workload keeps one hash across its
+        rungs."""
+        o = dict(opts)
+        wm = o.pop("with_metrics", None)
+        wi = o.pop("with_integrity", self.config.verify_integrity)
+        try:
+            return self.cache.signature(
+                build, probe, key=key, with_metrics=wm,
+                with_integrity=wi, **o).digest()[:16]
+        except Exception:
+            # Unknown option combinations still deserve an identity
+            # (the join itself will refuse them loudly) — hash the
+            # shapes + options directly.
+            import hashlib
+
+            basis = json.dumps(
+                {"key": key,
+                 "build": sorted(build.columns),
+                 "probe": sorted(probe.columns),
+                 "opts": sorted((k, repr(v)) for k, v in opts.items())},
+                sort_keys=True, default=str)
+            return hashlib.sha256(basis.encode()).hexdigest()[:16]
+
+    def _observe(self, rid, op, sig, outcome, res, err, elapsed_s,
+                 new_traces, cache_hits):
+        """Per-request accounting fan-out: live metrics, the flight-
+        recorder ring, the workload-history store, and the poison-time
+        flight dump. Observability must never turn a served request
+        into a failure (or mask the request's own error), so the whole
+        fan-out is guarded."""
+        try:
+            retry_rec = None
+            rung_path = None
+            matches = None
+            overflow = None
+            if res is not None and outcome == "served":
+                rr = getattr(res, "retry_report", None)
+                if rr is not None:
+                    retry_rec = rr.as_record()
+                    rung_path = [a.action for a in rr.attempts]
+                matches = int(res.total)
+                overflow = bool(res.overflow)
+            counts = tel_history.retry_counts(retry_rec)
+            error = (f"{type(err).__name__}: {err}"
+                     if err is not None else None)
+            self.live.record_request(
+                op, outcome,
+                latency_s=elapsed_s if outcome == "served" else None,
+                signature=sig, cache_hits=cache_hits,
+                new_traces=new_traces,
+                retry_rungs=max(counts["n_attempts"] - 1, 0),
+                integrity_retries=counts["integrity_retries"])
+            self.recorder.record(
+                request_id=rid, op=op, signature=sig, outcome=outcome,
+                elapsed_s=round(elapsed_s, 6), matches=matches,
+                overflow=overflow, new_traces=new_traces,
+                cache_hits=cache_hits, rung_path=rung_path,
+                error=error)
+            if self.history is not None:
+                tel = (getattr(res, "telemetry", None)
+                       if res is not None else None)
+                self.history.append(tel_history.request_entry(
+                    request_id=rid, op=op, signature=sig,
+                    outcome=outcome, wall_s=elapsed_s,
+                    new_traces=new_traces, cache_hits=cache_hits,
+                    matches=matches, retry_record=retry_rec,
+                    metrics=tel.to_dict() if tel is not None else None,
+                    error=error))
+            if outcome == "hang":
+                self.dump_flight_recorder(
+                    f"poisoned: request {rid} blew its deadline")
+        except Exception as exc:  # noqa: BLE001 - bookkeeping boundary
+            telemetry.event("observability_error", request_id=rid,
+                            error=f"{type(exc).__name__}: {exc}")
+
+    def dump_flight_recorder(self, reason: str) -> Optional[str]:
+        """Dump the last-N request ring as ``flightrecorder.json``
+        (``telemetry.analyze check`` validates the schema). Called on
+        poison and on daemon terminal error; also safe to call any
+        time for a live snapshot."""
+        path = self.config.flight_recorder_path
+        if path is None:
+            s = telemetry.sink()
+            base = (s.dir if s is not None
+                    else self.config.history_dir
+                    or self.config.persist_dir or ".")
+            path = os.path.join(base, tel_live.FLIGHT_RECORDER_FILENAME)
+        try:
+            path = self.recorder.dump(path, reason)
+        except OSError as exc:
+            telemetry.event("flightrecorder_dump_failed", path=path,
+                            error=f"{type(exc).__name__}: {exc}")
+            return None
+        self.flight_recorder_dumped = path
+        telemetry.event("flightrecorder_dumped", path=path,
+                        reason=reason)
+        return path
+
     def stats(self) -> dict:
+        with self._admit_lock:
+            pending = self._pending
+            hwm = self._pending_hwm
         return {
             "served": self.served,
             "failed": self.failed,
             "rejected": self.rejected,
-            "pending": self._pending,
+            "pending": pending,
+            "inflight": pending,
+            "pending_hwm": hwm,
+            "uptime_s": round(self.live.uptime_s(), 3),
+            "qps_60s": round(self.live.qps(), 3),
+            "latency": self.live.overall_latency(),
             "poisoned": self.poisoned,
             "cache": self.cache.stats(),
         }
+
+    def metrics_snapshot(self) -> dict:
+        """The ``metrics`` wire op's JSON body: the live accumulator
+        plus the service/cache counters."""
+        snap = self.live.snapshot()
+        snap["stats"] = self.stats()
+        snap["flight_records"] = len(self.recorder)
+        snap["history_path"] = (self.history.path
+                                if self.history is not None else None)
+        return snap
+
+    def prometheus_metrics(self) -> str:
+        """Prometheus text exposition of the same state (the
+        ``metrics`` op with ``format: "prometheus"``)."""
+        st = self.stats()
+        cache = st["cache"]
+        return self.live.to_prometheus(gauges={
+            "pending": st["pending"],
+            "pending_high_water": st["pending_hwm"],
+            "poisoned": int(bool(st["poisoned"])),
+            "served_requests": st["served"],
+            "failed_requests": st["failed"],
+            "rejected_requests": st["rejected"],
+            "program_cache_entries": cache["entries"],
+            "program_cache_hits": cache["hits"],
+            "program_cache_misses": cache["misses"],
+            "program_cache_traces": cache["traces"],
+            "program_cache_disk_loads": cache["disk_loads"],
+            "program_cache_lru_evictions": cache["lru_evictions"],
+        })
 
 
 # -- the wire protocol -------------------------------------------------
@@ -305,6 +596,13 @@ class _Handler(socketserver.StreamRequestHandler):
             return {"ok": True, "op": "ping"}
         if op == "stats":
             return {"ok": True, **service.stats()}
+        if op == "metrics":
+            if req.get("format") == "prometheus":
+                return {"ok": True, "op": "metrics",
+                        "format": "prometheus",
+                        "prometheus": service.prometheus_metrics()}
+            return {"ok": True, "op": "metrics",
+                    "metrics": service.metrics_snapshot()}
         if op == "shutdown":
             # shutdown() must not run on the handler thread (it joins
             # the serve_forever loop, which is waiting on us).
@@ -315,12 +613,16 @@ class _Handler(socketserver.StreamRequestHandler):
             build, probe = _tables_from_spec(req)
             t0 = time.perf_counter()
             res = service.join(build, probe,
+                               request_id=req.get("request_id"),
                                **_join_opts_from_spec(req))
             matches = int(res.total)
             elapsed = time.perf_counter() - t0
             retry = res.retry_report.as_record()
             return {
                 "ok": True,
+                # minted at admission; one id correlates this
+                # response with the daemon's JSONL/trace views
+                "request_id": getattr(res, "request_id", None),
                 "matches": matches,
                 "overflow": bool(res.overflow),
                 "elapsed_s": elapsed,
@@ -336,12 +638,15 @@ class _Handler(socketserver.StreamRequestHandler):
             t0 = time.perf_counter()
             results = service.join_batched(
                 pairs,
+                request_id=req.get("request_id"),
                 slot_build_rows=req.get("slot_build_rows"),
                 slot_probe_rows=req.get("slot_probe_rows"),
                 **_join_opts_from_spec(req))
             elapsed = time.perf_counter() - t0
             return {
                 "ok": True,
+                "request_id": (results[0]["request_id"]
+                               if results else None),
                 "requests": results,
                 "matches": sum(r["matches"] for r in results),
                 "elapsed_s": elapsed,
@@ -349,8 +654,8 @@ class _Handler(socketserver.StreamRequestHandler):
                                if results else 0),
                 "cache": service.cache.stats(),
             }
-        raise ValueError(f"unknown op {op!r} (ops: ping, stats, join, "
-                         "batch, shutdown)")
+        raise ValueError(f"unknown op {op!r} (ops: ping, stats, "
+                         "metrics, join, batch, shutdown)")
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -394,6 +699,71 @@ class ServiceClient:
         self._sock.close()
 
 
+# -- the operator watch console ----------------------------------------
+
+
+def watch(host: str, port: int, interval_s: float = 2.0,
+          count: int = 0, out=None) -> int:
+    """Poll a RUNNING daemon's ``metrics`` op and render one console
+    line per poll — the operator's ``top`` for the join service. Read
+    only: no mesh, no bootstrap, works from any machine that can reach
+    the port. ``count=0`` polls until interrupted."""
+    out = out or sys.stdout
+    try:
+        client = ServiceClient(host, port, timeout_s=30.0)
+    except OSError as exc:
+        # An operator console answers with one line, not a traceback.
+        print(f"cannot reach daemon at {host}:{port}: {exc}",
+              file=out, flush=True)
+        return 1
+    polls = 0
+
+    def ms(v):
+        return f"{v * 1e3:.1f}ms" if v else "-"
+
+    try:
+        while True:
+            resp = client.send({"op": "metrics"})
+            if not resp.get("ok"):
+                print(f"metrics op failed: {resp}", file=out,
+                      flush=True)
+                return 1
+            m = resp["metrics"]
+            st = m["stats"]
+            lat = st.get("latency") or {}
+            line = (
+                f"up {m['uptime_s']:8.1f}s  "
+                f"qps {m['qps_60s']:6.2f}  "
+                f"served {st['served']:6d}  "
+                f"failed {st['failed']:4d}  "
+                f"rejected {st['rejected']:4d}  "
+                f"inflight {st['inflight']:2d}  "
+                f"p50 {ms(lat.get('p50_s'))}  "
+                f"p95 {ms(lat.get('p95_s'))}  "
+                f"p99 {ms(lat.get('p99_s'))}  "
+                f"cache {st['cache']['hits']}h/"
+                f"{st['cache']['traces']}t"
+            )
+            if st.get("poisoned"):
+                line += f"  POISONED: {st['poisoned']}"
+            print(line, file=out, flush=True)
+            polls += 1
+            if count and polls >= count:
+                return 0
+            time.sleep(interval_s)
+    except KeyboardInterrupt:
+        return 0
+    except (OSError, ValueError) as exc:
+        # daemon restarted or went away mid-poll (ConnectionError,
+        # socket timeout, a torn half-written response line =
+        # JSONDecodeError) — report and exit, don't stack-trace
+        print(f"lost daemon at {host}:{port}: {exc}", file=out,
+              flush=True)
+        return 1
+    finally:
+        client.close()
+
+
 # -- the CLI daemon ----------------------------------------------------
 
 
@@ -428,10 +798,36 @@ def parse_args(argv=None):
                    help="persist compiled executables under DIR (the "
                         "AOT serialization tier): a restarted server "
                         "skips even the first trace")
+    p.add_argument("--history-dir", default=None, metavar="DIR",
+                   help="append one workload-history line per request "
+                        "to DIR/history.jsonl (telemetry/history.py — "
+                        "counter signature, indicators, resolved "
+                        "knobs, wall time; summarize with `analyze "
+                        "history`). Default: --persist-dir when set")
+    p.add_argument("--flight-records", type=int, default=256,
+                   help="flight-recorder ring size: the last-N "
+                        "per-request records dumped as "
+                        "flightrecorder.json on poison or terminal "
+                        "error")
+    p.add_argument("--flight-recorder-path", default=None,
+                   metavar="FILE",
+                   help="where the flight-recorder dump lands "
+                        "(default: the telemetry session dir, else "
+                        "the history dir, else ./flightrecorder.json)")
+    p.add_argument("--watch", action="store_true",
+                   help="do not serve: poll the RUNNING daemon at "
+                        "--host/--port and print one metrics line per "
+                        "poll (the operator console; ctrl-C to stop)")
+    p.add_argument("--watch-interval-s", type=float, default=2.0,
+                   help="seconds between --watch polls")
+    p.add_argument("--watch-count", type=int, default=0,
+                   help="stop --watch after N polls (0 = until "
+                        "interrupted)")
     p.add_argument("--smoke", action="store_true",
                    help="run the CI smoke protocol against an "
                         "in-process daemon instead of serving: warm "
-                        "cache discipline + batched-vs-sequential "
+                        "cache discipline + batched-vs-sequential + "
+                        "metrics scrape + poison drill "
                         "(docs/SERVICE.md), JSON record on stdout")
     p.add_argument("--smoke-small-rows", type=int, default=256,
                    help="rows per small join in the smoke's batched-"
@@ -471,6 +867,9 @@ def _service_from_args(args) -> JoinService:
         max_batch_requests=args.max_batch_requests,
         max_programs=args.max_programs,
         persist_dir=args.persist_dir,
+        history_dir=args.history_dir,
+        flight_records=args.flight_records,
+        flight_recorder_path=args.flight_recorder_path,
     )
     return JoinService(comm, cfg)
 
@@ -493,6 +892,12 @@ def run(args) -> dict:
             server.serve_forever()
         except KeyboardInterrupt:
             pass
+        except BaseException:
+            # Terminal daemon error: leave the postmortem ring next
+            # to the failure record (poison dumps already happened at
+            # hang time; this covers everything else fatal).
+            service.dump_flight_recorder("daemon terminal error")
+            raise
         finally:
             server.server_close()
         record = {"benchmark": "service", **service.stats()}
@@ -505,6 +910,72 @@ def run(args) -> dict:
     return record
 
 
+def _poison_drill(n_ranks: int, args) -> dict:
+    """The smoke's fail-stop rehearsal: on a THROWAWAY service (its
+    own communicator + cache — the real serving mesh is untouched), a
+    fault-delayed request blows its watchdog deadline, the poison flag
+    trips, a follow-up request is refused, and the flight recorder
+    dumps a schema-valid ``flightrecorder.json`` — the postmortem loop
+    of docs/OBSERVABILITY.md, end to end. Runs with
+    ``with_metrics=False`` so the detached worker that eventually
+    finishes the hung join cannot emit device metrics over the main
+    smoke's (baseline-gated) counter block."""
+    from distributed_join_tpu.parallel.communicator import (
+        make_communicator,
+    )
+    from distributed_join_tpu.parallel.faults import (
+        FaultInjectingCommunicator,
+        FaultPlan,
+    )
+    from distributed_join_tpu.parallel.watchdog import HangError
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+    )
+
+    comm = FaultInjectingCommunicator(
+        make_communicator(getattr(args, "communicator", "tpu"),
+                          n_ranks=n_ranks),
+        FaultPlan(dispatch_delay_s=3.0))
+    drill = JoinService(comm, ServiceConfig(
+        auto_retry=0, request_deadline_s=0.75,
+        flight_recorder_path=args.flight_recorder_path))
+    b, p = generate_build_probe_tables(
+        seed=11, build_nrows=512, probe_nrows=1024, rand_max=256,
+        selectivity=0.5)
+    try:
+        drill.join(b, p, with_metrics=False, out_capacity_factor=4.0)
+    except HangError:
+        pass
+    else:
+        raise RuntimeError(
+            "poison drill: the delayed request did not hang")
+    if not drill.poisoned:
+        raise RuntimeError("poison drill: service was not poisoned")
+    try:
+        drill.join(b, p, with_metrics=False, out_capacity_factor=4.0)
+    except AdmissionError:
+        pass
+    else:
+        raise RuntimeError(
+            "poison drill: the poisoned service accepted a join")
+    path = drill.flight_recorder_dumped
+    if not path or not os.path.exists(path):
+        raise RuntimeError("poison drill: no flightrecorder.json "
+                           "was dumped on poison")
+    # Drain the detached watchdog worker before the process moves on:
+    # it is still tracing/running the hung join, and it must not
+    # overlap the interpreter's exit.
+    for t in threading.enumerate():
+        if t.name.startswith("watchdog-request"):
+            t.join(timeout=120.0)
+    return {
+        "poisoned": True,
+        "flightrecorder": path,
+        "flight_records": len(drill.recorder),
+        "rejected_after_poison": drill.rejected,
+    }
+
+
 def run_smoke(service: JoinService, args) -> dict:
     """The acceptance protocol, end to end THROUGH the daemon's TCP
     loop (docs/SERVICE.md "CI smoke"):
@@ -513,7 +984,15 @@ def run_smoke(service: JoinService, args) -> dict:
        traces and report a cache hit;
     2. N small joins, warmed, timed sequentially (N dispatches of one
        cached program) vs micro-batched (ONE dispatch) — the batch
-       must win wall clock and return the same per-request matches.
+       must win wall clock and return the same per-request matches;
+    3. the ``metrics`` op must return non-degenerate latency
+       quantiles over the warm traffic (and a Prometheus rendering),
+       and every join response must echo a unique ``request_id``;
+    4. a poison drill on a throwaway service: an induced hang must
+       poison it, refuse the next request, and dump a schema-valid
+       ``flightrecorder.json``; when a history store is armed
+       (``--history-dir``), the smoke's traffic must land >= 2
+       distinct workload signatures in it.
 
     Raises RuntimeError on any violation (run_guarded turns it into a
     failure record with rc != 0)."""
@@ -541,6 +1020,11 @@ def run_smoke(service: JoinService, args) -> dict:
                 "program(s); the warm path must be run-only")
         if warm["matches"] != cold["matches"]:
             violations.append("warm matches != cold matches")
+        if not cold.get("request_id") or not warm.get("request_id"):
+            violations.append("join responses did not echo a "
+                              "request_id")
+        elif warm["request_id"] == cold["request_id"]:
+            violations.append("request ids are not unique per request")
 
         rows = args.smoke_small_rows
         small = [
@@ -578,11 +1062,52 @@ def run_smoke(service: JoinService, args) -> dict:
             violations.append(
                 f"batched step ({batched_s:.4f}s) did not beat "
                 f"{len(small)} sequential warm calls ({seq_s:.4f}s)")
+
+        # Live metrics scrape: after the warm traffic the latency
+        # histogram must yield non-degenerate, ordered quantiles, and
+        # the Prometheus rendering must carry the request counters.
+        met = send_ok({"op": "metrics"}, "metrics scrape")
+        join_lat = met["metrics"]["ops"]["join"]["latency"]
+        p50, p95, p99 = (join_lat.get("p50_s"), join_lat.get("p95_s"),
+                         join_lat.get("p99_s"))
+        if not p50 or not p95 or not p99 or not (p50 <= p95 <= p99):
+            violations.append(
+                "degenerate latency quantiles after warm traffic: "
+                f"p50={p50} p95={p95} p99={p99}")
+        prom = send_ok({"op": "metrics", "format": "prometheus"},
+                       "prometheus scrape")
+        if "djtpu_requests_total" not in prom.get("prometheus", ""):
+            violations.append("prometheus exposition is missing "
+                              "djtpu_requests_total")
+
         stats = client.send({"op": "stats"})
+        if stats.get("uptime_s") is None or \
+                stats.get("pending_hwm", 0) < 1:
+            violations.append(
+                "stats is missing uptime/admission high-water mark")
         client.send({"op": "shutdown"})
     finally:
         client.close()
         server.server_close()
+
+    # The history store (when armed) must have seen the smoke's
+    # distinct workloads — the substrate `analyze history` summarizes.
+    history_info = None
+    if service.history is not None:
+        entries, _ = tel_history.load_history(service.history.path)
+        hsum = tel_history.summarize(entries)
+        history_info = {
+            "path": service.history.path,
+            "n_entries": hsum["n_entries"],
+            "n_signatures": hsum["n_signatures"],
+        }
+        if hsum["n_signatures"] < 2:
+            violations.append(
+                f"history store holds {hsum['n_signatures']} "
+                "signature(s); the smoke's traffic spans >= 2")
+
+    drill = _poison_drill(service.comm.n_ranks, args)
+
     record = {
         "benchmark": "service_smoke",
         "n_ranks": service.comm.n_ranks,
@@ -595,7 +1120,13 @@ def run_smoke(service: JoinService, args) -> dict:
         "batched_speedup": seq_s / batched_s if batched_s else None,
         "batch_matches": batch_matches,
         "served": stats["served"],
+        "uptime_s": stats.get("uptime_s"),
+        "latency": stats.get("latency"),
+        "qps_60s": stats.get("qps_60s"),
+        "pending_hwm": stats.get("pending_hwm"),
         "cache": stats["cache"],
+        "history": history_info,
+        "poison_drill": drill,
         "violations": violations,
         # the warmup responses keep the smoke honest in the record
         "warmup_sequential_matches": [r["matches"] for r in seq_warm],
@@ -616,6 +1147,16 @@ def main(argv=None):
     )
 
     args = parse_args(argv)
+    if args.watch:
+        # Read-only console against an already-running daemon: no
+        # mesh, no bootstrap, no run_guarded record.
+        if not args.port:
+            print("--watch needs the --port of a running daemon",
+                  file=sys.stderr)
+            return 2
+        return watch(args.host, args.port,
+                     interval_s=args.watch_interval_s,
+                     count=args.watch_count)
     # --guard-deadline-s bounds each REQUEST, not the daemon: resolve
     # it now, then zero the flag so run_guarded leaves the (healthy,
     # long-lived) server unguarded. An explicit 0 also stops

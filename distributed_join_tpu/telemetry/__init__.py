@@ -53,8 +53,9 @@ from distributed_join_tpu.telemetry import spans as _spans
 __all__ = [
     "Metrics", "MetricsTape", "TelemetrySink",
     "configure", "configure_from_args", "counter_add", "emit_metrics",
-    "enabled", "event", "finalize", "maybe_start_xla_trace", "session",
-    "sink", "span", "span_complete", "summary",
+    "enabled", "event", "finalize", "maybe_start_xla_trace",
+    "request_scope", "session", "sink", "span", "span_complete",
+    "summary",
 ]
 
 _active: Optional[TelemetrySink] = None
@@ -96,13 +97,16 @@ def configure(out_dir: str, *, trace: bool = False,
 
 def configure_from_args(args) -> bool:
     """Driver seam: activate from ``--telemetry[=DIR]`` / ``--trace``
-    / ``--diagnose`` flags (see ``benchmarks.add_telemetry_args``).
-    ``--trace`` or ``--diagnose`` alone imply telemetry at the default
-    directory (both need a session's files to exist). Returns whether
-    a session was configured."""
+    / ``--diagnose`` / ``--history`` flags (see
+    ``benchmarks.add_telemetry_args``). ``--trace``, ``--diagnose``
+    or ``--history`` alone imply telemetry at the default directory
+    (all need a session — diagnosis reads its files, a history entry
+    wants the counter signature). Returns whether a session was
+    configured."""
     out_dir = getattr(args, "telemetry", None)
     trace = bool(getattr(args, "trace", False))
-    if out_dir is None and (trace or getattr(args, "diagnose", False)):
+    if out_dir is None and (trace or getattr(args, "diagnose", False)
+                            or getattr(args, "history", None)):
         out_dir = "telemetry"
     if out_dir is None:
         return False
@@ -174,6 +178,29 @@ def span_complete(name: str, t0_perf: float, dur_s: float, **payload) -> None:
     ``time.perf_counter()`` stamp."""
     if _active is not None:
         _active.span_event(name, t0_perf, dur_s, payload=payload or None)
+
+
+@contextlib.contextmanager
+def request_scope(request_id: Optional[str]):
+    """Tag every event/span recorded inside the scope with a serving
+    request id (the correlation key of docs/OBSERVABILITY.md "Live
+    service metrics"): the tag lands in the per-rank JSONL records,
+    the Chrome-trace args, and — because the sink tag is sink-global,
+    not thread-local — in events a request's watchdog/staging worker
+    threads emit too. No-op when telemetry is off or ``request_id`` is
+    None; nests (the previous tag is restored on exit)."""
+    s = _active
+    if s is None or request_id is None:
+        yield
+        return
+    prev = s.set_request_id(request_id)
+    try:
+        yield
+    finally:
+        # the session may have been finalized mid-request; restoring
+        # on the captured sink is still safe (a closed sink just holds
+        # the tag, it records nothing)
+        s.set_request_id(prev)
 
 
 def event(name: str, **payload) -> None:

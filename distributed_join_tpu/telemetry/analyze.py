@@ -23,11 +23,12 @@ the loop:
   telemetry files and renders the human report (every driver's
   ``--diagnose`` flag lands here via ``benchmarks.run_guarded``);
 - the CLI (``python -m distributed_join_tpu.telemetry.analyze``)
-  exposes ``diagnose`` / ``report`` / ``compare`` / ``check``, where
-  ``compare`` is the perf gate: non-zero exit on counter-signature
-  drift or banded wall-time regression against a committed baseline
-  (:mod:`.baselines`; the ``perfgate`` lane of
-  ``scripts/run_tier1.sh``).
+  exposes ``diagnose`` / ``report`` / ``compare`` / ``history`` /
+  ``check``, where ``compare`` is the perf gate: non-zero exit on
+  counter-signature drift or banded wall-time regression against a
+  committed baseline (:mod:`.baselines`; the ``perfgate`` lane of
+  ``scripts/run_tier1.sh``), and ``history`` summarizes a
+  workload-history store (:mod:`.history`) per signature.
 
 Deliberately device-free: analysis runs on the artifacts, never the
 accelerators, so it works on a laptop against files scp'd from a pod.
@@ -639,6 +640,21 @@ _SUMMARY_REQUIRED = ("telemetry_format_version", "rank", "counters",
 _DIAGNOSIS_REQUIRED = ("schema_version", "status", "indicators",
                        "recommendations", "signature")
 _BASELINE_REQUIRED = ("name", "signature")
+_FLIGHTRECORDER_REQUIRED = ("schema_version", "kind", "reason",
+                            "capacity", "recorded_total", "records")
+
+
+def _sniff_history_lines(path: str) -> bool:
+    """Whether a non-``.jsonl``-named file is a workload-history store
+    (one JSON object per line, each stamped ``kind: request|run``)."""
+    try:
+        with open(path) as f:
+            first = f.readline()
+        doc = json.loads(first)
+    except (OSError, ValueError):
+        return False
+    return isinstance(doc, dict) and doc.get("kind") in ("request",
+                                                         "run")
 
 
 def check_file(path: str) -> list:
@@ -646,8 +662,14 @@ def check_file(path: str) -> list:
     problems (empty = valid). Hand-rolled on purpose: no jsonschema
     dependency in this container."""
     problems = []
+    history_file = os.path.basename(path) == "history.jsonl"
     try:
-        if path.endswith(".jsonl"):
+        if not path.endswith(".jsonl") and _sniff_history_lines(path):
+            # --history FILE accepts any filename; a line-JSON store
+            # whose first entry carries a history kind stamp is
+            # validated as JSONL, not as one document.
+            history_file = True
+        if history_file or path.endswith(".jsonl"):
             torn = []   # (line_no, error) of unparseable lines
             with open(path) as f:
                 lines = [(i, ln) for i, ln in enumerate(f, 1)
@@ -658,9 +680,21 @@ def check_file(path: str) -> list:
                 except ValueError as exc:
                     torn.append((i, exc))
                     continue
-                if ev.get("kind") not in ("event", "span"):
-                    problems.append(f"line {i}: bad kind "
-                                    f"{ev.get('kind')!r}")
+                kind = ev.get("kind")
+                if history_file or kind in ("request", "run"):
+                    # Workload-history lines (telemetry/history.py):
+                    # recognized by basename OR by their own kind
+                    # stamp (the --history flag accepts any filename).
+                    # Each carries the fields the autotuner's
+                    # summarizer keys on.
+                    for key in ("schema_version", "signature",
+                                "outcome", "op"):
+                        if key not in ev:
+                            problems.append(
+                                f"line {i}: history entry missing "
+                                f"{key!r}")
+                elif kind not in ("event", "span"):
+                    problems.append(f"line {i}: bad kind {kind!r}")
             # A torn FINAL line is the advertised killed-run artifact
             # (export.py streams and a kill can land mid-write) —
             # tolerated, exactly as load_run tolerates it. Torn lines
@@ -691,6 +725,22 @@ def check_file(path: str) -> list:
         required = _SUMMARY_REQUIRED
     elif name == "diagnosis.json":
         required = _DIAGNOSIS_REQUIRED
+    elif name == "flightrecorder.json" or \
+            doc.get("kind") == "flightrecorder":
+        # The daemon's postmortem ring (telemetry/live.py).
+        for key in _FLIGHTRECORDER_REQUIRED:
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        if not isinstance(doc.get("records"), list):
+            problems.append("records is not a list")
+        else:
+            for i, rec in enumerate(doc["records"]):
+                if not isinstance(rec, dict) or \
+                        not {"request_id", "op", "outcome"} <= set(rec):
+                    problems.append(
+                        f"records[{i}] missing required "
+                        "request_id/op/outcome keys")
+        return problems
     elif "signature" in doc:
         required = _BASELINE_REQUIRED
     else:
@@ -783,6 +833,17 @@ def main(argv=None) -> int:
     c.add_argument("--note", default=None,
                    help="with --write: free-text provenance note")
 
+    hs = sub.add_parser(
+        "history",
+        help="summarize a workload-history store (per-signature "
+             "trends: runs, outcomes, wall times, escalations, "
+             "resolved knobs) — ROADMAP item 5's autotuner input")
+    hs.add_argument("path",
+                    help="history.jsonl, or a directory containing it")
+    hs.add_argument("--json", action="store_true",
+                    help="print the summary JSON instead of the "
+                         "human report")
+
     k = sub.add_parser("check",
                        help="shape-validate telemetry artifacts "
                             "(summary/diagnosis/baseline/trace/"
@@ -814,6 +875,21 @@ def main(argv=None) -> int:
                                     noise_band=args.noise_band)
             print(cmp.format())
             return 0 if cmp.ok else 2
+        if args.cmd == "history":
+            # Lazy import: history imports this module's gini/
+            # imbalance helpers lazily in the other direction.
+            from distributed_join_tpu.telemetry import history
+
+            entries, malformed = history.load_history(args.path)
+            summary = history.summarize(entries)
+            if malformed:
+                summary["malformed_lines"] = malformed
+            if args.json:
+                print(json.dumps(summary, indent=1))
+            else:
+                print(history.format_summary(
+                    summary, path=history.history_path(args.path)))
+            return 0
         if args.cmd == "check":
             bad = 0
             for path in args.files:

@@ -68,6 +68,7 @@ class TelemetrySink:
         self._origin = time.perf_counter()
         self._epoch = time.time()
         self._lock = threading.Lock()
+        self._request_id: Optional[str] = None
         self._counters: dict = {}
         self._span_stats: dict = {}
         self._metrics: Optional[dict] = None
@@ -95,6 +96,18 @@ class TelemetrySink:
 
     # -- recording ----------------------------------------------------
 
+    def set_request_id(self, request_id: Optional[str]) -> Optional[str]:
+        """Install the serving-layer request correlation tag; every
+        event/span recorded while set carries it (JSONL field + trace
+        args). Sink-global, not thread-local, on purpose: a request's
+        work fans out to watchdog/staging worker threads, and the
+        service serializes requests on one exec lock anyway. Returns
+        the previous tag (``telemetry.request_scope`` restores it)."""
+        with self._lock:
+            prev = self._request_id
+            self._request_id = request_id
+        return prev
+
     def _write_line(self, rec: dict) -> None:
         self._log.write(json.dumps(rec, default=_json_default) + "\n")
 
@@ -109,14 +122,24 @@ class TelemetrySink:
             if self._closed:
                 return
             self._n_events += 1
-            self._write_line({"kind": "event", "name": name,
-                              "ts_us": self._us(), "rank": self.rank,
-                              "payload": payload})
+            rec = {"kind": "event", "name": name,
+                   "ts_us": self._us(), "rank": self.rank,
+                   "payload": payload}
+            args = dict(payload or {})
+            # A payload-carried id wins over the sink-global tag: an
+            # admission/rejection event fires OUTSIDE the exec lock,
+            # concurrently with another request's scope, and must not
+            # be stamped with that request's id.
+            rid = args.get("request_id", self._request_id)
+            if rid is not None:
+                rec["request_id"] = rid
+                args.setdefault("request_id", rid)
+            self._write_line(rec)
             self._push_trace({
                 "name": name, "cat": "event", "ph": "i", "s": "t",
                 "ts": self._us(), "pid": self.rank,
                 "tid": threading.get_ident() % 2**31,
-                "args": payload or {},
+                "args": args,
             })
 
     def span_event(self, name: str, t0_perf: float, dur_s: float,
@@ -129,17 +152,23 @@ class TelemetrySink:
             if self._closed:
                 return
             self._n_events += 1
-            self._write_line({"kind": "span", "name": name,
-                              "path": path or name,
-                              "ts_us": self._us(t0_perf),
-                              "dur_us": dur_s * 1e6, "rank": self.rank,
-                              "payload": payload})
+            rec = {"kind": "span", "name": name,
+                   "path": path or name,
+                   "ts_us": self._us(t0_perf),
+                   "dur_us": dur_s * 1e6, "rank": self.rank,
+                   "payload": payload}
+            args = dict(payload or {}, path=path or name)
+            rid = args.get("request_id", self._request_id)
+            if rid is not None:
+                rec["request_id"] = rid
+                args.setdefault("request_id", rid)
+            self._write_line(rec)
             self._push_trace({
                 "name": name, "cat": "span", "ph": "X",
                 "ts": self._us(t0_perf), "dur": dur_s * 1e6,
                 "pid": self.rank,
                 "tid": threading.get_ident() % 2**31,
-                "args": dict(payload or {}, path=path or name),
+                "args": args,
             })
             st = self._span_stats.setdefault(
                 path or name, {"count": 0, "total_s": 0.0})
@@ -150,7 +179,17 @@ class TelemetrySink:
         with self._lock:
             if self._closed:
                 return
-            self._counters[name] = self._counters.get(name, 0) + value
+            total = self._counters.get(name, 0) + value
+            self._counters[name] = total
+            # Counter TRACK event ("ph": "C"): the running total lands
+            # as a per-(rank, counter) series in the Chrome trace, so
+            # Perfetto plots rows/bytes/seconds over time instead of
+            # the counters existing only as a final summary number.
+            self._push_trace({
+                "name": name, "cat": "counter", "ph": "C",
+                "ts": self._us(), "pid": self.rank,
+                "args": {"value": total},
+            })
 
     def set_metrics(self, metrics_dict: dict) -> None:
         """Install the host-fetched device-metrics summary (already

@@ -1,0 +1,350 @@
+"""Workload-history store — the persisted per-workload diagnosis trail.
+
+ROADMAP item 5's autotuner needs one input nothing used to write: for
+each workload signature, what actually happened every time it ran —
+the counter signature, the quick health indicators, the knobs the
+retry ladder finally resolved to, and the wall time. This module is
+that substrate:
+
+- :class:`WorkloadHistory` — an append-only ``history.jsonl`` (one
+  JSON object per line, flushed per append, torn-tail tolerant like
+  the event logs) living under the program cache's ``persist_dir`` by
+  default, so the workload memory restarts with the server;
+- :func:`request_entry` — one serving request's record (the
+  :class:`~..service.server.JoinService` write path): request id, op,
+  signature hash, outcome, wall seconds, cache/trace accounting, the
+  ladder's resolved sizing, the counter signature and quick
+  indicators when device metrics rode the program;
+- :func:`run_entry` — the offline analog for the benchmark drivers'
+  ``--history FILE`` flag (appended at end of run next to
+  ``--diagnose``), so hardware sessions feed the same store;
+- :func:`load_history` / :func:`summarize` / :func:`format_summary` —
+  the read side behind ``python -m distributed_join_tpu.telemetry.
+  analyze history``: per-signature trends (runs, outcomes, wall-time
+  quantiles, escalations, latest resolved knobs).
+
+Deliberately device-free, like :mod:`.analyze`: the store is files,
+and the summarizer runs anywhere the files do.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Optional
+
+HISTORY_SCHEMA_VERSION = 1
+HISTORY_FILENAME = "history.jsonl"
+
+# The resolved-knob fields worth persisting from a retry ladder's
+# final rung (the values the autotuner would pre-size from).
+_KNOB_FIELDS = (
+    "shuffle_capacity_factor", "out_capacity_factor",
+    "out_rows_per_rank", "compression_bits",
+    "hh_build_capacity", "hh_probe_capacity", "hh_out_capacity",
+)
+
+# Driver-record keys that identify a WORKLOAD (not a measurement) —
+# the basis of run_entry's signature hash. Public: maybe_history
+# back-fills these from driver args when a failure record carries
+# only its benchmark name.
+WORKLOAD_KEYS = (
+    "benchmark", "n_ranks", "build_table_nrows", "probe_table_nrows",
+    "selectivity", "shuffle", "key_type", "payload_type",
+    "key_columns", "over_decomposition_factor", "zipf_alpha",
+    "skew_threshold", "string_payload_bytes", "string_key_bytes",
+    "scale_factor", "nbytes",
+)
+
+
+def history_path(dir_or_file: str) -> str:
+    """Resolve a history location: an EXISTING directory maps to its
+    ``history.jsonl`` inside; anything else is taken verbatim as a
+    file path (the ``--history FILE`` contract — a user-named file
+    must never silently become a directory)."""
+    if os.path.isdir(dir_or_file):
+        return os.path.join(dir_or_file, HISTORY_FILENAME)
+    return dir_or_file
+
+
+class WorkloadHistory:
+    """Append-only JSONL store. Thread-safe appends over one
+    persistent line-buffered handle (the TelemetrySink log pattern:
+    flushed per line, so a killed server keeps its history; no
+    per-request open/close on the serving hot path)."""
+
+    def __init__(self, path: str):
+        self.path = history_path(path)
+        self._lock = threading.Lock()
+        self._f = None
+
+    def _handle(self):
+        if self._f is None or self._f.closed:
+            parent = os.path.dirname(self.path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            self._f = open(self.path, "a", buffering=1)
+        return self._f
+
+    def append(self, entry: dict) -> dict:
+        entry = dict(entry)
+        entry.setdefault("schema_version", HISTORY_SCHEMA_VERSION)
+        line = json.dumps(entry, default=str)
+        with self._lock:
+            self._handle().write(line + "\n")
+        return entry
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None and not self._f.closed:
+                self._f.close()
+
+
+# -- entry builders ---------------------------------------------------
+
+
+def _resolved_knobs(retry_record: Optional[dict]) -> Optional[dict]:
+    """The final rung's sizing from a ``RetryReport.as_record()`` dict
+    (None = single clean attempt, no sizing drift to persist)."""
+    if not retry_record or not retry_record.get("attempts"):
+        return None
+    final = retry_record["attempts"][-1]
+    return {k: final[k] for k in _KNOB_FIELDS
+            if final.get(k) is not None}
+
+
+def retry_counts(retry_record: Optional[dict]) -> dict:
+    attempts = (retry_record or {}).get("attempts") or []
+    return {
+        "n_attempts": max(len(attempts), 1),
+        "escalations": sum(1 for a in attempts if a.get("overflow")),
+        "integrity_retries": sum(
+            1 for a in attempts
+            if a.get("action") == "retry_integrity"),
+    }
+
+
+def quick_indicators(metrics: Optional[dict]) -> Optional[dict]:
+    """Per-request health indicators from one device-metrics block
+    (``Metrics.to_dict()``): the skew/headroom signals
+    ``analyze.compute_indicators`` derives for a full run, reduced to
+    what one request can tell. None when no metrics rode the program
+    (telemetry off)."""
+    if not metrics or not isinstance(metrics.get("per_rank"), dict):
+        return None
+    from distributed_join_tpu.telemetry.analyze import gini, imbalance
+
+    per_rank = metrics["per_rank"]
+    reduced = metrics.get("reduced", {})
+    out: dict = {}
+    for name in ("matches", "build.rows_received",
+                 "probe.rows_received"):
+        vals = per_rank.get(name)
+        if not vals:
+            continue
+        g, imb = gini(vals), imbalance(vals)
+        if g is None:
+            continue
+        out[name] = {"gini": round(g, 4),
+                     "max_over_mean": round(imb, 4)}
+    for side in ("build", "probe"):
+        margin = reduced.get(f"{side}.overflow_margin_min")
+        if margin is not None:
+            out[f"{side}.overflow_margin_min"] = int(margin)
+    return out or None
+
+
+def request_entry(*, request_id: str, op: str, signature: str,
+                  outcome: str, wall_s: float, new_traces: int = 0,
+                  cache_hits: int = 0, matches: Optional[int] = None,
+                  retry_record: Optional[dict] = None,
+                  metrics: Optional[dict] = None,
+                  error: Optional[str] = None) -> dict:
+    """One serving request's history line (the JoinService write
+    path). ``metrics`` is the request's ``Metrics.to_dict()`` block
+    when telemetry rode the program, else None."""
+    from distributed_join_tpu.telemetry import baselines
+
+    return {
+        "schema_version": HISTORY_SCHEMA_VERSION,
+        "kind": "request",
+        "request_id": request_id,
+        "op": op,
+        "signature": signature,
+        "outcome": outcome,
+        "wall_s": round(float(wall_s), 6),
+        "new_traces": int(new_traces),
+        "cache_hits": int(cache_hits),
+        "matches": matches,
+        "retry": retry_counts(retry_record),
+        "resolved_knobs": _resolved_knobs(retry_record),
+        "counter_signature": baselines.counter_signature(metrics),
+        "indicators": quick_indicators(metrics),
+        "error": error,
+    }
+
+
+def run_entry(record: Optional[dict] = None,
+              summary: Optional[dict] = None) -> dict:
+    """One benchmark run's history line (the ``--history`` driver
+    flag): the workload identity is hashed from the record's
+    workload-shaped keys, the knobs/wall/counters from wherever the
+    record carries them."""
+    from distributed_join_tpu.telemetry import baselines
+
+    record = record or {}
+    workload = {k: record.get(k) for k in WORKLOAD_KEYS
+                if record.get(k) is not None}
+    digest = hashlib.sha256(
+        json.dumps(workload, sort_keys=True, default=str).encode()
+    ).hexdigest()[:16]
+    metrics = None
+    if summary and isinstance(summary.get("metrics"), dict):
+        metrics = summary["metrics"]
+    # THE one extraction of a record's comparable wall number
+    # (bench.py's "value" is a rate, not a time — never recorded).
+    wall = baselines.wall_time_of(record)
+    return {
+        "schema_version": HISTORY_SCHEMA_VERSION,
+        "kind": "run",
+        "request_id": None,
+        "op": record.get("benchmark") or "run",
+        "signature": digest,
+        "workload": workload,
+        "outcome": "failed" if record.get("error") else "ok",
+        "wall_s": round(float(wall), 6) if wall else None,
+        "new_traces": 0,
+        "cache_hits": 0,
+        "matches": record.get("matches_per_join"),
+        "retry": retry_counts(record.get("retry")),
+        "resolved_knobs": _resolved_knobs(record.get("retry")),
+        "counter_signature": baselines.counter_signature(
+            metrics if metrics is not None else record),
+        "indicators": quick_indicators(metrics),
+        "error": record.get("error"),
+    }
+
+
+# -- the read side ----------------------------------------------------
+
+
+def load_history(path: str):
+    """Read a history store; returns ``(entries, malformed_lines)``.
+    A torn final line (killed mid-append) is tolerated exactly as
+    ``analyze.load_run`` tolerates torn event logs."""
+    path = history_path(path)
+    entries, malformed = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entries.append(json.loads(line))
+            except ValueError:
+                malformed += 1
+    return entries, malformed
+
+
+def _wall_stats(walls) -> Optional[dict]:
+    vals = sorted(w for w in walls if w is not None)
+    if not vals:
+        return None
+    n = len(vals)
+    return {
+        "n": n,
+        "min_s": round(vals[0], 6),
+        "p50_s": round(vals[n // 2], 6),
+        "max_s": round(vals[-1], 6),
+        "mean_s": round(sum(vals) / n, 6),
+        "last_s": round([w for w in walls if w is not None][-1], 6),
+    }
+
+
+def summarize(entries) -> dict:
+    """Per-signature trends over a history store — the view the
+    autotuner (ROADMAP item 5) will pre-size from."""
+    sigs: dict = {}
+    for e in entries:
+        digest = e.get("signature") or "?"
+        s = sigs.setdefault(digest, {
+            "entries": 0, "outcomes": {}, "ops": {}, "walls": [],
+            "escalations": 0, "integrity_retries": 0, "new_traces": 0,
+            "resolved_knobs_last": None, "counter_drift": False,
+            "_counters_seen": None,
+        })
+        s["entries"] += 1
+        outcome = e.get("outcome") or "?"
+        s["outcomes"][outcome] = s["outcomes"].get(outcome, 0) + 1
+        op = e.get("op") or "?"
+        s["ops"][op] = s["ops"].get(op, 0) + 1
+        s["walls"].append(e.get("wall_s"))
+        retry = e.get("retry") or {}
+        s["escalations"] += int(retry.get("escalations") or 0)
+        s["integrity_retries"] += int(
+            retry.get("integrity_retries") or 0)
+        s["new_traces"] += int(e.get("new_traces") or 0)
+        if e.get("resolved_knobs"):
+            s["resolved_knobs_last"] = e["resolved_knobs"]
+        csig = e.get("counter_signature")
+        if isinstance(csig, dict) and csig.get("counters"):
+            if s["_counters_seen"] is None:
+                s["_counters_seen"] = csig["counters"]
+            elif s["_counters_seen"] != csig["counters"]:
+                # Same workload signature, different device counters:
+                # the data (or a seam) moved — the drift the autotuner
+                # must re-observe before trusting old sizing.
+                s["counter_drift"] = True
+    out = {}
+    for digest, s in sigs.items():
+        out[digest] = {
+            "entries": s["entries"],
+            "outcomes": s["outcomes"],
+            "ops": s["ops"],
+            "wall": _wall_stats(s["walls"]),
+            "escalations": s["escalations"],
+            "integrity_retries": s["integrity_retries"],
+            "new_traces": s["new_traces"],
+            "resolved_knobs_last": s["resolved_knobs_last"],
+            "counter_drift": s["counter_drift"],
+        }
+    return {
+        "schema_version": HISTORY_SCHEMA_VERSION,
+        "n_entries": len(entries),
+        "n_signatures": len(out),
+        "signatures": out,
+    }
+
+
+def format_summary(summary: dict, path: str = "") -> str:
+    lines = [
+        f"history: {summary['n_entries']} entr"
+        f"{'y' if summary['n_entries'] == 1 else 'ies'}, "
+        f"{summary['n_signatures']} signature(s)"
+        + (f"  [{path}]" if path else ""),
+    ]
+    for digest, s in sorted(summary["signatures"].items(),
+                            key=lambda kv: -kv[1]["entries"]):
+        outcomes = ", ".join(f"{k}={v}" for k, v in
+                             sorted(s["outcomes"].items()))
+        lines.append(f"  {digest}: {s['entries']} run(s)  {outcomes}")
+        wall = s.get("wall")
+        if wall:
+            lines.append(
+                f"    wall p50={wall['p50_s']}s "
+                f"mean={wall['mean_s']}s last={wall['last_s']}s")
+        if s["escalations"] or s["integrity_retries"]:
+            lines.append(
+                f"    ladder: {s['escalations']} escalation(s), "
+                f"{s['integrity_retries']} integrity retr"
+                f"{'y' if s['integrity_retries'] == 1 else 'ies'}")
+        if s.get("resolved_knobs_last"):
+            knobs = " ".join(f"{k}={v}" for k, v in
+                             sorted(s["resolved_knobs_last"].items()))
+            lines.append(f"    resolved: {knobs}")
+        if s.get("counter_drift"):
+            lines.append("    counter signature DRIFTED across runs "
+                         "(data moved; re-observe before pre-sizing)")
+    return "\n".join(lines)

@@ -1,0 +1,414 @@
+"""Live serving metrics — the streaming read side of a resident server.
+
+The PR 2-3 telemetry is RUN-shaped: a session opens, a run happens, the
+session finalizes and the artifacts get diagnosed. A resident daemon
+(docs/SERVICE.md) never finalizes — its operators need the live view:
+latency distributions over the traffic served so far, per-op and
+per-workload counters, rolling QPS, and a postmortem buffer for the
+request that killed the mesh. This module is that view, deliberately
+dependency-free and device-free (plain Python over host timestamps):
+
+- :class:`LatencyHistogram` — fixed log-spaced buckets, so snapshots
+  taken on different processes (or at different times) MERGE by adding
+  counts, and p50/p95/p99 derive from any snapshot;
+- :class:`LiveMetrics` — the lock-protected accumulator behind the
+  daemon's ``metrics`` wire op and ``stats`` quantiles: per-op outcome
+  counters + latency histograms, per-:class:`~..service.programs.
+  JoinSignature` counters (served/failed, cache hits, ``new_traces``,
+  retry rungs, integrity retries), rolling QPS and uptime. Exposed as
+  a JSON snapshot and as Prometheus text exposition;
+- :class:`FlightRecorder` — a bounded ring of the last-N per-request
+  records (request id, signature hash, timings, rung path, outcome);
+  on poison or terminal error the daemon dumps it as
+  ``flightrecorder.json`` (``telemetry.analyze check`` validates the
+  schema), the postmortem the drivers' hard-exit records cannot give a
+  long-lived server.
+
+Everything here is HOST bookkeeping around requests that already ran —
+none of it touches the compiled program, so the telemetry-off hot path
+stays the exact seed program (the PR 2 contract).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Optional
+
+FLIGHT_RECORDER_SCHEMA_VERSION = 1
+FLIGHT_RECORDER_FILENAME = "flightrecorder.json"
+
+# Log-spaced latency bucket upper bounds: 100 us .. 100 s, four buckets
+# per decade. FIXED (not configurable) so every snapshot ever taken is
+# mergeable with every other by adding counts position-wise.
+LATENCY_BUCKETS_S = tuple(
+    round(1e-4 * 10 ** (i / 4), 10) for i in range(25)
+)
+
+
+class LatencyHistogram:
+    """Fixed-bucket log-spaced histogram with mergeable snapshots.
+
+    ``counts[i]`` is the number of observations with value <=
+    ``LATENCY_BUCKETS_S[i]`` (and > the previous bound); the final slot
+    is the overflow bucket. Not thread-safe by itself —
+    :class:`LiveMetrics` holds the lock.
+    """
+
+    __slots__ = ("counts", "count", "sum_s")
+
+    def __init__(self):
+        self.counts = [0] * (len(LATENCY_BUCKETS_S) + 1)
+        self.count = 0
+        self.sum_s = 0.0
+
+    def observe(self, seconds: float) -> None:
+        i = bisect.bisect_left(LATENCY_BUCKETS_S, float(seconds))
+        self.counts[i] += 1
+        self.count += 1
+        self.sum_s += float(seconds)
+
+    def merge(self, snapshot: dict) -> None:
+        """Fold another histogram's :meth:`snapshot` into this one —
+        bucket bounds are module constants, so addition is exact."""
+        other = snapshot["counts"]
+        if len(other) != len(self.counts):
+            raise ValueError(
+                f"histogram shape mismatch: {len(other)} buckets vs "
+                f"{len(self.counts)} (snapshots merge only across the "
+                "same LATENCY_BUCKETS_S)")
+        for i, c in enumerate(other):
+            self.counts[i] += int(c)
+        self.count += int(snapshot["count"])
+        self.sum_s += float(snapshot["sum_s"])
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Approximate quantile by cumulative walk + linear
+        interpolation inside the landing bucket. None when empty."""
+        if self.count == 0:
+            return None
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                lo = LATENCY_BUCKETS_S[i - 1] if i > 0 else 0.0
+                hi = (LATENCY_BUCKETS_S[i]
+                      if i < len(LATENCY_BUCKETS_S)
+                      else LATENCY_BUCKETS_S[-1])
+                frac = (target - cum) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            cum += c
+        return LATENCY_BUCKETS_S[-1]  # pragma: no cover - defensive
+
+    def snapshot(self) -> dict:
+        return {
+            "le_s": list(LATENCY_BUCKETS_S),
+            "counts": list(self.counts),
+            "count": self.count,
+            "sum_s": round(self.sum_s, 6),
+        }
+
+    def summary(self) -> dict:
+        """The quantile block ``stats``/``metrics`` embed."""
+        out = {"count": self.count, "sum_s": round(self.sum_s, 6)}
+        if self.count:
+            out["mean_s"] = round(self.sum_s / self.count, 6)
+        if self.counts[-1]:
+            # Quantiles saturate at the top bucket bound (100 s) —
+            # say so instead of silently understating a slow tail.
+            out["overflow"] = self.counts[-1]
+        for name, q in (("p50_s", 0.50), ("p95_s", 0.95),
+                        ("p99_s", 0.99)):
+            v = self.quantile(q)
+            out[name] = round(v, 6) if v is not None else None
+        return out
+
+
+class LiveMetrics:
+    """Lock-protected streaming serving stats (one per
+    :class:`~..service.server.JoinService`).
+
+    ``record_request`` is the single write path — the service calls it
+    once per request with the outcome ("served", "failed", "hang",
+    "rejected") and the per-request accounting it captured under its
+    exec lock. Reads (:meth:`snapshot`, :meth:`to_prometheus`,
+    :meth:`overall_latency`) take the same lock, so a scrape never sees
+    a torn update.
+    """
+
+    QPS_WINDOW_S = 60
+    MAX_SIGNATURES = 256
+
+    def __init__(self, now=time.monotonic):
+        self._now = now
+        self._lock = threading.Lock()
+        self._t0 = now()
+        self._epoch0 = time.time()
+        self._ops: dict = {}
+        self._signatures: OrderedDict = OrderedDict()
+        self._signatures_dropped = 0
+        self._arrivals = deque()        # (second, count) ring
+
+    # -- write path ---------------------------------------------------
+
+    def _op_slot(self, op: str) -> dict:
+        slot = self._ops.get(op)
+        if slot is None:
+            slot = self._ops[op] = {
+                "outcomes": {},
+                "cache_hits": 0,
+                "new_traces": 0,
+                "retry_rungs": 0,
+                "integrity_retries": 0,
+                "latency": LatencyHistogram(),
+            }
+        return slot
+
+    def _sig_slot(self, digest: str) -> Optional[dict]:
+        slot = self._signatures.get(digest)
+        if slot is not None:
+            self._signatures.move_to_end(digest)
+            return slot
+        if len(self._signatures) >= self.MAX_SIGNATURES:
+            # Bounded like the program cache: drop the least recently
+            # SERVED workload, count the drop (no silent caps).
+            self._signatures.popitem(last=False)
+            self._signatures_dropped += 1
+        slot = self._signatures[digest] = {
+            "requests": 0,
+            "outcomes": {},
+            "cache_hits": 0,
+            "new_traces": 0,
+            "retry_rungs": 0,
+            "integrity_retries": 0,
+            "latency": LatencyHistogram(),
+        }
+        return slot
+
+    def _tick(self) -> None:
+        sec = int(self._now())
+        if self._arrivals and self._arrivals[-1][0] == sec:
+            self._arrivals[-1][1] += 1
+        else:
+            self._arrivals.append([sec, 1])
+        horizon = sec - self.QPS_WINDOW_S
+        while self._arrivals and self._arrivals[0][0] <= horizon:
+            self._arrivals.popleft()
+
+    def record_request(self, op: str, outcome: str, *,
+                       latency_s: Optional[float] = None,
+                       signature: Optional[str] = None,
+                       cache_hits: int = 0, new_traces: int = 0,
+                       retry_rungs: int = 0,
+                       integrity_retries: int = 0) -> None:
+        with self._lock:
+            self._tick()
+            slots = [self._op_slot(op)]
+            if signature is not None:
+                slots.append(self._sig_slot(signature))
+            for slot in slots:
+                slot["outcomes"][outcome] = (
+                    slot["outcomes"].get(outcome, 0) + 1)
+                slot["cache_hits"] += int(cache_hits)
+                slot["new_traces"] += int(new_traces)
+                slot["retry_rungs"] += int(retry_rungs)
+                slot["integrity_retries"] += int(integrity_retries)
+                if "requests" in slot:
+                    slot["requests"] += 1
+                if latency_s is not None:
+                    slot["latency"].observe(latency_s)
+
+    # -- read path ----------------------------------------------------
+
+    def uptime_s(self) -> float:
+        return self._now() - self._t0
+
+    def qps(self) -> float:
+        with self._lock:
+            horizon = int(self._now()) - self.QPS_WINDOW_S
+            n = sum(c for sec, c in self._arrivals if sec > horizon)
+        window = min(max(self.uptime_s(), 1.0), self.QPS_WINDOW_S)
+        return n / window
+
+    def overall_latency(self) -> dict:
+        """Quantiles over every op's SERVED latency (the ``stats``
+        block) — merged from the per-op histograms."""
+        merged = LatencyHistogram()
+        with self._lock:
+            for slot in self._ops.values():
+                merged.merge(slot["latency"].snapshot())
+        return merged.summary()
+
+    def snapshot(self) -> dict:
+        """The ``metrics`` wire op's JSON body."""
+        with self._lock:
+            ops = {
+                op: {
+                    "outcomes": dict(slot["outcomes"]),
+                    "cache_hits": slot["cache_hits"],
+                    "new_traces": slot["new_traces"],
+                    "retry_rungs": slot["retry_rungs"],
+                    "integrity_retries": slot["integrity_retries"],
+                    "latency": slot["latency"].summary(),
+                    "latency_histogram": slot["latency"].snapshot(),
+                }
+                for op, slot in sorted(self._ops.items())
+            }
+            signatures = {
+                digest: {
+                    "requests": slot["requests"],
+                    "outcomes": dict(slot["outcomes"]),
+                    "cache_hits": slot["cache_hits"],
+                    "new_traces": slot["new_traces"],
+                    "retry_rungs": slot["retry_rungs"],
+                    "integrity_retries": slot["integrity_retries"],
+                    "latency": slot["latency"].summary(),
+                }
+                for digest, slot in self._signatures.items()
+            }
+            dropped = self._signatures_dropped
+        return {
+            "uptime_s": round(self.uptime_s(), 3),
+            "epoch_start_s": self._epoch0,
+            "qps_60s": round(self.qps(), 3),
+            "ops": ops,
+            "signatures": signatures,
+            "signatures_dropped": dropped,
+        }
+
+    def to_prometheus(self, gauges: Optional[dict] = None) -> str:
+        """Prometheus text exposition (version 0.0.4) of the live
+        stats: outcome counters and latency histograms per op, the
+        per-signature request counters, uptime/QPS, plus any caller-
+        supplied ``gauges`` (the service adds pending/poisoned and the
+        program-cache counters)."""
+        lines = [
+            "# HELP djtpu_uptime_seconds Service uptime.",
+            "# TYPE djtpu_uptime_seconds gauge",
+            f"djtpu_uptime_seconds {self.uptime_s():.3f}",
+            "# HELP djtpu_qps_60s Requests/s over the last 60s.",
+            "# TYPE djtpu_qps_60s gauge",
+            f"djtpu_qps_60s {self.qps():.3f}",
+        ]
+        with self._lock:
+            lines += [
+                "# HELP djtpu_requests_total Requests by op and "
+                "outcome.",
+                "# TYPE djtpu_requests_total counter",
+            ]
+            for op, slot in sorted(self._ops.items()):
+                for outcome, n in sorted(slot["outcomes"].items()):
+                    lines.append(
+                        f'djtpu_requests_total{{op="{op}",'
+                        f'outcome="{outcome}"}} {n}')
+            for name in ("cache_hits", "new_traces", "retry_rungs",
+                         "integrity_retries"):
+                lines += [
+                    f"# TYPE djtpu_{name}_total counter",
+                ]
+                for op, slot in sorted(self._ops.items()):
+                    lines.append(
+                        f'djtpu_{name}_total{{op="{op}"}} '
+                        f'{slot[name]}')
+            lines += [
+                "# HELP djtpu_request_latency_seconds Served request "
+                "latency.",
+                "# TYPE djtpu_request_latency_seconds histogram",
+            ]
+            for op, slot in sorted(self._ops.items()):
+                hist = slot["latency"]
+                cum = 0
+                for i, le in enumerate(LATENCY_BUCKETS_S):
+                    cum += hist.counts[i]
+                    lines.append(
+                        "djtpu_request_latency_seconds_bucket"
+                        f'{{op="{op}",le="{le:g}"}} {cum}')
+                lines.append(
+                    "djtpu_request_latency_seconds_bucket"
+                    f'{{op="{op}",le="+Inf"}} {hist.count}')
+                lines.append(
+                    "djtpu_request_latency_seconds_sum"
+                    f'{{op="{op}"}} {hist.sum_s:.6f}')
+                lines.append(
+                    "djtpu_request_latency_seconds_count"
+                    f'{{op="{op}"}} {hist.count}')
+            lines += [
+                "# HELP djtpu_signature_requests_total Requests by "
+                "join signature.",
+                "# TYPE djtpu_signature_requests_total counter",
+            ]
+            for digest, slot in self._signatures.items():
+                lines.append(
+                    "djtpu_signature_requests_total"
+                    f'{{signature="{digest}"}} {slot["requests"]}')
+        for name, value in sorted((gauges or {}).items()):
+            if value is None:
+                continue
+            lines.append(f"# TYPE djtpu_{name} gauge")
+            lines.append(f"djtpu_{name} {value}")
+        return "\n".join(lines) + "\n"
+
+
+class FlightRecorder:
+    """Bounded ring of the last-N per-request records — the resident
+    server's postmortem buffer.
+
+    Each :meth:`record` call appends one dict (request id, op,
+    signature hash, timings, rung path, outcome, error); the ring
+    keeps the newest ``capacity`` and counts what rotated out. On
+    poison or terminal error the service dumps the ring as
+    ``flightrecorder.json`` (:meth:`dump` — atomic write), the
+    artifact ``telemetry.analyze check`` validates.
+    """
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._ring = deque(maxlen=self.capacity)
+        self._recorded_total = 0
+
+    def record(self, **fields) -> dict:
+        rec = dict(fields)
+        rec.setdefault("ts_epoch_s", time.time())
+        with self._lock:
+            self._ring.append(rec)
+            self._recorded_total += 1
+        return rec
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def snapshot(self, reason: str = "snapshot") -> dict:
+        with self._lock:
+            records = [dict(r) for r in self._ring]
+            total = self._recorded_total
+        return {
+            "schema_version": FLIGHT_RECORDER_SCHEMA_VERSION,
+            "kind": "flightrecorder",
+            "reason": reason,
+            "dumped_epoch_s": time.time(),
+            "capacity": self.capacity,
+            "recorded_total": total,
+            "dropped": max(total - len(records), 0),
+            "records": records,
+        }
+
+    def dump(self, path: str, reason: str) -> str:
+        """Atomically write the ring to ``path`` and return it."""
+        doc = self.snapshot(reason)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path

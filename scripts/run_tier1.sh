@@ -21,8 +21,11 @@
 #                                   # unit suite + the daemon smoke
 #                                   # (warm second query = zero new
 #                                   # traces, batched 16-way beats 16
-#                                   # sequential warm calls) on the
-#                                   # CPU mesh
+#                                   # sequential warm calls, live
+#                                   # metrics quantiles, poison drill)
+#                                   # + schema checks over the flight
+#                                   # recorder and workload-history
+#                                   # artifacts, on the CPU mesh
 #
 # Notes:
 # - tests/conftest.py points the persistent XLA compile cache at
@@ -138,20 +141,41 @@ case "$lane" in
   service)
     # Join-as-a-service (docs/SERVICE.md): the -m service unit suite
     # (cache-key discipline, warm-path program-count locks, retry-rung
-    # reuse, batching isolation, daemon protocol), then the daemon
-    # smoke through the real TCP loop — a warm second query must add
-    # zero traces and a 16-way micro-batch must beat 16 sequential
-    # warm calls on wall clock. The smoke's record carries the counter
-    # signature the perfgate lane gates against
-    # results/baselines/service_smoke.json.
+    # reuse, batching isolation, daemon protocol, live observability),
+    # then the daemon smoke through the real TCP loop — a warm second
+    # query must add zero traces, a 16-way micro-batch must beat 16
+    # sequential warm calls on wall clock, the `metrics` op must
+    # return non-degenerate latency quantiles over the warm traffic,
+    # and the poison drill must dump a schema-valid flight recorder.
+    # The observability artifacts (flightrecorder.json + the workload
+    # history store) are schema-checked and the history store must
+    # summarize >= 2 distinct workload signatures (ISSUE 7 acceptance).
+    # The smoke's record carries the counter signature the perfgate
+    # lane gates against results/baselines/service_smoke.json.
     set -e
     timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
       tests/ -q -m service --continue-on-collection-errors \
       -p no:cacheprovider -p no:xdist -p no:randomly
-    exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    tmp="$(mktemp -d /tmp/djtpu_service.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
       JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
       python -m distributed_join_tpu.service.server --smoke \
-      --platform cpu --n-ranks 8
+      --platform cpu --n-ranks 8 \
+      --history-dir "$tmp/history" \
+      --flight-recorder-path "$tmp/flightrecorder.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/flightrecorder.json" "$tmp/history/history.jsonl"
+    python -m distributed_join_tpu.telemetry.analyze history \
+      "$tmp/history"
+    python -m distributed_join_tpu.telemetry.analyze history \
+      "$tmp/history" --json | python -c '
+import json, sys
+s = json.load(sys.stdin)
+assert s["n_signatures"] >= 2, s
+print("history store:", s["n_entries"], "entries,",
+      s["n_signatures"], "signatures")'
+    exit $?
     ;;
   *)
     echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service]" >&2

@@ -468,3 +468,278 @@ def test_bench_proxy_record(monkeypatch):
     # the proxy record IS a valid baseline/compare source
     assert baselines.counter_signature(rec) == sig
     assert baselines.wall_time_of(rec) is None
+
+
+# -- workload history (ISSUE 7) ---------------------------------------
+
+
+def _fake_history(tmp_path):
+    from distributed_join_tpu.telemetry import history
+
+    store = history.WorkloadHistory(str(tmp_path))
+    store.append(history.request_entry(
+        request_id="req-000001", op="join", signature="sig-a",
+        outcome="served", wall_s=0.5, new_traces=2))
+    store.append(history.request_entry(
+        request_id="req-000002", op="join", signature="sig-a",
+        outcome="served", wall_s=0.1,
+        retry_record={"attempts": [
+            {"attempt": 0, "action": "initial", "overflow": True,
+             "out_capacity_factor": 3.0},
+            {"attempt": 1, "action": "double_capacities",
+             "overflow": False, "out_capacity_factor": 6.0},
+        ]}))
+    store.append(history.request_entry(
+        request_id="req-000003", op="batch", signature="sig-b",
+        outcome="failed", wall_s=0.2, error="ValueError: nope"))
+    return store
+
+
+def test_history_summarize_trends(tmp_path):
+    from distributed_join_tpu.telemetry import history
+
+    store = _fake_history(tmp_path)
+    entries, malformed = history.load_history(str(tmp_path))
+    assert malformed == 0 and len(entries) == 3
+    summary = history.summarize(entries)
+    assert summary["n_signatures"] == 2
+    a = summary["signatures"]["sig-a"]
+    assert a["entries"] == 2
+    assert a["escalations"] == 1
+    assert a["resolved_knobs_last"] == {"out_capacity_factor": 6.0}
+    assert a["wall"]["p50_s"] == 0.5 and a["wall"]["last_s"] == 0.1
+    b = summary["signatures"]["sig-b"]
+    assert b["outcomes"] == {"failed": 1}
+    text = history.format_summary(summary, path=store.path)
+    assert "2 signature(s)" in text and "sig-a" in text
+
+    # torn final line tolerated, like the event logs
+    with open(store.path, "a") as f:
+        f.write('{"torn": ')
+    entries2, malformed2 = history.load_history(store.path)
+    assert len(entries2) == 3 and malformed2 == 1
+
+
+def test_history_cli_and_artifact_checks(tmp_path, capsys):
+    """`analyze history` summarizes the store (human + --json), and
+    `analyze check` understands history.jsonl and flightrecorder.json
+    artifacts — the CI lane's validation."""
+    from distributed_join_tpu.telemetry import live
+
+    store = _fake_history(tmp_path)
+    assert analyze.main(["history", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "2 signature(s)" in out
+    assert analyze.main(["history", store.path, "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["n_entries"] == 3
+
+    assert analyze.main(["check", store.path]) == 0
+    capsys.readouterr()
+    # a history line missing its required keys fails the check
+    bad = tmp_path / "bad" / "history.jsonl"
+    bad.parent.mkdir()
+    bad.write_text('{"kind": "request"}\n{"also": "bad"}\n')
+    assert analyze.main(["check", str(bad)]) == 1
+    capsys.readouterr()
+
+    fr = live.FlightRecorder(capacity=4)
+    fr.record(request_id="req-1", op="join", outcome="hang",
+              signature="sig-a", elapsed_s=0.75)
+    path = fr.dump(str(tmp_path / "flightrecorder.json"), "poisoned")
+    assert analyze.check_file(path) == []
+    assert analyze.main(["check", path]) == 0
+    capsys.readouterr()
+    doc = json.load(open(path))
+    del doc["reason"]
+    doc["records"].append({"no": "ids"})
+    broken = tmp_path / "broken_flightrecorder.json"
+    broken.write_text(json.dumps(doc))
+    problems = analyze.check_file(str(broken))
+    assert any("reason" in p for p in problems)
+    assert any("records[1]" in p for p in problems)
+
+
+def test_run_entry_from_driver_record(tmp_path):
+    """The drivers' --history flag appends a run-shaped entry whose
+    workload hash is stable across repeats and whose counter signature
+    comes from the record's telemetry block."""
+    from distributed_join_tpu.benchmarks import maybe_history
+    from distributed_join_tpu.telemetry import history
+
+    record = {
+        "benchmark": "distributed_join", "n_ranks": 8,
+        "build_table_nrows": 8000, "probe_table_nrows": 8000,
+        "shuffle": "ragged", "elapsed_per_join_s": 0.25,
+        "matches_per_join": 123,
+        "retry": None,
+        "telemetry": {"metrics": {
+            "n_ranks": 8,
+            "per_rank": {"matches": [15] * 8},
+            "reduced": {"matches": 120},
+        }},
+    }
+    e1 = history.run_entry(record=record)
+    e2 = history.run_entry(record=dict(record, elapsed_per_join_s=0.5))
+    assert e1["kind"] == "run"
+    assert e1["signature"] == e2["signature"]      # same workload
+    assert e1["wall_s"] == 0.25 and e2["wall_s"] == 0.5
+    assert e1["counter_signature"]["counters"]["matches"] == 120
+
+    # the end-of-run hook appends on rank 0 (best-effort, never raises)
+    path = str(tmp_path / "h.jsonl")
+
+    class A:
+        history = path
+
+    maybe_history(A(), summary=None, record=record)
+    entries, _ = history.load_history(path)
+    assert len(entries) == 1 and entries[0]["signature"] == \
+        e1["signature"]
+
+
+def test_launch_forwards_history_flag():
+    """The new observability flag rides the shared forwarding table —
+    tpu-launch must not silently drop it (the PR 6 fix pattern)."""
+    from distributed_join_tpu.benchmarks import launch
+
+    args = launch.parse_args([
+        "--num-processes", "2", "--history", "store.jsonl",
+        "--", "tpu-distributed-join", "--iterations", "1",
+    ])
+    cmd = args.command
+    assert cmd[cmd.index("--history") + 1] == "store.jsonl"
+    # ... and is stripped from the launcher itself (no session, no
+    # launcher-level history entry)
+    assert args.history is None
+    assert not telemetry.configure_from_args(args)
+
+    # explicit child flags win; nothing forwards twice
+    args2 = launch.parse_args([
+        "--num-processes", "2", "--history", "parent.jsonl",
+        "--", "drv", "--history", "child.jsonl",
+    ])
+    assert args2.command.count("--history") == 1
+    assert "parent.jsonl" not in args2.command
+
+
+def test_history_file_contract_and_wall_extraction(tmp_path):
+    """--history FILE must write THAT file (never silently become a
+    directory), and run_entry's wall number follows wall_time_of —
+    all_to_all's elapsed_per_exchange_s counts, bench.py's rate-shaped
+    'value' never does."""
+    from distributed_join_tpu.telemetry import history
+
+    path = str(tmp_path / "runs.log")        # no .jsonl suffix
+    store = history.WorkloadHistory(path)
+    store.append(history.run_entry(record={"benchmark": "demo"}))
+    store.append(history.run_entry(record={"benchmark": "demo2"}))
+    assert os.path.isfile(path)
+    entries, _ = history.load_history(path)
+    assert len(entries) == 2
+    # `analyze check` validates the store under ANY filename (content
+    # sniff on the per-line kind stamp)
+    assert analyze.check_file(path) == []
+
+    e = history.run_entry(record={"benchmark": "all_to_all",
+                                  "elapsed_per_exchange_s": 0.125})
+    assert e["wall_s"] == 0.125
+    e2 = history.run_entry(record={"benchmark": "bench",
+                                   "value": 68.4})
+    assert e2["wall_s"] is None              # a rate, not a time
+
+
+def test_failed_run_history_entry(tmp_path, capsys):
+    """A run that dies under run_guarded must land a FAILED history
+    entry carrying the failure record's identity and error — never a
+    bogus healthy entry hashed from an empty workload."""
+    import pytest as _pytest
+
+    from distributed_join_tpu import benchmarks
+    from distributed_join_tpu.telemetry import history
+
+    path = str(tmp_path / "h.jsonl")
+
+    class A:
+        telemetry = str(tmp_path / "tel")
+        trace = False
+        diagnose = False
+        history = path
+        guard_deadline_s = 0
+        json_output = None
+        # driver-args workload identity, back-filled into the failure
+        # record so the failed run files under the same signature as
+        # its healthy runs
+        build_table_nrows = 8000
+        shuffle = "ragged"
+
+    def boom(args):
+        raise ValueError("nope")
+
+    # arrange the back-fill's precondition explicitly: it only reads
+    # n_ranks from an ALREADY-initialized backend (order-independent)
+    import jax
+
+    jax.device_count()
+    with _pytest.raises(ValueError):
+        benchmarks.run_guarded(boom, A(), benchmark="demo")
+    capsys.readouterr()
+    entries, _ = history.load_history(path)
+    assert len(entries) == 1
+    e = entries[0]
+    assert e["outcome"] == "failed"
+    assert "ValueError" in e["error"]
+    wl = dict(e["workload"])
+    # n_ranks is back-filled from the already-initialized backend so
+    # the failure hashes to the same signature as healthy runs
+    assert wl.pop("n_ranks", None) is not None
+    assert wl == {"benchmark": "demo",
+                  "build_table_nrows": 8000,
+                  "shuffle": "ragged"}
+
+
+def test_hang_failure_lands_history_entry(tmp_path, monkeypatch,
+                                          capsys):
+    """The HangError hard-exit path must still append the failure's
+    history entry before os._exit — a hang-prone workload is exactly
+    the trend the store exists to show."""
+    import os as _os
+    import time
+
+    import pytest as _pytest
+
+    from distributed_join_tpu import benchmarks
+    from distributed_join_tpu.telemetry import history
+
+    path = str(tmp_path / "h.jsonl")
+
+    class Exited(Exception):
+        pass
+
+    def fake_exit(code):
+        raise Exited(str(code))
+
+    monkeypatch.setattr(_os, "_exit", fake_exit)
+
+    class A:
+        telemetry = str(tmp_path / "tel")
+        trace = False
+        diagnose = False
+        history = path
+        guard_deadline_s = 0.2
+        json_output = None
+        build_table_nrows = 4096
+
+    def sleepy(args):
+        time.sleep(3.0)
+
+    with _pytest.raises(Exited):
+        benchmarks.run_guarded(sleepy, A(), benchmark="demo")
+    capsys.readouterr()
+    entries, _ = history.load_history(path)
+    assert entries                  # (the fake exit lets the finally
+    #                                 run too; production exits first)
+    assert all(e["outcome"] == "failed" for e in entries)
+    assert "HangError" in entries[0]["error"]
+    assert entries[0]["workload"]["build_table_nrows"] == 4096
+    time.sleep(3.0)                 # drain the detached worker

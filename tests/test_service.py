@@ -397,35 +397,6 @@ def test_admission_bounds():
     assert service.served == 0
 
 
-def test_hung_request_poisons_service():
-    """A request that blows its deadline leaves its join running on
-    the detached watchdog worker — the mesh must not take another
-    program. Fail-stop: later joins are refused until restart."""
-    import time
-
-    from distributed_join_tpu.parallel.watchdog import HangError
-    from distributed_join_tpu.service.server import (
-        AdmissionError,
-        JoinService,
-        ServiceConfig,
-    )
-
-    b, p = _tables()
-    comm = FaultInjectingCommunicator(
-        CountingComm(), FaultPlan(dispatch_delay_s=3.0))
-    service = JoinService(
-        comm, ServiceConfig(request_deadline_s=0.75, auto_retry=0))
-    with pytest.raises(HangError):
-        service.join(b, p, out_capacity_factor=4.0)
-    assert service.stats()["poisoned"]
-    with pytest.raises(AdmissionError):
-        service.join(b, p, out_capacity_factor=4.0)
-    assert service.failed == 1 and service.rejected == 1
-    # let the detached worker drain so it cannot interleave with the
-    # next test's programs
-    time.sleep(3.0)
-
-
 def test_daemon_warm_and_batched_over_tcp():
     """The wire protocol end to end: a warm repeat answers with zero
     new traces, stats report the cache, a micro-batch answers per
@@ -472,3 +443,338 @@ def test_daemon_warm_and_batched_over_tcp():
     finally:
         client.close()
         server.server_close()
+
+
+# -- live observability (ISSUE 7) -------------------------------------
+
+
+def test_request_id_propagation_over_tcp(tmp_path):
+    """Satellite: a daemon TCP request's id must appear in the wire
+    response, the per-rank JSONL events, and the trace span args —
+    one id correlates client, daemon, and rank-level views."""
+    import json
+
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceClient,
+        ServiceConfig,
+        start_daemon,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    service = JoinService(comm, ServiceConfig(auto_retry=1))
+    server, port = start_daemon(service)
+    client = ServiceClient("127.0.0.1", port)
+    tel_dir = str(tmp_path / "tel")
+    try:
+        with telemetry.session(tel_dir) as sink:
+            q = {"op": "join", "build_nrows": 256, "probe_nrows": 256,
+                 "seed": 7, "selectivity": 0.5,
+                 "out_capacity_factor": 4.0}
+            r1 = client.send(q)
+            r2 = client.send(dict(q, request_id="client-abc"))
+            events_path, trace_path = sink.events_path, sink.trace_path
+        assert r1["ok"] and r1["request_id"]
+        # a client-supplied id is honored end to end
+        assert r2["ok"] and r2["request_id"] == "client-abc"
+        assert r1["request_id"] != r2["request_id"]
+    finally:
+        client.close()
+        server.server_close()
+
+    events = [json.loads(line) for line in open(events_path)]
+    for rid in (r1["request_id"], "client-abc"):
+        tagged = [e for e in events if e.get("request_id") == rid]
+        # the request span plus the events its execution emitted
+        # (cache trace, metrics, ...) all carry the id
+        assert any(e["kind"] == "span" and e["name"] == "request"
+                   for e in tagged), rid
+        assert any(e["kind"] == "event" for e in tagged), rid
+    trace = json.load(open(trace_path))
+    span_args = [e["args"] for e in trace["traceEvents"]
+                 if e["name"] == "request" and e["ph"] == "X"]
+    assert {a["request_id"] for a in span_args} == {
+        r1["request_id"], "client-abc"}
+
+
+def test_metrics_op_stats_gaps_and_prometheus():
+    """The `metrics` wire op returns live latency quantiles and
+    per-signature counters (JSON and Prometheus exposition), and
+    stats() carries the uptime/inflight/high-water satellite fields."""
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceClient,
+        ServiceConfig,
+        start_daemon,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    service = JoinService(comm, ServiceConfig(auto_retry=1))
+    server, port = start_daemon(service)
+    client = ServiceClient("127.0.0.1", port)
+    try:
+        q = {"op": "join", "build_nrows": 256, "probe_nrows": 256,
+             "seed": 7, "selectivity": 0.5,
+             "out_capacity_factor": 4.0}
+        client.send(q)
+        client.send(q)
+
+        stats = client.send({"op": "stats"})
+        assert stats["ok"] and stats["served"] == 2
+        assert stats["uptime_s"] >= 0
+        assert stats["inflight"] == 0 and stats["pending"] == 0
+        assert stats["pending_hwm"] == 1
+        lat = stats["latency"]
+        assert lat["count"] == 2 and lat["p50_s"] > 0
+        assert lat["p50_s"] <= lat["p95_s"] <= lat["p99_s"]
+
+        met = client.send({"op": "metrics"})
+        assert met["ok"]
+        m = met["metrics"]
+        assert m["uptime_s"] >= 0 and m["qps_60s"] > 0
+        join_op = m["ops"]["join"]
+        assert join_op["outcomes"]["served"] == 2
+        assert join_op["cache_hits"] >= 1
+        assert join_op["latency"]["count"] == 2
+        # one workload -> one signature slot with both requests
+        (sig_stats,) = m["signatures"].values()
+        assert sig_stats["requests"] == 2
+
+        prom = client.send({"op": "metrics", "format": "prometheus"})
+        text = prom["prometheus"]
+        assert 'djtpu_requests_total{op="join",outcome="served"} 2' \
+            in text
+        assert "djtpu_request_latency_seconds_bucket" in text
+        assert "djtpu_program_cache_hits" in text
+        assert client.send({"op": "shutdown"})["ok"]
+    finally:
+        client.close()
+        server.server_close()
+
+
+def test_hung_request_poisons_service_and_dumps_flight_recorder(
+        tmp_path):
+    """A request that blows its deadline leaves its join running on
+    the detached watchdog worker — the mesh must not take another
+    program. Fail-stop: later joins are refused until restart, and the
+    poison dumps a schema-valid flightrecorder.json postmortem."""
+    import json
+    import time
+
+    from distributed_join_tpu.parallel.watchdog import HangError
+    from distributed_join_tpu.service.server import (
+        AdmissionError,
+        JoinService,
+        ServiceConfig,
+    )
+    from distributed_join_tpu.telemetry.analyze import check_file
+
+    b, p = _tables()
+    comm = FaultInjectingCommunicator(
+        CountingComm(), FaultPlan(dispatch_delay_s=3.0))
+    fr_path = str(tmp_path / "flightrecorder.json")
+    service = JoinService(
+        comm, ServiceConfig(request_deadline_s=0.75, auto_retry=0,
+                            flight_recorder_path=fr_path))
+    with pytest.raises(HangError):
+        service.join(b, p, out_capacity_factor=4.0)
+    assert service.stats()["poisoned"]
+    with pytest.raises(AdmissionError):
+        service.join(b, p, out_capacity_factor=4.0)
+    assert service.failed == 1 and service.rejected == 1
+    # the poison dumped the ring, and the artifact passes the schema
+    # check the CI lane runs
+    assert service.flight_recorder_dumped == fr_path
+    assert check_file(fr_path) == []
+    doc = json.load(open(fr_path))
+    assert doc["kind"] == "flightrecorder"
+    assert "poisoned" in doc["reason"]
+    (rec,) = doc["records"]
+    assert rec["outcome"] == "hang" and rec["request_id"]
+    assert rec["signature"] and rec["elapsed_s"] >= 0.75
+    # the hang AND the poisoned-refusal are visible in live metrics
+    snap = service.live.snapshot()
+    assert snap["ops"]["join"]["outcomes"] == {"hang": 1,
+                                               "rejected": 1}
+    # let the detached worker drain so it cannot interleave with the
+    # next test's programs
+    time.sleep(3.0)
+
+
+def test_history_store_records_requests(tmp_path):
+    """Every request lands one per-signature history.jsonl line under
+    the history dir — signature hash, outcome, wall time, cache/trace
+    accounting, and (with telemetry on) the counter signature — and
+    `summarize` sees the distinct workloads (the autotuner substrate)."""
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+    from distributed_join_tpu.telemetry import history
+    from distributed_join_tpu.telemetry.analyze import check_file
+
+    hist_dir = str(tmp_path / "hist")
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    service = JoinService(
+        comm, ServiceConfig(auto_retry=1, history_dir=hist_dir))
+    b1, p1 = _tables()
+    b2, p2 = _request(0)
+    with telemetry.session(str(tmp_path / "tel")):
+        service.join(b1, p1, out_capacity_factor=4.0)
+        service.join(b1, p1, out_capacity_factor=4.0)   # warm repeat
+        service.join(b2, p2, out_capacity_factor=4.0)   # 2nd workload
+
+    entries, malformed = history.load_history(hist_dir)
+    assert malformed == 0 and len(entries) == 3
+    assert all(e["kind"] == "request" and e["request_id"]
+               and e["wall_s"] > 0 for e in entries)
+    assert entries[0]["signature"] == entries[1]["signature"]
+    assert entries[1]["new_traces"] == 0                 # warm
+    assert entries[0]["counter_signature"]["counters"]["matches"] > 0
+    summary = history.summarize(entries)
+    assert summary["n_signatures"] == 2
+    sig0 = summary["signatures"][entries[0]["signature"]]
+    assert sig0["entries"] == 2 and sig0["outcomes"] == {"served": 2}
+    # identical workload, identical counters: no drift flagged
+    assert not sig0["counter_drift"]
+    # the store passes the CI lane's schema check
+    assert check_file(service.history.path) == []
+
+
+def test_batch_requests_carry_request_id_and_rejections_record():
+    """join_batched threads one request id to every per-request
+    record; an oversize batch is refused AND leaves a flight-recorder
+    rejection record."""
+    from distributed_join_tpu.service.server import (
+        AdmissionError,
+        JoinService,
+        ServiceConfig,
+    )
+
+    comm = CountingComm()
+    service = JoinService(
+        comm, ServiceConfig(auto_retry=1, max_batch_requests=4))
+    requests = [_request(i) for i in range(2)]
+    results = service.join_batched(
+        requests, slot_build_rows=64, slot_probe_rows=128,
+        out_capacity_factor=4.0)
+    rids = {r["request_id"] for r in results}
+    assert len(rids) == 1 and None not in rids
+    b, p = _request(0)
+    with pytest.raises(AdmissionError):
+        service.join_batched([(b, p)] * 5)
+    recs = service.recorder.snapshot()["records"]
+    rejected = [r for r in recs if r["outcome"] == "rejected"]
+    assert rejected and rejected[-1]["op"] == "batch"
+    assert rejected[-1]["reason"] == "batch_size"
+    snap = service.live.snapshot()
+    assert snap["ops"]["batch"]["outcomes"] == {"served": 1,
+                                                "rejected": 1}
+
+
+def test_watch_console_renders_metrics():
+    """The --watch operator console polls the metrics op and renders
+    one line per poll (no mesh of its own — read-only over TCP)."""
+    import io
+
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+        start_daemon,
+        watch,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    service = JoinService(comm, ServiceConfig())
+    server, port = start_daemon(service)
+    try:
+        out = io.StringIO()
+        assert watch("127.0.0.1", port, interval_s=0.05, count=2,
+                     out=out) == 0
+        lines = out.getvalue().strip().splitlines()
+        assert len(lines) == 2
+        assert "served" in lines[0] and "p99" in lines[0]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_bad_input_does_not_leak_admission_slot():
+    """A request that dies before dispatch (signature computation on a
+    non-Table input) must still release its admission slot — a leak
+    here bricks the resident server after max_pending bad requests."""
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    service = JoinService(comm, ServiceConfig(max_pending=2))
+    for _ in range(3):
+        with pytest.raises(Exception):
+            service.join(object(), object())
+    assert service._pending == 0
+    assert service.failed == 3
+
+
+def test_minted_ids_never_collide_with_client_namespace():
+    """Minted ids carry a per-service nonce, so a client-supplied id
+    shaped like the mint format cannot alias a future minted id —
+    correlation stays one-to-one."""
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    service = JoinService(comm, ServiceConfig())
+    with service._admit_lock:
+        client_style = service._mint_request_id("req-000002")
+        minted = [service._mint_request_id(None) for _ in range(3)]
+    assert client_style == "req-000002"       # echoed verbatim
+    assert client_style not in minted
+    assert len(set(minted)) == 3
+    # over-long client ids are capped WITHOUT aliasing: a shared
+    # 64-char prefix must not collapse two requests onto one id
+    with service._admit_lock:
+        long_a = service._mint_request_id("x" * 80 + "a")
+        long_b = service._mint_request_id("x" * 80 + "b")
+    assert long_a != long_b
+    assert len(long_a) <= 64 and len(long_b) <= 64
+
+
+def test_watch_console_unreachable_daemon_is_one_line():
+    import io
+
+    from distributed_join_tpu.service.server import watch
+
+    out = io.StringIO()
+    # nothing listens on this port: one line + rc 1, no traceback
+    assert watch("127.0.0.1", 1, interval_s=0.05, count=1,
+                 out=out) == 1
+    assert "cannot reach daemon" in out.getvalue()
+
+
+def test_malformed_batch_is_counted_and_flight_recorded():
+    """A batch that dies in combine() (schema mismatch) must still be
+    visible to operators: failed count, live metric, flight record."""
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    service = JoinService(comm, ServiceConfig())
+    b0, p0 = _request(0)
+    b1 = Table.from_dense({
+        "key": jnp.arange(64, dtype=jnp.int64),
+        "other": jnp.arange(64, dtype=jnp.int32),
+    })
+    with pytest.raises(ValueError):
+        service.join_batched([(b0, p0), (b1, p0)])
+    assert service.failed == 1
+    assert service.live.snapshot()["ops"]["batch"]["outcomes"] == \
+        {"failed": 1}
+    (rec,) = service.recorder.snapshot()["records"]
+    assert rec["outcome"] == "failed" and rec["op"] == "batch"
+    assert rec["reason"] == "batch_combine" and rec["request_id"]

@@ -310,3 +310,90 @@ def test_driver_record_off_mode_unchanged():
     assert record["schema_version"] == 2
     assert record["rank"] == 0
     assert "telemetry" not in record
+
+
+# -- live-observability plumbing (ISSUE 7) ----------------------------
+
+
+def test_counter_track_events_in_chrome_trace(tmp_path):
+    """Host counters must land in the Chrome trace as counter-track
+    ("ph": "C") events carrying the RUNNING total — so Perfetto plots
+    rows/bytes over time instead of the counters existing only as one
+    final summary number."""
+    d = str(tmp_path / "tel")
+    with telemetry.session(d, rank=0) as sink:
+        telemetry.counter_add("demo.rows", 5)
+        telemetry.counter_add("demo.rows", 7)
+        telemetry.counter_add("demo.bytes", 100)
+        trace_path = sink.trace_path
+    trace = json.load(open(trace_path))
+    counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+    rows = [e["args"]["value"] for e in counters
+            if e["name"] == "demo.rows"]
+    assert rows == [5, 12]                     # cumulative series
+    assert [e["args"]["value"] for e in counters
+            if e["name"] == "demo.bytes"] == [100]
+    # still a valid Chrome trace per the analyze shape check
+    from distributed_join_tpu.telemetry.analyze import check_file
+
+    assert check_file(trace_path) == []
+
+
+def test_request_scope_tags_events_and_spans(tmp_path):
+    """Everything recorded inside telemetry.request_scope carries the
+    request id — in the JSONL record AND the trace args — including
+    events emitted from another thread (the watchdog-worker case);
+    records outside the scope stay untagged."""
+    import threading
+
+    d = str(tmp_path / "tel")
+    with telemetry.session(d, rank=0) as sink:
+        telemetry.event("before")
+        with telemetry.request_scope("req-000042"):
+            telemetry.event("inside")
+            with telemetry.span("request_stage"):
+                pass
+            t = threading.Thread(
+                target=lambda: telemetry.event("from_worker"))
+            t.start()
+            t.join()
+        telemetry.event("after")
+        events_path, trace_path = sink.events_path, sink.trace_path
+    by_name = {}
+    for line in open(events_path):
+        ev = json.loads(line)
+        by_name[ev["name"]] = ev
+    assert by_name["inside"]["request_id"] == "req-000042"
+    assert by_name["from_worker"]["request_id"] == "req-000042"
+    assert by_name["request_stage"]["request_id"] == "req-000042"
+    assert "request_id" not in by_name["before"]
+    assert "request_id" not in by_name["after"]
+    trace = json.load(open(trace_path))
+    args_by_name = {e["name"]: e.get("args", {})
+                    for e in trace["traceEvents"]}
+    assert args_by_name["inside"]["request_id"] == "req-000042"
+    assert args_by_name["request_stage"]["request_id"] == "req-000042"
+    assert "request_id" not in args_by_name["before"]
+
+
+def test_request_scope_noop_when_off():
+    assert not telemetry.enabled()
+    with telemetry.request_scope("req-1"):
+        telemetry.event("ignored")          # must not raise
+
+
+def test_payload_request_id_wins_over_scope(tmp_path):
+    """An event fired concurrently with another request's scope (the
+    admission-rejection case — emitted outside the exec lock) carries
+    ITS OWN payload request_id, never the scope's tag."""
+    d = str(tmp_path / "tel")
+    with telemetry.session(d, rank=0) as sink:
+        with telemetry.request_scope("req-A"):
+            # request B's rejection, stamped explicitly by admission
+            telemetry.event("request_rejected", request_id="req-B")
+            telemetry.event("scoped_event")
+        events_path = sink.events_path
+    by_name = {json.loads(l)["name"]: json.loads(l)
+               for l in open(events_path)}
+    assert by_name["request_rejected"]["request_id"] == "req-B"
+    assert by_name["scoped_event"]["request_id"] == "req-A"
