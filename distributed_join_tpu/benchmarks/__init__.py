@@ -216,6 +216,62 @@ def maybe_diagnose(args, summary, record=None) -> None:
               file=sys.stderr)
 
 
+def write_explain(args, explain_record, label: str = "") -> "str | None":
+    """The drivers' ``--explain`` sink: write the deterministic
+    ``explain.json`` artifact (``planning.JoinPlan.explain_record()``
+    or ``planning.build_exchange_plan``'s dict) into the telemetry
+    session directory — beside where ``--diagnose`` leaves
+    ``diagnosis.json`` — and embed a compact prediction summary in the
+    driver record via :func:`explain_summary`. Rank 0 only;
+    deterministic content (no timestamps) so the same query spec
+    yields byte-identical artifacts (the determinism gate of
+    tests/test_explain.py). Returns the path written (None off-rank-0
+    or with no session)."""
+    import json
+    import os
+
+    from distributed_join_tpu import telemetry
+    from distributed_join_tpu.parallel.bootstrap import is_coordinator
+
+    if not is_coordinator():
+        return None
+    s = telemetry.sink()
+    out_dir = s.dir if s is not None else "."
+    name = f"explain.{label}.json" if label else "explain.json"
+    path = os.path.join(out_dir, name)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(explain_record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    plan = explain_record.get("plan", {})
+    print(f"explain: plan {plan.get('signature_digest', '?')[:16]} "
+          f"-> {path}")
+    return path
+
+
+def explain_summary(explain_record) -> dict:
+    """The compact prediction block drivers embed in their JSON record
+    under ``"explain"`` — what :mod:`..telemetry.history` grades
+    against the measured wall (prediction error per workload
+    signature, ROADMAP item 5's calibration signal)."""
+    plan = explain_record.get("plan", {})
+    cost = explain_record.get("cost", {})
+    wire = plan.get("wire", {})
+    predicted = {
+        side: wire.get(side, {}).get("bytes_total")
+        for side in ("build", "probe") if side in wire
+    }
+    if not predicted and "bytes_total" in wire:
+        predicted = {"total": wire["bytes_total"]}   # exchange plan
+    return {
+        "plan_digest": plan.get("signature_digest"),
+        "predicted_wall_s": cost.get("total_s"),
+        "wire_exact": wire.get("exact"),
+        "predicted_wire_bytes": predicted,
+    }
+
+
 def maybe_history(args, summary, record=None) -> None:
     """End-of-run ``--history FILE`` hook (next to :func:`maybe_
     diagnose`): append one workload-history entry — workload
@@ -318,6 +374,15 @@ def add_telemetry_args(parser) -> None:
              "writes per request and `telemetry.analyze history` "
              "summarizes. Implies --telemetry; rank 0 only",
     )
+    parser.add_argument(
+        "--explain", action="store_true",
+        help="materialize the fully-resolved JoinPlan + roofline cost "
+             "prediction (distributed_join_tpu/planning; zero extra "
+             "traces/compiles) and write explain.json beside "
+             "diagnosis.json in the telemetry dir; the plan's "
+             "predicted-vs-measured error is gradeable post-run with "
+             "`telemetry.analyze explain`. Implies --telemetry",
+    )
 
 
 def add_robustness_args(parser) -> None:
@@ -360,6 +425,7 @@ FORWARDED_CHILD_FLAGS = (
     ("--trace", "trace", False),
     ("--diagnose", "diagnose", False),
     ("--history", "history", True),
+    ("--explain", "explain", False),
     ("--verify-integrity", "verify_integrity", False),
     ("--chaos-seed", "chaos_seed", True),
     ("--guard-deadline-s", "guard_deadline_s", True),

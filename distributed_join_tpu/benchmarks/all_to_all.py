@@ -138,12 +138,29 @@ def run(args) -> dict:
 
     bytes_per_rank = elems * 4
     egress = bytes_per_rank * (n - 1) / n
+
+    # --explain: the microbenchmark's reduced plan — one fixed-size
+    # exchange's exact wire bytes + the spec-derived ICI prediction
+    # (planning.build_exchange_plan; no join pipeline here).
+    explain_rec = None
+    if args.explain:
+        from distributed_join_tpu import planning
+        from distributed_join_tpu.benchmarks import (
+            explain_summary,
+            write_explain,
+        )
+
+        doc = planning.build_exchange_plan(n, bytes_per_rank)
+        write_explain(args, doc)
+        explain_rec = explain_summary(doc)
+
     record = {
         "benchmark": "all_to_all",
         "communicator": comm.name,
         "n_ranks": n,
         "buffer_bytes_per_rank": bytes_per_rank,
         "integrity": integ,
+        "explain": explain_rec,
         "chaos_seed": args.chaos_seed,
         "elapsed_per_exchange_s": sec,
         "aggregate_offchip_gb_per_sec": n * egress / sec / 1e9,

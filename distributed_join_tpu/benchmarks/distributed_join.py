@@ -401,6 +401,27 @@ def run(args) -> dict:
         integ = collect_integrity(comm, build, probe,
                                   dict(fixed_opts, **ladder.sizing()))
 
+    # --explain: the fully-resolved plan + roofline prediction of the
+    # TIMED program (final ladder rung; with_metrics=False — the seed
+    # hot path is what was measured). Pure host arithmetic, written as
+    # the deterministic explain.json artifact beside diagnosis.json;
+    # the compact summary rides the record so `analyze explain` and
+    # the history store can grade prediction error post-run.
+    explain_rec = None
+    if args.explain:
+        from distributed_join_tpu import planning
+        from distributed_join_tpu.benchmarks import (
+            explain_summary,
+            write_explain,
+        )
+
+        plan = planning.build_plan(
+            comm, build, probe, with_metrics=False,
+            **fixed_opts, **ladder.sizing())
+        doc = plan.explain_record()
+        write_explain(args, doc)
+        explain_rec = explain_summary(doc)
+
     rows = b_rows + p_rows
     rows_per_sec = rows / sec_per_join
     record = {
@@ -432,6 +453,7 @@ def run(args) -> dict:
         "matches_per_join": matches,
         "overflow": overflow,
         "integrity": integ,
+        "explain": explain_rec,
         "chaos_seed": args.chaos_seed,
         "retry": ladder.report().as_record(),
         "elapsed_per_join_s": sec_per_join,

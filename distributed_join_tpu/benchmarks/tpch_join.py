@@ -126,6 +126,15 @@ def run(args) -> dict:
             "--fetch-results applies to the batched paths; add "
             "--batches > 1 or --host-generator"
         )
+    if args.explain and (args.batches > 1 or args.host_generator):
+        # The out-of-core paths re-plan per key-range batch with
+        # staging-dependent capacities; a single static plan would
+        # misdescribe them. Say so instead of writing a wrong artifact.
+        import sys as _sys
+
+        print("note: --explain covers the single-shot path; the "
+              "batched/out-of-core paths are not planned (per-batch "
+              "capacities resolve during staging)", file=_sys.stderr)
     apply_platform(args.platform, args.n_ranks)
     comm = maybe_chaos_communicator(
         make_communicator(args.communicator, n_ranks=args.n_ranks),
@@ -270,6 +279,20 @@ def run(args) -> dict:
         if args.verify_integrity:
             extra_single["integrity"] = collect_integrity(
                 comm, build, probe, join_opts)
+        if args.explain:
+            # Plan of the timed single-shot program (see
+            # benchmarks/distributed_join.py's --explain block).
+            from distributed_join_tpu import planning
+            from distributed_join_tpu.benchmarks import (
+                explain_summary,
+                write_explain,
+            )
+
+            doc = planning.build_plan(
+                comm, build, probe, with_metrics=False,
+                **join_opts).explain_record()
+            write_explain(args, doc)
+            extra_single["explain"] = explain_summary(doc)
 
     # Valid-row counts (post-filter), same semantics as the host path.
     return _report(args, comm, int(orders.num_valid()),

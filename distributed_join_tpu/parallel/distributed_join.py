@@ -481,6 +481,55 @@ def make_distributed_join(comm: Communicator, with_metrics=None,
     return fn
 
 
+def resolve_join_ladder(build, probe, n_ranks: int, opts: dict):
+    """THE one resolution of ``distributed_inner_join``'s capacity
+    contract: pop the sizing knobs from ``opts`` (mutated — what
+    remains goes to ``make_join_step`` verbatim), resolve the skew
+    defaults exactly as the step would, and return the
+    :class:`..faults.CapacityLadder` at its initial rung.
+
+    Shared with :func:`..planning.explain_join` so an EXPLAIN's plan
+    resolves the identical sizing a real call would run — the two
+    can never drift apart."""
+    from distributed_join_tpu.parallel.faults import CapacityLadder
+
+    shuffle_f = opts.pop("shuffle_capacity_factor",
+                         DEFAULT_SHUFFLE_CAPACITY_FACTOR)
+    out_f = opts.pop("out_capacity_factor", DEFAULT_OUT_CAPACITY_FACTOR)
+    # Resolve the HH capacities here so retries can double them too —
+    # overflow can originate in the skew path as well as the shuffle.
+    skew_on = opts.get("skew_threshold") is not None
+    hh_build_cap = opts.pop("hh_build_capacity", None)
+    hh_probe_cap = opts.pop("hh_probe_capacity", None)
+    hh_out_cap = opts.pop("hh_out_capacity", None)
+    if skew_on:
+        hh_build_cap = hh_build_cap or (
+            opts.get("hh_slots", DEFAULT_HH_SLOTS) * HH_BUILD_SLOTS_PER_HH
+        )
+        hh_probe_cap = hh_probe_cap or max(
+            probe.capacity // (8 * n_ranks), 1024)
+        hh_out_cap = hh_out_cap or max(
+            probe.capacity // (4 * n_ranks), 1024)
+    out_rows = opts.pop("out_rows_per_rank", None)
+    comp_bits = opts.pop("compression_bits", None)
+    # The escalation policy — compression bits widen first (the cheap
+    # axis), then every capacity doubles with the skew capacities
+    # jumping straight to full local probe coverage — lives in
+    # CapacityLadder so drivers escalate identically and the
+    # decisions survive as a RetryReport.
+    return CapacityLadder(
+        shuffle_capacity_factor=shuffle_f,
+        out_capacity_factor=out_f,
+        out_rows_per_rank=out_rows,
+        compression_bits=comp_bits,
+        skew=skew_on,
+        hh_build_capacity=hh_build_cap,
+        hh_probe_capacity=hh_probe_cap,
+        hh_out_capacity=hh_out_cap,
+        local_probe_rows=probe.capacity // n_ranks,
+    )
+
+
 def distributed_inner_join(
     build: Table,
     probe: Table,
@@ -489,6 +538,7 @@ def distributed_inner_join(
     auto_retry: int = 0,
     verify_integrity: bool = False,
     program_cache=None,
+    explain: bool = False,
     **opts,
 ) -> JoinResult:
     """One-shot convenience: pad to rank-divisible capacity, shard the
@@ -530,9 +580,17 @@ def distributed_inner_join(
     trace time, so only a re-trace is guaranteed to face a fresh
     schedule, and a possibly-tainted resident program must not keep
     serving. Default None: build per call, the historical behavior.
+
+    ``explain``: attach the fully-resolved :class:`..planning.JoinPlan`
+    of the attempt that produced the result (final ladder rung) as a
+    host-side ``res.plan`` attribute — capacities, wire-byte and
+    wall-time predictions, and the canonical signature digest, which
+    equals the program cache's key for the same call
+    (docs/OBSERVABILITY.md "Explain & cost model"). Plan construction
+    is pure host arithmetic — no extra traces or compiles; use
+    :func:`..planning.explain_join` for the plan WITHOUT running.
     """
     from distributed_join_tpu.parallel import faults, integrity
-    from distributed_join_tpu.parallel.faults import CapacityLadder
 
     if program_cache is not None and program_cache.comm is not comm:
         # The cache compiles over ITS communicator's mesh; silently
@@ -548,39 +606,7 @@ def distributed_inner_join(
     if hasattr(comm, "device_put_sharded"):
         build, probe = comm.device_put_sharded((build, probe))
 
-    shuffle_f = opts.pop("shuffle_capacity_factor",
-                         DEFAULT_SHUFFLE_CAPACITY_FACTOR)
-    out_f = opts.pop("out_capacity_factor", DEFAULT_OUT_CAPACITY_FACTOR)
-    # Resolve the HH capacities here so retries can double them too —
-    # overflow can originate in the skew path as well as the shuffle.
-    skew_on = opts.get("skew_threshold") is not None
-    hh_build_cap = opts.pop("hh_build_capacity", None)
-    hh_probe_cap = opts.pop("hh_probe_capacity", None)
-    hh_out_cap = opts.pop("hh_out_capacity", None)
-    if skew_on:
-        hh_build_cap = hh_build_cap or (
-            opts.get("hh_slots", DEFAULT_HH_SLOTS) * HH_BUILD_SLOTS_PER_HH
-        )
-        hh_probe_cap = hh_probe_cap or max(probe.capacity // (8 * n), 1024)
-        hh_out_cap = hh_out_cap or max(probe.capacity // (4 * n), 1024)
-    out_rows = opts.pop("out_rows_per_rank", None)
-    comp_bits = opts.pop("compression_bits", None)
-    # The escalation policy — compression bits widen first (the cheap
-    # axis), then every capacity doubles with the skew capacities
-    # jumping straight to full local probe coverage — lives in
-    # CapacityLadder so drivers escalate identically and the
-    # decisions survive as a RetryReport.
-    ladder = CapacityLadder(
-        shuffle_capacity_factor=shuffle_f,
-        out_capacity_factor=out_f,
-        out_rows_per_rank=out_rows,
-        compression_bits=comp_bits,
-        skew=skew_on,
-        hh_build_capacity=hh_build_cap,
-        hh_probe_capacity=hh_probe_cap,
-        hh_out_capacity=hh_out_cap,
-        local_probe_rows=probe.capacity // n,
-    )
+    ladder = resolve_join_ladder(build, probe, n, opts)
     last_sig = None
     for attempt in range(auto_retry + 1):
         if program_cache is not None:
@@ -621,6 +647,17 @@ def distributed_inner_join(
             # JoinResult traces through shard_map, and the report only
             # exists outside the compiled program.
             object.__setattr__(res, "retry_report", ladder.report())
+            if explain:
+                # Host arithmetic only (no trace/compile): the plan of
+                # the attempt that produced THIS result — its digest is
+                # the program cache's key for the same sizing.
+                from distributed_join_tpu import planning
+
+                object.__setattr__(res, "plan", planning.build_plan(
+                    comm, build, probe, key=key,
+                    with_integrity=verify_integrity,
+                    metrics_static={"retry_attempt_max": attempt},
+                    **ladder.sizing(), **opts))
             if report is not None:
                 object.__setattr__(res, "integrity_report", report)
             # Fold the device metrics of the FINAL attempt into the

@@ -1,0 +1,47 @@
+"""Plan introspection + roofline cost model (EXPLAIN / EXPLAIN
+ANALYZE for the distributed join; docs/OBSERVABILITY.md "Explain &
+cost model").
+
+- :mod:`.plan` — :class:`JoinPlan` / :func:`build_plan` /
+  :func:`explain_join`: the fully-resolved program description
+  (capacities, wire bytes, HBM footprint, canonical cache-key digest)
+  from table shapes alone, with zero traces or compiles;
+- :mod:`.cost` — :class:`CostModel` / :func:`predict`: ROOFLINE.md's
+  measured per-primitive costs as an executable per-stage wall-time
+  predictor, graded post-run by ``analyze explain`` and the
+  workload-history store.
+"""
+
+from distributed_join_tpu.planning.cost import (
+    COST_MODEL_VERSION,
+    DEFAULT_COST_MODEL,
+    DEFAULT_PREDICTION_BAND,
+    CostModel,
+    predict,
+    predict_exchange,
+)
+from distributed_join_tpu.planning.plan import (
+    EXPLAIN_SCHEMA_VERSION,
+    JoinPlan,
+    SidePlan,
+    abstract_tables,
+    build_exchange_plan,
+    build_plan,
+    explain_join,
+)
+
+__all__ = [
+    "COST_MODEL_VERSION",
+    "DEFAULT_COST_MODEL",
+    "DEFAULT_PREDICTION_BAND",
+    "EXPLAIN_SCHEMA_VERSION",
+    "CostModel",
+    "JoinPlan",
+    "SidePlan",
+    "abstract_tables",
+    "build_exchange_plan",
+    "build_plan",
+    "explain_join",
+    "predict",
+    "predict_exchange",
+]

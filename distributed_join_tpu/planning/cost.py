@@ -1,0 +1,244 @@
+"""The roofline cost model — ROOFLINE.md's measured numbers, in code.
+
+``docs/ROOFLINE.md`` §1 measured what every primitive of the join
+pipeline costs on a real v5e (sorts ~7 ns/element with value lanes
+riding ~free, random gathers a serialized ~10-21 ns/element loop,
+scans ~2 ns/element, the Pallas expand ~11-16 ns/output-row), and §6-8
+refined the per-stage split (sort 138 ms / compaction 116 ms / expand
+80 ms of a 20M-element 360 ms join). Until this module those numbers
+lived only in a doc; :class:`CostModel` makes them an executable
+predictor over a :class:`~.plan.JoinPlan`: per-stage wall seconds and
+the derived rows/s, before anything traces or compiles.
+
+Honesty contract:
+
+- Every constant is either MEASURED (named row of ROOFLINE.md §1/§6,
+  chip wall clocks) or SPEC-DERIVED (the ICI bandwidth — this
+  environment exposes one chip, so the all-to-all has never been
+  measured on real ICI; ROADMAP item 1's hardware session is the
+  calibration path). ``CostModel.provenance`` says which is which.
+- Predictions model the **v5e roofline**, not whatever backend the
+  process happens to run on. On the 8-virtual-device CPU mesh the
+  predicted WALL is deliberately wrong (emulation measures the host);
+  the predicted WIRE BYTES are exact in padded/compressed modes and
+  are gated in CI. ``analyze explain`` grades both against measured
+  counters after a run, and the workload-history store records the
+  error per workload signature so the autotuner (ROADMAP item 5)
+  learns where this model lies.
+
+Everything here is plain host arithmetic — no jax import, no device
+touch, deterministic to the byte (the explain artifact is
+byte-identical across runs of the same query spec).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+COST_MODEL_VERSION = 1
+
+# Prediction band for grading: a measured wall within
+# [predicted / BAND, predicted * BAND] is "inside the model"; outside
+# means the model (or the machine) moved for that workload. Wide on
+# purpose — the model is a v5e roofline, and §5-8 of ROOFLINE.md show
+# real implementations landing within ~1.5-4x of primitive floors.
+DEFAULT_PREDICTION_BAND = 4.0
+
+
+@dataclasses.dataclass(frozen=True)
+class CostModel:
+    """Per-primitive costs (ns/element unless noted), v5e.
+
+    Measured constants cite their ROOFLINE.md row; spec-derived ones
+    say so. Replace any field and re-run ``predict`` — the explain
+    artifact embeds the constants used, so a graded run is always
+    attributable to one concrete model.
+    """
+
+    # lax.sort, 20M x (i64 key + small lanes): 139-168 ms (§1, §6).
+    sort_ns_per_elem: float = 7.0
+    # each extra i64 value lane on a 139 ms sort: +6 ms (§1).
+    sort_lane_ns_per_elem: float = 0.3
+    # cumsum/cummax 20M i32: 30-43 ms (§1).
+    scan_ns_per_elem: float = 2.0
+    # random gather, any index order: 161-205 ms / 7.5M i64 (§1).
+    gather_ns_per_elem: float = 21.0
+    # packed (rows, k<=4) row gather: ~110 ms / 7.5M rows (§1 fact 3).
+    row_gather_ns_per_row: float = 14.7
+    # log-shift plane compaction: 116 ms / 20M merged elements (§6).
+    compact_ns_per_elem: float = 5.8
+    # Pallas streaming expand: ~80 ms / 7.5M output rows (§6).
+    expand_ns_per_out_row: float = 10.7
+    # sequential HBM stream (§1 fact 2's contrast case).
+    hbm_bytes_per_s: float = 8.0e11
+    # FoR+bitpack codec encode/decode: 26/28 GB/s (BASELINE.md row 18).
+    codec_bytes_per_s: float = 2.6e10
+    # SPEC-DERIVED: v5e ICI per-chip all-to-all egress. Never measured
+    # here (one-chip environment; BASELINE.md row 17) — recalibrate
+    # from the first real `tpu-all-to-all` session (ROADMAP item 1).
+    ici_bytes_per_s: float = 4.5e10
+    # per-collective dispatch/sync overhead (spec-derived order).
+    collective_latency_s: float = 2.0e-5
+    # v5e HBM per chip, for the footprint verdict (16 GiB).
+    hbm_capacity_bytes: int = 16 * 1024**3
+
+    @property
+    def provenance(self) -> dict:
+        return {
+            "measured": [
+                "sort_ns_per_elem", "sort_lane_ns_per_elem",
+                "scan_ns_per_elem", "gather_ns_per_elem",
+                "row_gather_ns_per_row", "compact_ns_per_elem",
+                "expand_ns_per_out_row", "hbm_bytes_per_s",
+                "codec_bytes_per_s",
+            ],
+            "spec_derived": [
+                "ici_bytes_per_s", "collective_latency_s",
+                "hbm_capacity_bytes",
+            ],
+            "source": "docs/ROOFLINE.md §1/§6; BASELINE.md",
+        }
+
+    def as_record(self) -> dict:
+        rec = dataclasses.asdict(self)
+        rec["model_version"] = COST_MODEL_VERSION
+        rec["provenance"] = self.provenance
+        return rec
+
+
+DEFAULT_COST_MODEL = CostModel()
+
+
+def _round_s(x: float) -> float:
+    """Deterministic second-rounding for the artifact (9 digits keeps
+    ns resolution without float repr jitter)."""
+    return round(float(x), 9)
+
+
+def predict(plan, model: Optional[CostModel] = None) -> dict:
+    """Per-stage predicted wall seconds (per rank — the pipeline is
+    symmetric, so per-rank == critical path) for one join step plus
+    the derived throughput. ``plan`` is a :class:`~.plan.JoinPlan`.
+
+    Stage decomposition mirrors make_join_step: partition (one
+    bucket sort per side + the to_padded gathers), shuffle (wire
+    bytes over ICI + per-collective latency + codec time when
+    compression is on), join per batch (merged sort + scans +
+    compaction over the merged domain, expand over the output block),
+    and the skew sidecar when the PRPD path is on.
+    """
+    m = model or DEFAULT_COST_MODEL
+    n = plan.n_ranks
+    k = plan.over_decomposition
+    ns = 1e-9
+
+    b_local = plan.build.rows_local
+    p_local = plan.probe.rows_local
+    b_cols = max(len(plan.build.columns), 1)
+    p_cols = max(len(plan.probe.columns), 1)
+
+    single = plan.n_buckets == 1
+    # Rows materialized into the shuffle layout per side (k batches of
+    # the n x cap padded block; ragged ships the same rows unpadded).
+    b_shipped = 0 if single else k * n * plan.capacities["shuffle_build_per_bucket"]
+    p_shipped = 0 if single else k * n * plan.capacities["shuffle_probe_per_bucket"]
+
+    # -- partition: bucket sort (2 int32 lanes) + one composed gather
+    # per column into the padded/ragged layout.
+    if single:
+        partition_s = 0.0
+    else:
+        partition_s = ns * (
+            (b_local + p_local) * m.sort_ns_per_elem
+            + b_shipped * m.row_gather_ns_per_row * _col_groups(b_cols)
+            + p_shipped * m.row_gather_ns_per_row * _col_groups(p_cols)
+        )
+
+    # -- shuffle: off-chip bytes at ICI bandwidth + dispatch latency.
+    if single:
+        shuffle_s = 0.0
+    else:
+        wire_rank = (plan.wire["build"]["bytes_per_rank"]
+                     + plan.wire["probe"]["bytes_per_rank"])
+        offchip = wire_rank * (n - 1) / n
+        shuffle_s = (offchip / m.ici_bytes_per_s
+                     + plan.wire["collectives_per_step"]
+                     * m.collective_latency_s)
+        if plan.compression_bits is not None:
+            # encode + decode of the raw (uncompressed) block bytes.
+            raw = (plan.wire["build"].get("raw_bytes_per_rank", 0)
+                   + plan.wire["probe"].get("raw_bytes_per_rank", 0))
+            shuffle_s += 2.0 * raw / m.codec_bytes_per_s
+
+    # -- local join, per batch: sort both received sides into the
+    # merged domain, scans + compaction over it, expand the output.
+    if single:
+        merged = b_local + p_local
+        out_total = plan.capacities["out_rows_per_batch"]
+        batches = 1
+    else:
+        merged = (n * plan.capacities["shuffle_build_per_bucket"]
+                  + n * plan.capacities["shuffle_probe_per_bucket"])
+        out_total = plan.capacities["out_rows_per_batch"]
+        batches = k
+    join_s = batches * ns * (
+        merged * (m.sort_ns_per_elem
+                  + m.sort_lane_ns_per_elem * 2
+                  + m.scan_ns_per_elem
+                  + m.compact_ns_per_elem)
+        + out_total * m.expand_ns_per_out_row
+    )
+
+    # -- skew sidecar: HH detection scans the probe keys; the HH join
+    # runs over the compacted HH blocks.
+    skew_s = 0.0
+    if plan.skew is not None:
+        hh_rows = (plan.capacities.get("hh_build") or 0) * n \
+            + (plan.capacities.get("hh_probe") or 0)
+        skew_s = ns * (
+            (b_local + p_local) * m.scan_ns_per_elem
+            + hh_rows * m.sort_ns_per_elem
+            + (plan.capacities.get("hh_out") or 0)
+            * m.expand_ns_per_out_row
+        )
+
+    total = partition_s + shuffle_s + join_s + skew_s
+    rows = plan.build.rows_global + plan.probe.rows_global
+    return {
+        "model": m.as_record(),
+        "platform": "tpu-v5e-roofline",
+        "stages": {
+            "partition": _round_s(partition_s),
+            "shuffle": _round_s(shuffle_s),
+            "join": _round_s(join_s),
+            "skew": _round_s(skew_s),
+        },
+        "total_s": _round_s(total),
+        "predicted_rows_per_sec": _round_s(rows / total) if total else None,
+        "predicted_m_rows_per_sec_per_rank": (
+            _round_s(rows / total / 1e6 / n) if total else None),
+    }
+
+
+def _col_groups(n_cols: int) -> float:
+    """ROOFLINE §1 fact 3: a packed row gather is flat in k for k <= 4
+    columns, so materialization pays one gather per group of 4."""
+    return max((n_cols + 3) // 4, 1)
+
+
+def predict_exchange(n_ranks: int, bytes_per_rank: int,
+                     model: Optional[CostModel] = None) -> dict:
+    """The all_to_all microbenchmark's reduced prediction: one
+    fixed-size exchange (`tpu-all-to-all`'s --explain)."""
+    m = model or DEFAULT_COST_MODEL
+    offchip = bytes_per_rank * (n_ranks - 1) / n_ranks
+    total = offchip / m.ici_bytes_per_s + m.collective_latency_s
+    return {
+        "model": m.as_record(),
+        "platform": "tpu-v5e-roofline",
+        "stages": {"all_to_all": _round_s(total)},
+        "total_s": _round_s(total),
+        "predicted_aggregate_offchip_gb_per_sec": _round_s(
+            n_ranks * offchip / total / 1e9),
+    }

@@ -1,0 +1,619 @@
+"""EXPLAIN for the distributed join: the plan, before any execution.
+
+``distributed_inner_join`` resolves a dozen knobs per query — shuffle
+mode, over-decomposition, the full capacity contract (every ladder
+rung's sizing), skew policy, compression, telemetry/integrity switches
+— but until now none of that was visible before a run had already paid
+trace + compile. A :class:`JoinPlan` materializes the whole resolution
+as a structured, inspectable record from nothing but table SHAPES and
+options: per-bucket capacities, per-rank and total wire bytes, the HBM
+footprint, the skew sidecar sizing, and the canonical program identity.
+
+Two agreement contracts, both load-bearing:
+
+- **Plan == cache key.** The plan's identity is
+  :class:`~..service.programs.JoinSignature` — the SAME canonical
+  resolution the serving program cache keys executables under — so an
+  EXPLAIN's digest and the executable a run would dispatch can never
+  disagree (tests/test_explain.py locks digest equality against a
+  real cached run).
+- **Padded wire bytes are exact.** The padded (and compressed) shuffle
+  moves static-shaped blocks, so the predicted ``wire_bytes`` equals
+  the measured device counter (``build.wire_bytes`` /
+  ``probe.wire_bytes`` in the :class:`~..telemetry.metrics.Metrics`
+  block) to the byte — a hard CI gate (``analyze explain
+  --gate-wire-bytes``), not a dashboard estimate. Ragged mode ships
+  actual rows, so its byte prediction is an upper-bound estimate and
+  says so (``wire.exact = false``).
+
+Everything here is host arithmetic over shapes: building a plan
+traces nothing, compiles nothing, and touches no device — the
+admission-free ``explain`` wire op of the join service dry-runs a
+query spec through exactly this path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Optional
+
+from distributed_join_tpu.planning.cost import (
+    CostModel,
+    predict,
+    predict_exchange,
+)
+
+EXPLAIN_SCHEMA_VERSION = 1
+
+_DTYPE_BYTES = {
+    "bool": 1, "int8": 1, "uint8": 1, "int16": 2, "uint16": 2,
+    "int32": 4, "uint32": 4, "float32": 4, "int64": 8, "uint64": 8,
+    "float64": 8,
+}
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _itemsize(dtype: str) -> int:
+    try:
+        return _DTYPE_BYTES[dtype]
+    except KeyError:
+        raise ValueError(f"unknown dtype {dtype!r} in plan schema")
+
+
+def _row_bytes(columns) -> int:
+    """Fixed-width wire bytes per row over (name, dtype, trailing)."""
+    total = 0
+    for _, dtype, trailing in columns:
+        total += _itemsize(dtype) * math.prod(trailing or (1,))
+    return total
+
+
+@dataclasses.dataclass(frozen=True)
+class SidePlan:
+    """One side's shape story: the columns that actually ride the
+    partition + shuffle (post string-key packing), global/local rows,
+    and the fixed row width on the wire."""
+
+    rows_global: int
+    rows_local: int
+    columns: tuple            # ((name, dtype, trailing), ...) sorted
+    varwidth: tuple           # byte-exact-eligible names (ragged mode)
+    row_bytes: int            # fixed-width bytes/row incl. varwidth
+    row_bytes_fixed: int      # bytes/row excluding varwidth columns
+
+    def as_record(self) -> dict:
+        return {
+            "rows_global": self.rows_global,
+            "rows_local": self.rows_local,
+            "columns": [list(c) for c in self.columns],
+            "varwidth": list(self.varwidth),
+            "row_bytes": self.row_bytes,
+            "row_bytes_fixed": self.row_bytes_fixed,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinPlan:
+    """The fully-resolved, pre-execution description of one join
+    program. ``digest`` is the program-cache signature digest — the
+    plan IS the cache key, rendered human-readable."""
+
+    digest: str
+    n_ranks: int
+    over_decomposition: int
+    key: tuple
+    shuffle: str
+    compression_bits: Optional[int]
+    with_metrics: bool
+    with_integrity: bool
+    build: SidePlan
+    probe: SidePlan
+    capacities: dict
+    skew: Optional[dict]
+    wire: dict
+    memory: dict
+    resolved_options: dict
+    cost: dict
+
+    @property
+    def n_buckets(self) -> int:
+        return self.n_ranks * self.over_decomposition
+
+    def as_record(self) -> dict:
+        return {
+            "pipeline": "join",
+            "signature_digest": self.digest,
+            "n_ranks": self.n_ranks,
+            "over_decomposition": self.over_decomposition,
+            "n_buckets": self.n_buckets,
+            "key": list(self.key),
+            "shuffle": self.shuffle,
+            "compression_bits": self.compression_bits,
+            "with_metrics": self.with_metrics,
+            "with_integrity": self.with_integrity,
+            "build": self.build.as_record(),
+            "probe": self.probe.as_record(),
+            "capacities": dict(self.capacities),
+            "skew": self.skew,
+            "wire": self.wire,
+            "memory": self.memory,
+            "resolved_options": self.resolved_options,
+        }
+
+    def explain_record(self) -> dict:
+        """The ``explain.json`` artifact body — deliberately free of
+        timestamps so the same query spec yields byte-identical
+        output (the determinism gate)."""
+        return {
+            "schema_version": EXPLAIN_SCHEMA_VERSION,
+            "kind": "explain",
+            "plan": self.as_record(),
+            "cost": self.cost,
+        }
+
+    def format(self) -> str:
+        """Human rendering (the drivers' --explain stderr line set)."""
+        c = self.capacities
+        w = self.wire
+        lines = [
+            f"plan {self.digest[:16]}: {self.shuffle} shuffle, "
+            f"{self.n_ranks} rank(s) x k={self.over_decomposition}"
+            + (f", compression_bits={self.compression_bits}"
+               if self.compression_bits is not None else ""),
+            f"  build {self.build.rows_global} rows "
+            f"({self.build.row_bytes} B/row) | probe "
+            f"{self.probe.rows_global} rows "
+            f"({self.probe.row_bytes} B/row)",
+            f"  capacities: shuffle {c['shuffle_build_per_bucket']}/"
+            f"{c['shuffle_probe_per_bucket']} rows/bucket, out "
+            f"{c['out_rows_per_batch']} rows/batch",
+            f"  wire: build {w['build']['bytes_total']} B, probe "
+            f"{w['probe']['bytes_total']} B "
+            f"({'EXACT' if w['exact'] else 'estimate'})",
+            f"  memory/rank: {self.memory['total_per_rank_bytes']} B"
+            + ("" if self.memory["fits_hbm"] else
+               "  [EXCEEDS v5e HBM]"),
+            f"  predicted: {self.cost['total_s']}s "
+            f"({self.cost['predicted_m_rows_per_sec_per_rank']} "
+            "M rows/s/rank, v5e roofline)",
+        ]
+        if self.skew is not None:
+            lines.insert(3, f"  skew: threshold="
+                            f"{self.skew['threshold']}, hh "
+                            f"{c.get('hh_build')}/{c.get('hh_probe')}/"
+                            f"{c.get('hh_out')}")
+        return "\n".join(lines)
+
+
+# -- schema resolution (the host-side mirror of the step's key prep) --
+
+
+def _schema_cols(table) -> dict:
+    """{name: (dtype_str, trailing_shape)} of a Table (or a Table of
+    ShapeDtypeStructs) — shape metadata only, no data touch."""
+    return {
+        name: (str(c.dtype), tuple(int(d) for d in c.shape[1:]))
+        for name, c in table.columns.items()
+    }
+
+
+def _wire_schemas(build, probe, keys, build_payload, probe_payload):
+    """The columns each side actually partitions + shuffles, after
+    ``utils.strings.prepare_string_key_join``'s packing — mirrored at
+    the SHAPE level so planning never executes the packing itself.
+    Returns (build_cols, probe_cols, keys_eff) with cols as sorted
+    ((name, dtype, trailing), ...) tuples."""
+    from distributed_join_tpu.utils.strings import (
+        LEN_SUFFIX,
+        string_key_word_names,
+    )
+
+    bcols = _schema_cols(build)
+    pcols = _schema_cols(probe)
+    str_keys = [k for k in keys if len(bcols[k][1]) == 1]
+    if not str_keys:
+        return (_sorted_cols(bcols), _sorted_cols(pcols), tuple(keys))
+    drop = {k + LEN_SUFFIX for k in str_keys}
+    if build_payload is None:
+        build_payload = [n for n in bcols
+                         if n not in keys and n not in drop]
+    keys_eff = []
+    for i, k in enumerate(keys):
+        dtype, trailing = bcols[k]
+        if len(trailing) != 1:
+            keys_eff.append(k)
+            continue
+        # split_string_keys: the 2-D uint8 key becomes big-endian
+        # uint64 word columns on BOTH sides; byte column dropped.
+        n_words = (trailing[0] + 7) // 8
+        word_names = string_key_word_names(i, n_words)
+        for nm in word_names:
+            bcols[nm] = ("uint64", ())
+            pcols[nm] = ("uint64", ())
+        del bcols[k], pcols[k]
+        keys_eff.extend(word_names)
+    keep_b = set(keys_eff) | set(build_payload)
+    bcols = {n: v for n, v in bcols.items() if n in keep_b}
+    return (_sorted_cols(bcols), _sorted_cols(pcols), tuple(keys_eff))
+
+
+def _sorted_cols(cols: dict) -> tuple:
+    return tuple(sorted(
+        (name, dtype, trailing)
+        for name, (dtype, trailing) in cols.items()
+    ))
+
+
+def _varwidth_names(columns) -> tuple:
+    """Mirror of distributed_join._varwidth_cols over plan schema:
+    2-D uint8 columns with a 4-aligned width and a '#len' companion
+    (the byte-exact ragged wire's eligibility rule)."""
+    names = {name for name, _, _ in columns}
+    return tuple(
+        name for name, dtype, trailing in columns
+        if dtype == "uint8" and len(trailing) == 1
+        and trailing[0] % 4 == 0 and name + "#len" in names
+    )
+
+
+# -- wire-byte prediction ---------------------------------------------
+
+
+_COMPRESSION_BLOCK = 256   # shuffle_padded_compressed's block default
+
+
+def _padded_side_bytes(n: int, k: int, cap: int, columns,
+                       compression_bits: Optional[int]):
+    """Per-rank wire bytes for one side across all k batches of the
+    padded/ppermute shuffle — EXACTLY what the shuffle's MetricsTape
+    bills (``shuffle_padded``/``shuffle_padded_compressed``): the full
+    static (n, cap) block per column, pad included. Returns
+    (sent_bytes_per_rank, raw_bytes_per_rank)."""
+    from distributed_join_tpu.utils.strings import _WORD_PREFIX
+
+    raw = sent = 0
+    for name, dtype, trailing in columns:
+        isz = _itemsize(dtype)
+        col_bytes = n * cap * isz * math.prod(trailing or (1,))
+        raw += col_bytes
+        if compression_bits is None:
+            sent += col_bytes
+            continue
+        compressible = (
+            not trailing
+            and dtype in ("int32", "uint32", "int64", "uint64")
+            and not name.startswith(_WORD_PREFIX)
+        )
+        if not compressible:
+            sent += col_bytes
+            continue
+        # for_bitpack_encode on each destination's cap-length row:
+        # word plane = n_pad * bits/8 bytes, frame plane = one int64
+        # per block (ops/compression.py; block=256 on this path).
+        n_pad = _round_up(max(cap, 1), _COMPRESSION_BLOCK)
+        per_dest = (n_pad * compression_bits // 8
+                    + (n_pad // _COMPRESSION_BLOCK) * 8)
+        sent += n * per_dest
+    return k * sent, k * raw
+
+
+def _predict_wire(n: int, k: int, shuffle: str,
+                  compression_bits: Optional[int],
+                  build: SidePlan, probe: SidePlan,
+                  b_cap: int, p_cap: int) -> dict:
+    single = n * k == 1
+    if single:
+        zero = {"bytes_per_rank": 0, "bytes_total": 0,
+                "rows_estimate": 0}
+        return {"exact": True, "build": dict(zero),
+                "probe": dict(zero), "collectives_per_step": 0}
+    sides = {}
+    exact = shuffle in ("padded", "ppermute")
+    for side, cap in (("build", b_cap), ("probe", p_cap)):
+        sp = build if side == "build" else probe
+        if shuffle == "ragged":
+            # Exact-size exchange: fixed-width bytes for actual rows
+            # (assume every row valid — an upper bound on a masked
+            # table) plus the varwidth planes at full width (upper
+            # bound; real lengths only exist at run time).
+            vw_bytes = sp.row_bytes - sp.row_bytes_fixed
+            per_rank = sp.rows_local * sp.row_bytes_fixed \
+                + sp.rows_local * vw_bytes
+            raw = per_rank
+        else:
+            per_rank, raw = _padded_side_bytes(
+                n, k, cap, sp.columns, compression_bits)
+        sides[side] = {
+            "bytes_per_rank": int(per_rank),
+            "bytes_total": int(per_rank) * n,
+            "rows_estimate": sp.rows_local * n,
+        }
+        if compression_bits is not None:
+            sides[side]["raw_bytes_per_rank"] = int(raw)
+    # Data-plane collectives per compiled step: per batch per side one
+    # count exchange + one collective per column (compressed integer
+    # columns ride as two planes).
+    coll = 0
+    for sp in (build, probe):
+        per_col = 2 if compression_bits is not None else 1
+        coll += k * (1 + per_col * len(sp.columns))
+    return {"exact": exact, "build": sides["build"],
+            "probe": sides["probe"], "collectives_per_step": coll}
+
+
+# -- the builder ------------------------------------------------------
+
+
+def build_plan(comm, build, probe, key="key", with_metrics=None,
+               cost_model: Optional[CostModel] = None,
+               **opts) -> JoinPlan:
+    """Materialize the :class:`JoinPlan` for exactly the program
+    ``make_join_step(comm, key=key, **opts)`` would compile over these
+    tables — without tracing or compiling anything.
+
+    ``build``/``probe`` are Tables (real arrays or ShapeDtypeStructs
+    — only shapes/dtypes are read). ``with_metrics=None`` resolves
+    from the telemetry session exactly as ``make_distributed_join``
+    and the program cache do, so the plan digest equals the cache key
+    of the run it predicts. Unknown options raise the same loud
+    TypeError the signature layer raises.
+    """
+    from distributed_join_tpu import telemetry
+    from distributed_join_tpu.service.programs import JoinSignature
+
+    if with_metrics is None:
+        with_metrics = telemetry.enabled()
+    keys = [key] if isinstance(key, str) else list(key)
+    sig = JoinSignature.of(comm, build, probe, key=key,
+                           with_metrics=with_metrics, **opts)
+    resolved = dict(sig.options)
+
+    n = sig.n_ranks
+    k = int(resolved.get("over_decomposition") or 1)
+    nb = n * k
+    shuffle = resolved.get("shuffle") or "padded"
+    comp_bits = resolved.get("compression_bits")
+    # A plan must describe a program that could compile — mirror
+    # make_join_step's option validation so an EXPLAIN of a config
+    # the join would reject is the same loud error, not a plausible-
+    # looking plan for nothing.
+    if k < 1:
+        raise ValueError("over_decomposition must be >= 1")
+    if shuffle not in ("padded", "ragged", "ppermute"):
+        raise ValueError(f"unknown shuffle mode {shuffle!r}")
+    if comp_bits is not None and shuffle == "ragged":
+        raise ValueError(
+            "compression applies to the padded/ppermute shuffles; the "
+            "ragged exchange already sends exact rows (combining the "
+            "two is unimplemented)"
+        )
+    shuffle_f = float(resolved["shuffle_capacity_factor"])
+    out_f = float(resolved["out_capacity_factor"])
+    out_rows = resolved.get("out_rows_per_rank")
+
+    b_global, p_global = sig.build_capacity, sig.probe_capacity
+    b_local, p_local = b_global // n, p_global // n
+
+    wb, wp, keys_eff = _wire_schemas(
+        build, probe, keys,
+        resolved.get("build_payload"), resolved.get("probe_payload"))
+    vb = _varwidth_names(wb) if shuffle == "ragged" else ()
+    vp = _varwidth_names(wp) if shuffle == "ragged" else ()
+    side_b = SidePlan(
+        rows_global=b_global, rows_local=b_local, columns=wb,
+        varwidth=vb, row_bytes=_row_bytes(wb),
+        row_bytes_fixed=_row_bytes(
+            [c for c in wb if c[0] not in vb]),
+    )
+    side_p = SidePlan(
+        rows_global=p_global, rows_local=p_local, columns=wp,
+        varwidth=vp, row_bytes=_row_bytes(wp),
+        row_bytes_fixed=_row_bytes(
+            [c for c in wp if c[0] not in vp]),
+    )
+
+    # Capacity arithmetic, verbatim from make_join_step (float order
+    # included — the exact-gate depends on it).
+    b_cap = _round_up(int(math.ceil(b_local / nb * shuffle_f)), 8)
+    p_cap = _round_up(int(math.ceil(p_local / nb * shuffle_f)), 8)
+    if out_rows is not None:
+        out_cap = _round_up(int(math.ceil(int(out_rows) / k)), 8)
+    else:
+        out_cap = _round_up(int(math.ceil(p_local / k * out_f)), 8)
+    capacities = {
+        "shuffle_build_per_bucket": b_cap,
+        "shuffle_probe_per_bucket": p_cap,
+        "out_rows_per_batch": out_cap,
+        "shuffle_capacity_factor": shuffle_f,
+        "out_capacity_factor": out_f,
+        "out_rows_per_rank": out_rows,
+    }
+
+    skew = None
+    if resolved.get("skew_threshold") is not None:
+        hh_slots = int(resolved.get("hh_slots") or 64)
+        hh_build = resolved.get("hh_build_capacity") or hh_slots * 32
+        hh_probe = _round_up(
+            int(resolved.get("hh_probe_capacity")
+                or max(p_local // 8, 1024)), 8)
+        hh_out = int(resolved.get("hh_out_capacity")
+                     or max(p_local // 4, 1024))
+        capacities.update(hh_build=int(hh_build), hh_probe=hh_probe,
+                          hh_out=hh_out)
+        skew = {"threshold": resolved["skew_threshold"],
+                "hh_slots": hh_slots}
+
+    wire = _predict_wire(n, k, shuffle, comp_bits, side_b, side_p,
+                         b_cap, p_cap)
+
+    model = cost_model or CostModel()
+    memory = _predict_memory(n, k, side_b, side_p, b_cap, p_cap,
+                             out_cap, capacities, model)
+
+    plan = JoinPlan(
+        digest=sig.digest(),
+        n_ranks=n,
+        over_decomposition=k,
+        key=tuple(keys_eff),
+        shuffle=shuffle,
+        compression_bits=comp_bits,
+        with_metrics=bool(with_metrics),
+        with_integrity=bool(resolved.get("with_integrity")),
+        build=side_b,
+        probe=side_p,
+        capacities=capacities,
+        skew=skew,
+        wire=wire,
+        memory=memory,
+        resolved_options=_jsonable(resolved),
+        cost={},
+    )
+    # cost needs the assembled plan; frozen dataclass -> rebuild field.
+    object.__setattr__(plan, "cost", predict(plan, model))
+    return plan
+
+
+def _predict_memory(n, k, side_b, side_p, b_cap, p_cap, out_cap,
+                    capacities, model: CostModel) -> dict:
+    """Per-rank HBM footprint of the resident arrays the step
+    materializes: the local table shards, one batch's shuffle
+    send/recv blocks per side, and the k output blocks. A roofline
+    bound (working-set copies during sorts are not modeled)."""
+    input_b = (side_b.rows_local * side_b.row_bytes
+               + side_p.rows_local * side_p.row_bytes)
+    shuffle_b = 2 * n * (b_cap * side_b.row_bytes
+                         + p_cap * side_p.row_bytes)
+    out_row_bytes = side_b.row_bytes + side_p.row_bytes
+    output_b = k * out_cap * out_row_bytes
+    hh_b = 0
+    if "hh_build" in capacities:
+        hh_b = (capacities["hh_build"] * side_b.row_bytes
+                + capacities["hh_probe"] * side_p.row_bytes
+                + capacities["hh_out"] * out_row_bytes)
+    total = input_b + shuffle_b + output_b + hh_b
+    return {
+        "per_rank_bytes": {
+            "input": int(input_b),
+            "shuffle_blocks": int(shuffle_b),
+            "output_blocks": int(output_b),
+            "skew_blocks": int(hh_b),
+        },
+        "total_per_rank_bytes": int(total),
+        "hbm_capacity_bytes": int(model.hbm_capacity_bytes),
+        "fits_hbm": bool(total < model.hbm_capacity_bytes),
+    }
+
+
+def _jsonable(obj):
+    """Canonical-options tuples -> JSON-stable lists/dicts."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in sorted(obj.items())}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    return repr(obj)
+
+
+# -- dry-run surfaces --------------------------------------------------
+
+
+def abstract_tables(build_rows: int, probe_rows: int,
+                    key_dtype: str = "int64",
+                    payload_dtype: str = "int64"):
+    """Abstract (ShapeDtypeStruct) build/probe Tables matching the
+    generator drivers' schema — the service ``explain`` op's dry-run
+    inputs. No device, no data; shapes only."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_join_tpu.table import Table
+
+    def tbl(rows, payload_name):
+        return Table(
+            {"key": jax.ShapeDtypeStruct((rows,),
+                                         jnp.dtype(key_dtype)),
+             payload_name: jax.ShapeDtypeStruct(
+                 (rows,), jnp.dtype(payload_dtype))},
+            jax.ShapeDtypeStruct((rows,), jnp.bool_),
+        )
+
+    return (tbl(build_rows, "build_payload"),
+            tbl(probe_rows, "probe_payload"))
+
+
+def explain_join(build, probe, comm, key="key",
+                 verify_integrity: bool = False,
+                 cost_model: Optional[CostModel] = None,
+                 **opts) -> JoinPlan:
+    """Dry-run ``distributed_inner_join``'s knob resolution — padding,
+    capacity-factor defaults, skew-capacity resolution, the ladder's
+    INITIAL rung — and return the plan, executing nothing. The library
+    EXPLAIN surface; ``distributed_inner_join(explain=True)`` attaches
+    the same plan (final rung) to its result."""
+    from distributed_join_tpu.parallel.distributed_join import (
+        resolve_join_ladder,
+    )
+    from distributed_join_tpu.table import Table
+
+    n = comm.n_ranks
+
+    def padded(table):
+        cap = table.capacity
+        target = _round_up(cap, n)
+        if target == cap:
+            return table
+        import jax
+
+        # Abstract pad: mirror Table.pad_to at the shape level so a
+        # dry-run never concatenates real arrays.
+        cols = {
+            name: jax.ShapeDtypeStruct((target,) + tuple(c.shape[1:]),
+                                       c.dtype)
+            for name, c in table.columns.items()
+        }
+        return Table(cols, jax.ShapeDtypeStruct((target,), bool))
+
+    build, probe = padded(build), padded(probe)
+    opts = dict(opts)
+    ladder = resolve_join_ladder(build, probe, n, opts)
+    return build_plan(
+        comm, build, probe, key=key,
+        with_integrity=verify_integrity,
+        metrics_static={"retry_attempt_max": 0},
+        cost_model=cost_model,
+        **ladder.sizing(), **opts)
+
+
+def build_exchange_plan(n_ranks: int, buffer_bytes_per_rank: int,
+                        cost_model: Optional[CostModel] = None) -> dict:
+    """The all_to_all microbenchmark's explain artifact (no join
+    pipeline — one fixed-size exchange)."""
+    import hashlib
+
+    body = {
+        "pipeline": "all_to_all",
+        "n_ranks": int(n_ranks),
+        "buffer_bytes_per_rank": int(buffer_bytes_per_rank),
+        "wire": {
+            "exact": True,
+            "bytes_per_rank": int(buffer_bytes_per_rank),
+            "bytes_total": int(buffer_bytes_per_rank) * int(n_ranks),
+            "offchip_bytes_per_rank": int(
+                buffer_bytes_per_rank * (n_ranks - 1) // n_ranks),
+        },
+    }
+    body["signature_digest"] = hashlib.sha256(
+        json.dumps(body, sort_keys=True).encode()).hexdigest()
+    return {
+        "schema_version": EXPLAIN_SCHEMA_VERSION,
+        "kind": "explain",
+        "plan": body,
+        "cost": predict_exchange(n_ranks, buffer_bytes_per_rank,
+                                 cost_model),
+    }

@@ -200,20 +200,32 @@ class JoinProgramCache:
         self.misses = 0
         self.traces = 0
         self.disk_loads = 0
+        self.disk_load_failures = 0
+        self.disk_persists = 0
         self.lru_evictions = 0
+        self.integrity_evictions = 0
 
     def __len__(self) -> int:
         return len(self._entries)
 
     def stats(self) -> dict:
+        """Operator-facing occupancy + tier counters (the ``stats``
+        wire op and Prometheus exposition surface every field —
+        PR 6's LRU bound and disk tier are invisible otherwise)."""
         return {
             "entries": len(self._entries),
             "max_entries": self.max_entries,
+            "occupancy": (round(len(self._entries) / self.max_entries,
+                                4)
+                          if self.max_entries else None),
             "hits": self.hits,
             "misses": self.misses,
             "traces": self.traces,
             "disk_loads": self.disk_loads,
+            "disk_load_failures": self.disk_load_failures,
+            "disk_persists": self.disk_persists,
             "lru_evictions": self.lru_evictions,
+            "integrity_evictions": self.integrity_evictions,
         }
 
     def signature(self, build, probe, with_metrics=None,
@@ -254,12 +266,36 @@ class JoinProgramCache:
                             entries=len(self._entries))
         return entry, False
 
-    def evict(self, signature: JoinSignature) -> bool:
+    def predict_hit(self, digest: str) -> dict:
+        """Cache-hit prediction for a plan digest (the ``explain``
+        wire op's dry-run verdict): would this signature dispatch a
+        resident executable, rehydrate a persisted blob, or pay a
+        fresh trace? Read-only — never loads or traces."""
+        # Snapshot before iterating: the explain wire op calls this
+        # WITHOUT the service exec lock, and a concurrent join may be
+        # inserting/evicting entries (dict-changed-size mid-iteration
+        # otherwise; a momentarily stale verdict is fine, a crash not).
+        resident = any(sig.digest() == digest
+                       for sig in list(self._entries))
+        persisted = bool(
+            self.persist_dir is not None
+            and os.path.exists(os.path.join(
+                self.persist_dir, digest + PROGRAM_SUFFIX)))
+        return {
+            "resident": resident,
+            "persisted": persisted,
+            "would_trace": not (resident or persisted),
+        }
+
+    def evict(self, signature: JoinSignature,
+              reason: str = "integrity") -> bool:
         """Drop one entry (memory AND its disk blob). The integrity
         retry rung uses this: a wire-corruption verdict taints the
         resident program — injected corruption is woven at trace time,
         so only a RE-trace is guaranteed to face a fresh schedule —
-        and the corrupt-adjacent blob must not be reloaded either."""
+        and the corrupt-adjacent blob must not be reloaded either.
+        ``reason="integrity"`` (the only production caller) is counted
+        so operators can see taint-driven churn in ``stats``."""
         dropped = self._entries.pop(signature, None) is not None
         if self.persist_dir is not None:
             try:
@@ -267,6 +303,8 @@ class JoinProgramCache:
                 dropped = True
             except OSError:
                 pass
+        if dropped and reason == "integrity":
+            self.integrity_evictions += 1
         return dropped
 
     def clear(self) -> None:
@@ -356,6 +394,7 @@ class JoinProgramCache:
         with open(tmp, "wb") as f:
             pickle.dump(payload, f)
         os.replace(tmp, path)
+        self.disk_persists += 1
         return True
 
     def _load_persisted(self, sig: JoinSignature):
@@ -372,12 +411,16 @@ class JoinProgramCache:
                 payload = pickle.load(f)
             if (payload.get("signature") != sig.canonical()
                     or payload.get("backend") != jax.default_backend()):
+                # A foreign/stale blob degrading to a miss is still a
+                # disk-tier event operators should see.
+                self.disk_load_failures += 1
                 return None
             raw = serialize_executable.deserialize_and_load(
                 *payload["program"])
         except Exception as exc:
             # A stale blob (jaxlib bump, different device topology) is
             # a cache miss, not an outage.
+            self.disk_load_failures += 1
             telemetry.event("program_cache_load_failed", path=path,
                             error=f"{type(exc).__name__}: {exc}")
             return None

@@ -139,6 +139,10 @@ class JoinService:
         self.history = (tel_history.WorkloadHistory(
             os.path.join(hist_dir, tel_history.HISTORY_FILENAME))
             if hist_dir else None)
+        # Per-signature predicted-wall memo (plan construction is
+        # cheap host arithmetic, but one join stream hits the same
+        # signature thousands of times). Bounded; cleared wholesale.
+        self._pred_cache: dict = {}
         # Set (to the HangError description) when a request blew its
         # deadline: the timed-out join keeps running on its detached
         # watchdog worker, so dispatching ANOTHER program onto the
@@ -226,6 +230,7 @@ class JoinService:
         rid = self._admit(op, request_id)
         t_start = time.perf_counter()
         sig = None
+        predicted = plan_digest = None
         outcome = "failed"
         res = None
         err: Optional[BaseException] = None
@@ -234,6 +239,8 @@ class JoinService:
             # Inside the try: anything raising after _admit must still
             # release the pending-admission slot in the finally.
             sig = self._workload_signature(build, probe, key, opts)
+            predicted, plan_digest = self._predicted_wall(
+                sig, build, probe, key, opts)
             with self._exec_lock:
                 # Re-check under the EXEC lock: a request admitted
                 # before a hang can be parked here while the hanging
@@ -324,7 +331,8 @@ class JoinService:
             self._release()
             self._observe(rid, op, sig, outcome, res, err,
                           time.perf_counter() - t_start,
-                          new_traces, cache_hits)
+                          new_traces, cache_hits, predicted,
+                          plan_digest)
 
     def join_batched(self, requests, key="key", *,
                      slot_build_rows=None, slot_probe_rows=None,
@@ -376,7 +384,75 @@ class JoinService:
             r["request_id"] = getattr(res, "request_id", None)
         return results
 
+    def explain(self, build, probe, key="key", **opts) -> dict:
+        """ADMISSION-FREE dry run (the ``explain`` wire op): resolve
+        the plan + roofline cost prediction for exactly the program a
+        ``join`` with these tables/options would dispatch, plus the
+        cache-hit verdict — resident executable, persisted blob, or a
+        fresh trace. Pure host arithmetic over shapes: no admission
+        slot, no exec lock, no mesh, ZERO traces or compiles (the
+        tables may be ShapeDtypeStructs — nothing reads data).
+
+        The plan's digest equals the program cache's key for the
+        corresponding join (first ladder rung), so the verdict can
+        never disagree with what dispatch would actually do."""
+        t0 = time.perf_counter()
+        try:
+            plan = self._plan_for(build, probe, key, opts)
+            out = {
+                "plan": plan.as_record(),
+                "cost": plan.cost,
+                "cache": self.cache.predict_hit(plan.digest),
+            }
+        except BaseException:
+            # A failing dry run (unknown option, malformed spec) must
+            # be visible on the operator surfaces too, not only to the
+            # one client that sent it.
+            self.live.record_request("explain", "failed")
+            raise
+        # Visible to operators like any other op (latency + outcome in
+        # the live metrics), but never in the flight recorder — the
+        # postmortem ring is for requests that touched the mesh.
+        self.live.record_request(
+            "explain", "served", latency_s=time.perf_counter() - t0)
+        return out
+
+    def _plan_for(self, build, probe, key, opts):
+        """THE one plan construction for both the explain op and the
+        per-request prediction: normalize the service-level options
+        exactly as :meth:`join`'s dispatch resolves them —
+        ``with_metrics`` is FORWARDED (session-resolved only when the
+        caller left it None, like the cache key), ``with_integrity``
+        defaults to the service policy — so the plan digest always
+        equals the cache key the corresponding join dispatches under."""
+        from distributed_join_tpu import planning
+
+        o = dict(opts)
+        wi = o.pop("with_integrity", self.config.verify_integrity)
+        return planning.explain_join(build, probe, self.comm, key=key,
+                                     verify_integrity=wi, **o)
+
     # -- live observability -------------------------------------------
+
+    def _predicted_wall(self, sig, build, probe, key, opts):
+        """``(predicted_wall_s, plan_digest16)`` for this request
+        (memoized per workload signature — one join stream repeats one
+        signature). The plan digest is the FULL first-rung cache key,
+        truncated like the workload signature but distinct from it
+        (the workload hash deliberately ignores ladder sizing so a
+        workload keeps one identity across rungs). Never fails a
+        request: an unplannable option set predicts ``(None, None)``."""
+        if sig in self._pred_cache:
+            return self._pred_cache[sig]
+        try:
+            plan = self._plan_for(build, probe, key, opts)
+            val = (plan.cost.get("total_s"), plan.digest[:16])
+        except Exception:
+            val = (None, None)
+        if len(self._pred_cache) >= 512:
+            self._pred_cache.clear()
+        self._pred_cache[sig] = val
+        return val
 
     def _workload_signature(self, build, probe, key, opts) -> str:
         """The stable workload identity the live layer keys on (flight
@@ -407,7 +483,8 @@ class JoinService:
             return hashlib.sha256(basis.encode()).hexdigest()[:16]
 
     def _observe(self, rid, op, sig, outcome, res, err, elapsed_s,
-                 new_traces, cache_hits):
+                 new_traces, cache_hits, predicted_wall_s=None,
+                 plan_digest=None):
         """Per-request accounting fan-out: live metrics, the flight-
         recorder ring, the workload-history store, and the poison-time
         flight dump. Observability must never turn a served request
@@ -436,7 +513,12 @@ class JoinService:
                 retry_rungs=max(counts["n_attempts"] - 1, 0),
                 integrity_retries=counts["integrity_retries"])
             self.recorder.record(
-                request_id=rid, op=op, signature=sig, outcome=outcome,
+                request_id=rid, op=op, signature=sig,
+                # The first-rung program-cache key (truncated) — a
+                # postmortem record correlates directly with explain
+                # artifacts and cache entries; distinct from the
+                # coarser rung-stable workload signature above.
+                plan_digest=plan_digest, outcome=outcome,
                 elapsed_s=round(elapsed_s, 6), matches=matches,
                 overflow=overflow, new_traces=new_traces,
                 cache_hits=cache_hits, rung_path=rung_path,
@@ -450,6 +532,7 @@ class JoinService:
                     new_traces=new_traces, cache_hits=cache_hits,
                     matches=matches, retry_record=retry_rec,
                     metrics=tel.to_dict() if tel is not None else None,
+                    predicted_wall_s=predicted_wall_s,
                     error=error))
             if outcome == "hang":
                 self.dump_flight_recorder(
@@ -495,6 +578,7 @@ class JoinService:
             "uptime_s": round(self.live.uptime_s(), 3),
             "qps_60s": round(self.live.qps(), 3),
             "latency": self.live.overall_latency(),
+            "latency_by_op": self.live.latency_by_op(),
             "poisoned": self.poisoned,
             "cache": self.cache.stats(),
         }
@@ -522,11 +606,18 @@ class JoinService:
             "failed_requests": st["failed"],
             "rejected_requests": st["rejected"],
             "program_cache_entries": cache["entries"],
+            "program_cache_max_entries": cache["max_entries"],
+            "program_cache_occupancy": cache["occupancy"],
             "program_cache_hits": cache["hits"],
             "program_cache_misses": cache["misses"],
             "program_cache_traces": cache["traces"],
             "program_cache_disk_loads": cache["disk_loads"],
+            "program_cache_disk_load_failures":
+                cache["disk_load_failures"],
+            "program_cache_disk_persists": cache["disk_persists"],
             "program_cache_lru_evictions": cache["lru_evictions"],
+            "program_cache_integrity_evictions":
+                cache["integrity_evictions"],
         })
 
 
@@ -609,6 +700,18 @@ class _Handler(socketserver.StreamRequestHandler):
             threading.Thread(target=self.server.shutdown,
                              daemon=True).start()
             return {"ok": True, "op": "shutdown"}
+        if op == "explain":
+            # Admission-free dry run: the spec's shapes become
+            # abstract tables (the generator schema — no data, no
+            # device), and the plan/cost/cache verdict comes back
+            # with ZERO traces or compiles (docs/SERVICE.md).
+            from distributed_join_tpu import planning
+
+            build, probe = planning.abstract_tables(
+                int(req["build_nrows"]), int(req["probe_nrows"]))
+            out = service.explain(build, probe,
+                                  **_join_opts_from_spec(req))
+            return {"ok": True, "op": "explain", **out}
         if op == "join":
             build, probe = _tables_from_spec(req)
             t0 = time.perf_counter()
@@ -655,7 +758,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 "cache": service.cache.stats(),
             }
         raise ValueError(f"unknown op {op!r} (ops: ping, stats, "
-                         "metrics, join, batch, shutdown)")
+                         "metrics, explain, join, batch, shutdown)")
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -744,6 +847,13 @@ def watch(host: str, port: int, interval_s: float = 2.0,
                 f"cache {st['cache']['hits']}h/"
                 f"{st['cache']['traces']}t"
             )
+            # Per-op quantiles (the overall p50/p95/p99 above hides a
+            # slow batch path behind fast joins).
+            for opname, ol in sorted(
+                    (st.get("latency_by_op") or {}).items()):
+                line += (f"  {opname}[{ms(ol.get('p50_s'))}/"
+                         f"{ms(ol.get('p95_s'))}/"
+                         f"{ms(ol.get('p99_s'))}]")
             if st.get("poisoned"):
                 line += f"  POISONED: {st['poisoned']}"
             print(line, file=out, flush=True)
@@ -1026,6 +1136,26 @@ def run_smoke(service: JoinService, args) -> dict:
         elif warm["request_id"] == cold["request_id"]:
             violations.append("request ids are not unique per request")
 
+        # EXPLAIN dry-run of the query just served: the plan must come
+        # back with ZERO new traces (admission-free host arithmetic)
+        # and predict the resident executable as a cache hit.
+        traces_before = client.send({"op": "stats"})["cache"]["traces"]
+        exp = send_ok({**{kk: v for kk, v in q.items()
+                          if kk != "op"}, "op": "explain"},
+                      "explain dry-run")
+        traces_after = client.send({"op": "stats"})["cache"]["traces"]
+        if traces_after != traces_before:
+            violations.append(
+                f"explain op traced {traces_after - traces_before} "
+                "program(s); the dry-run path must not compile")
+        if not exp.get("plan", {}).get("signature_digest"):
+            violations.append("explain response carries no plan "
+                              "digest")
+        if not exp.get("cache", {}).get("resident"):
+            violations.append(
+                "explain did not predict the warm query's resident "
+                f"program as a cache hit: {exp.get('cache')}")
+
         rows = args.smoke_small_rows
         small = [
             {"op": "join", "build_nrows": rows, "probe_nrows": rows,
@@ -1113,6 +1243,12 @@ def run_smoke(service: JoinService, args) -> dict:
         "n_ranks": service.comm.n_ranks,
         "warm_new_traces": warm["new_traces"],
         "matches_per_join": cold["matches"],
+        "explain": {
+            "plan_digest": exp.get("plan", {}).get(
+                "signature_digest"),
+            "predicted_wall_s": exp.get("cost", {}).get("total_s"),
+            "cache": exp.get("cache"),
+        },
         "small_rows": args.smoke_small_rows,
         "batch_requests": args.smoke_batch,
         "sequential_s": seq_s,

@@ -97,16 +97,18 @@ def configure(out_dir: str, *, trace: bool = False,
 
 def configure_from_args(args) -> bool:
     """Driver seam: activate from ``--telemetry[=DIR]`` / ``--trace``
-    / ``--diagnose`` / ``--history`` flags (see
-    ``benchmarks.add_telemetry_args``). ``--trace``, ``--diagnose``
-    or ``--history`` alone imply telemetry at the default directory
-    (all need a session — diagnosis reads its files, a history entry
-    wants the counter signature). Returns whether a session was
+    / ``--diagnose`` / ``--history`` / ``--explain`` flags (see
+    ``benchmarks.add_telemetry_args``). ``--trace``, ``--diagnose``,
+    ``--history`` or ``--explain`` alone imply telemetry at the
+    default directory (all need a session — diagnosis reads its
+    files, a history entry wants the counter signature, explain.json
+    lands beside diagnosis.json). Returns whether a session was
     configured."""
     out_dir = getattr(args, "telemetry", None)
     trace = bool(getattr(args, "trace", False))
     if out_dir is None and (trace or getattr(args, "diagnose", False)
-                            or getattr(args, "history", None)):
+                            or getattr(args, "history", None)
+                            or getattr(args, "explain", False)):
         out_dir = "telemetry"
     if out_dir is None:
         return False

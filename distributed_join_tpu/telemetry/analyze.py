@@ -23,12 +23,16 @@ the loop:
   telemetry files and renders the human report (every driver's
   ``--diagnose`` flag lands here via ``benchmarks.run_guarded``);
 - the CLI (``python -m distributed_join_tpu.telemetry.analyze``)
-  exposes ``diagnose`` / ``report`` / ``compare`` / ``history`` /
-  ``check``, where ``compare`` is the perf gate: non-zero exit on
-  counter-signature drift or banded wall-time regression against a
-  committed baseline (:mod:`.baselines`; the ``perfgate`` lane of
-  ``scripts/run_tier1.sh``), and ``history`` summarizes a
-  workload-history store (:mod:`.history`) per signature.
+  exposes ``diagnose`` / ``report`` / ``compare`` / ``explain`` /
+  ``history`` / ``check``, where ``compare`` is the perf gate:
+  non-zero exit on counter-signature drift or banded wall-time
+  regression against a committed baseline (:mod:`.baselines`; the
+  ``perfgate`` lane of ``scripts/run_tier1.sh``); ``explain`` grades
+  an ``explain.json`` plan's predictions against measured counters
+  (EXPLAIN ANALYZE — the padded-mode wire-byte prediction is an
+  exact CI gate via ``--gate-wire-bytes``); and ``history``
+  summarizes a workload-history store (:mod:`.history`) per
+  signature, including cost-model prediction drift.
 
 Deliberately device-free: analysis runs on the artifacts, never the
 accelerators, so it works on a laptop against files scp'd from a pod.
@@ -633,6 +637,100 @@ def format_report(diag: dict) -> str:
     return "\n".join(lines)
 
 
+# -- explain grading (EXPLAIN ANALYZE: prediction vs measurement) -----
+
+
+def grade_explain(explain: dict, metrics: Optional[dict],
+                  record: Optional[dict]) -> dict:
+    """Join a plan's predictions (``explain.json``,
+    ``planning.JoinPlan.explain_record()``) to a run's MEASURED
+    device counters and wall time — the read side of EXPLAIN ANALYZE.
+
+    Wire bytes and shuffled rows compare against the ``Metrics``
+    reduced counters; wall time against the record's
+    ``elapsed_per_join_s``. For padded/compressed plans the wire
+    prediction is EXACT by construction (static blocks), so any
+    mismatch is a bug in the plan or the tape — the
+    ``--gate-wire-bytes`` CI gate fails on it. Wall ratios are
+    honest model error (and meaningless on the CPU mesh, which
+    measures emulation — the prediction models the v5e roofline)."""
+    plan = explain.get("plan") or {}
+    cost = explain.get("cost") or {}
+    wire = plan.get("wire") or {}
+    # metrics may be a Metrics.to_dict() block ("reduced") or a
+    # counter-signature body ("counters") — same keyspace either way.
+    red = ((metrics or {}).get("reduced")
+           or (metrics or {}).get("counters") or {})
+    out: dict = {
+        "plan_digest": plan.get("signature_digest"),
+        "pipeline": plan.get("pipeline"),
+        "wire_exact": wire.get("exact"),
+        "wire": {},
+        "rows": {},
+        "wall": None,
+        "predicted_stages": cost.get("stages"),
+    }
+    for side in ("build", "probe"):
+        pred = (wire.get(side) or {}).get("bytes_total")
+        meas = red.get(f"{side}.wire_bytes")
+        if pred is not None and meas is not None:
+            out["wire"][side] = {
+                "predicted_bytes": int(pred),
+                "measured_bytes": int(meas),
+                "match": int(pred) == int(meas),
+                "error_ratio": (round(meas / pred, 6) if pred
+                                else None),
+            }
+        prows = (wire.get(side) or {}).get("rows_estimate")
+        mrows = red.get(f"{side}.rows_shuffled")
+        if prows is not None and mrows is not None:
+            out["rows"][side] = {
+                "predicted_rows": int(prows),
+                "measured_rows": int(mrows),
+                "error_ratio": (round(mrows / prows, 6) if prows
+                                else None),
+            }
+    wall = baselines.wall_time_of(record)
+    predicted_wall = cost.get("total_s")
+    if wall and predicted_wall:
+        out["wall"] = {
+            "predicted_s": predicted_wall,
+            "measured_s": wall,
+            # measured / predicted: >1 = the model was optimistic.
+            "ratio": round(wall / predicted_wall, 4),
+        }
+    return out
+
+
+def format_explain_grade(grade: dict) -> str:
+    lines = [f"explain {str(grade.get('plan_digest'))[:16]} "
+             f"[{grade.get('pipeline')}]  wire prediction: "
+             + ("EXACT" if grade.get("wire_exact") else "estimate")]
+    for side, d in sorted(grade["wire"].items()):
+        verdict = ("MATCH" if d["match"]
+                   else f"MISMATCH x{d['error_ratio']}")
+        lines.append(
+            f"  wire {side}: predicted {d['predicted_bytes']} B, "
+            f"measured {d['measured_bytes']} B -> {verdict}")
+    for side, d in sorted(grade["rows"].items()):
+        lines.append(
+            f"  rows {side}: predicted {d['predicted_rows']}, "
+            f"measured {d['measured_rows']} "
+            f"(x{d['error_ratio']})")
+    w = grade.get("wall")
+    if w:
+        lines.append(
+            f"  wall: predicted {w['predicted_s']}s (v5e roofline), "
+            f"measured {w['measured_s']:.6g}s -> x{w['ratio']} "
+            "(CPU-mesh walls measure emulation, not the model)")
+    st = grade.get("predicted_stages")
+    if st:
+        lines.append("  predicted stage split (s): "
+                     + "  ".join(f"{k}={v}"
+                                 for k, v in sorted(st.items())))
+    return "\n".join(lines)
+
+
 # -- schema checks (the perfgate lane's artifact validation) ----------
 
 _SUMMARY_REQUIRED = ("telemetry_format_version", "rank", "counters",
@@ -642,6 +740,9 @@ _DIAGNOSIS_REQUIRED = ("schema_version", "status", "indicators",
 _BASELINE_REQUIRED = ("name", "signature")
 _FLIGHTRECORDER_REQUIRED = ("schema_version", "kind", "reason",
                             "capacity", "recorded_total", "records")
+_EXPLAIN_REQUIRED = ("schema_version", "kind", "plan", "cost")
+_EXPLAIN_PLAN_REQUIRED = ("pipeline", "signature_digest", "wire")
+_EXPLAIN_COST_REQUIRED = ("stages", "total_s")
 
 
 def _sniff_history_lines(path: str) -> bool:
@@ -725,6 +826,25 @@ def check_file(path: str) -> list:
         required = _SUMMARY_REQUIRED
     elif name == "diagnosis.json":
         required = _DIAGNOSIS_REQUIRED
+    elif name.startswith("explain") or doc.get("kind") == "explain":
+        # The EXPLAIN artifact (planning/plan.py): a plan + cost
+        # prediction pair, recognized by basename OR kind stamp.
+        for key in _EXPLAIN_REQUIRED:
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        if isinstance(doc.get("plan"), dict):
+            for key in _EXPLAIN_PLAN_REQUIRED:
+                if key not in doc["plan"]:
+                    problems.append(f"plan missing {key!r}")
+        elif "plan" in doc:
+            problems.append("plan is not an object")
+        if isinstance(doc.get("cost"), dict):
+            for key in _EXPLAIN_COST_REQUIRED:
+                if key not in doc["cost"]:
+                    problems.append(f"cost missing {key!r}")
+        elif "cost" in doc:
+            problems.append("cost is not an object")
+        return problems
     elif name == "flightrecorder.json" or \
             doc.get("kind") == "flightrecorder":
         # The daemon's postmortem ring (telemetry/live.py).
@@ -844,10 +964,34 @@ def main(argv=None) -> int:
                     help="print the summary JSON instead of the "
                          "human report")
 
+    ex = sub.add_parser(
+        "explain",
+        help="EXPLAIN ANALYZE: grade an explain.json's predictions "
+             "(wire bytes, rows, wall) against a run's measured "
+             "counters; --gate-wire-bytes turns the padded-mode "
+             "exact-byte prediction into a CI gate (exit 2 on "
+             "mismatch)")
+    ex.add_argument("explain", help="explain.json path")
+    ex.add_argument("--run", default=None,
+                    help="telemetry run dir supplying the measured "
+                         "counters (summary.json)")
+    ex.add_argument("--record", default=None,
+                    help="driver --json-output record supplying "
+                         "counters and/or the measured wall time")
+    ex.add_argument("--json", action="store_true",
+                    help="print the grade JSON instead of the human "
+                         "report")
+    ex.add_argument("--gate-wire-bytes", action="store_true",
+                    help="fail (exit 2) unless every predicted wire "
+                         "byte count EXACTLY equals the measured "
+                         "counter; refuses (exit 1) on estimate-only "
+                         "plans (ragged) — only static-block modes "
+                         "are gateable")
+
     k = sub.add_parser("check",
                        help="shape-validate telemetry artifacts "
                             "(summary/diagnosis/baseline/trace/"
-                            "events); exit 1 on any problem")
+                            "explain/events); exit 1 on any problem")
     k.add_argument("files", nargs="+")
 
     args = p.parse_args(argv)
@@ -889,6 +1033,40 @@ def main(argv=None) -> int:
             else:
                 print(history.format_summary(
                     summary, path=history.history_path(args.path)))
+            return 0
+        if args.cmd == "explain":
+            with open(args.explain) as f:
+                explain_doc = json.load(f)
+            metrics, record = None, None
+            if args.run:
+                run = load_run(args.run)
+                metrics = run.metrics
+            if args.record:
+                from distributed_join_tpu.benchmarks import load_record
+
+                record = load_record(args.record)
+                if metrics is None:
+                    metrics = baselines._find_metrics(record)
+            grade = grade_explain(explain_doc, metrics, record)
+            if args.json:
+                print(json.dumps(grade, indent=1))
+            else:
+                print(format_explain_grade(grade))
+            if args.gate_wire_bytes:
+                if not grade.get("wire_exact"):
+                    print("error: --gate-wire-bytes needs an exact "
+                          "(padded/compressed) plan; this plan's "
+                          "wire prediction is an estimate",
+                          file=sys.stderr)
+                    return 1
+                if not grade["wire"]:
+                    print("error: no measured wire counters to gate "
+                          "against (run with --telemetry)",
+                          file=sys.stderr)
+                    return 1
+                if not all(d["match"] for d in
+                           grade["wire"].values()):
+                    return 2
             return 0
         if args.cmd == "check":
             bad = 0

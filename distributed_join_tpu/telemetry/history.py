@@ -156,15 +156,32 @@ def quick_indicators(metrics: Optional[dict]) -> Optional[dict]:
     return out or None
 
 
+def prediction_block(wall_s, predicted_wall_s) -> Optional[dict]:
+    """The cost-model grading carried per entry: predicted wall vs
+    measured, as a ratio (measured / predicted — >1 means the model
+    was optimistic). The summarizer turns these into the per-signature
+    prediction-band drift flag the autotuner reads (where is the
+    model wrong, and is it wrong CONSISTENTLY)."""
+    if not predicted_wall_s:
+        return None
+    block = {"predicted_wall_s": float(predicted_wall_s)}
+    if wall_s:
+        block["wall_ratio"] = round(
+            float(wall_s) / float(predicted_wall_s), 6)
+    return block
+
+
 def request_entry(*, request_id: str, op: str, signature: str,
                   outcome: str, wall_s: float, new_traces: int = 0,
                   cache_hits: int = 0, matches: Optional[int] = None,
                   retry_record: Optional[dict] = None,
                   metrics: Optional[dict] = None,
+                  predicted_wall_s: Optional[float] = None,
                   error: Optional[str] = None) -> dict:
     """One serving request's history line (the JoinService write
     path). ``metrics`` is the request's ``Metrics.to_dict()`` block
-    when telemetry rode the program, else None."""
+    when telemetry rode the program, else None; ``predicted_wall_s``
+    the plan's cost-model prediction when the service computed one."""
     from distributed_join_tpu.telemetry import baselines
 
     return {
@@ -182,6 +199,7 @@ def request_entry(*, request_id: str, op: str, signature: str,
         "resolved_knobs": _resolved_knobs(retry_record),
         "counter_signature": baselines.counter_signature(metrics),
         "indicators": quick_indicators(metrics),
+        "prediction": prediction_block(wall_s, predicted_wall_s),
         "error": error,
     }
 
@@ -206,6 +224,9 @@ def run_entry(record: Optional[dict] = None,
     # THE one extraction of a record's comparable wall number
     # (bench.py's "value" is a rate, not a time — never recorded).
     wall = baselines.wall_time_of(record)
+    # --explain runs embed their prediction summary in the record;
+    # grade it here so the store carries per-signature model error.
+    predicted = (record.get("explain") or {}).get("predicted_wall_s")
     return {
         "schema_version": HISTORY_SCHEMA_VERSION,
         "kind": "run",
@@ -223,6 +244,7 @@ def run_entry(record: Optional[dict] = None,
         "counter_signature": baselines.counter_signature(
             metrics if metrics is not None else record),
         "indicators": quick_indicators(metrics),
+        "prediction": prediction_block(wall, predicted),
         "error": record.get("error"),
     }
 
@@ -263,6 +285,29 @@ def _wall_stats(walls) -> Optional[dict]:
     }
 
 
+def _prediction_stats(ratios) -> Optional[dict]:
+    """Per-signature cost-model grading: measured/predicted wall
+    ratios across runs, flagged when any run lands outside the
+    model's prediction band (planning.cost.DEFAULT_PREDICTION_BAND)
+    — the "this workload's wall drifted from the cost model" signal
+    ISSUE 8's small fix asks for, next to counter drift."""
+    if not ratios:
+        return None
+    from distributed_join_tpu.planning.cost import (
+        DEFAULT_PREDICTION_BAND,
+    )
+
+    band = DEFAULT_PREDICTION_BAND
+    return {
+        "n": len(ratios),
+        "wall_ratio_min": round(min(ratios), 4),
+        "wall_ratio_max": round(max(ratios), 4),
+        "wall_ratio_last": round(ratios[-1], 4),
+        "band": band,
+        "drift": any(r > band or r < 1.0 / band for r in ratios),
+    }
+
+
 def summarize(entries) -> dict:
     """Per-signature trends over a history store — the view the
     autotuner (ROADMAP item 5) will pre-size from."""
@@ -273,7 +318,7 @@ def summarize(entries) -> dict:
             "entries": 0, "outcomes": {}, "ops": {}, "walls": [],
             "escalations": 0, "integrity_retries": 0, "new_traces": 0,
             "resolved_knobs_last": None, "counter_drift": False,
-            "_counters_seen": None,
+            "_counters_seen": None, "_pred_ratios": [],
         })
         s["entries"] += 1
         outcome = e.get("outcome") or "?"
@@ -297,6 +342,9 @@ def summarize(entries) -> dict:
                 # the data (or a seam) moved — the drift the autotuner
                 # must re-observe before trusting old sizing.
                 s["counter_drift"] = True
+        pred = e.get("prediction")
+        if isinstance(pred, dict) and pred.get("wall_ratio"):
+            s["_pred_ratios"].append(float(pred["wall_ratio"]))
     out = {}
     for digest, s in sigs.items():
         out[digest] = {
@@ -309,6 +357,7 @@ def summarize(entries) -> dict:
             "new_traces": s["new_traces"],
             "resolved_knobs_last": s["resolved_knobs_last"],
             "counter_drift": s["counter_drift"],
+            "prediction": _prediction_stats(s["_pred_ratios"]),
         }
     return {
         "schema_version": HISTORY_SCHEMA_VERSION,
@@ -347,4 +396,13 @@ def format_summary(summary: dict, path: str = "") -> str:
         if s.get("counter_drift"):
             lines.append("    counter signature DRIFTED across runs "
                          "(data moved; re-observe before pre-sizing)")
+        pred = s.get("prediction")
+        if pred:
+            tag = (" OUTSIDE prediction band" if pred["drift"]
+                   else "")
+            lines.append(
+                f"    cost model: wall/predicted "
+                f"{pred['wall_ratio_min']}-{pred['wall_ratio_max']}x "
+                f"over {pred['n']} run(s) (band "
+                f"{pred['band']:g}x){tag}")
     return "\n".join(lines)

@@ -245,6 +245,16 @@ class LiveMetrics:
                 merged.merge(slot["latency"].snapshot())
         return merged.summary()
 
+    def latency_by_op(self) -> dict:
+        """Per-op p50/p95/p99 summaries (``stats.latency_by_op``, the
+        ``--watch`` console's per-op segment, and the Prometheus
+        quantile gauges) — the histograms always existed per op; this
+        surfaces them without shipping full bucket arrays."""
+        with self._lock:
+            return {op: slot["latency"].summary()
+                    for op, slot in sorted(self._ops.items())
+                    if slot["latency"].count}
+
     def snapshot(self) -> dict:
         """The ``metrics`` wire op's JSON body."""
         with self._lock:
@@ -338,6 +348,26 @@ class LiveMetrics:
                 lines.append(
                     "djtpu_request_latency_seconds_count"
                     f'{{op="{op}"}} {hist.count}')
+            # Pre-derived per-op quantile gauges: scrapers that can't
+            # (or won't) do histogram_quantile still get p50/p95/p99.
+            lines += [
+                "# HELP djtpu_request_latency_quantile_seconds "
+                "Per-op latency quantiles (derived from the fixed "
+                "log-spaced histogram).",
+                "# TYPE djtpu_request_latency_quantile_seconds gauge",
+            ]
+            for op, slot in sorted(self._ops.items()):
+                hist = slot["latency"]
+                if not hist.count:
+                    continue
+                for label, q in (("0.5", 0.50), ("0.95", 0.95),
+                                 ("0.99", 0.99)):
+                    v = hist.quantile(q)
+                    if v is not None:
+                        lines.append(
+                            "djtpu_request_latency_quantile_seconds"
+                            f'{{op="{op}",quantile="{label}"}} '
+                            f"{v:.6f}")
             lines += [
                 "# HELP djtpu_signature_requests_total Requests by "
                 "join signature.",

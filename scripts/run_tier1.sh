@@ -6,7 +6,11 @@
 #   scripts/run_tier1.sh            # full tier-1 (ROADMAP verbatim)
 #   scripts/run_tier1.sh faults     # fast lane: -m faults smoke only
 #   scripts/run_tier1.sh telemetry  # fast lane: -m telemetry smoke only
-#   scripts/run_tier1.sh analysis   # fast lane: -m analysis smoke only
+#   scripts/run_tier1.sh analysis   # fast lane: -m 'analysis or
+#                                   # explain' suites + an --explain
+#                                   # driver smoke whose padded-mode
+#                                   # wire-byte prediction is gated
+#                                   # EXACTLY vs measured counters
 #   scripts/run_tier1.sh perfgate   # deterministic CPU-mesh join vs.
 #                                   # the committed counter-signature
 #                                   # baseline + artifact schema check
@@ -59,10 +63,34 @@ case "$lane" in
     ;;
   analysis)
     # Run-analysis smoke: skew/balanced diagnosis, baseline
-    # round-trip + drift detection, CLI exit codes, bench proxy.
-    exec timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
-      tests/ -q -m analysis --continue-on-collection-errors \
+    # round-trip + drift detection, CLI exit codes, bench proxy —
+    # plus the explain suite and an end-to-end --explain smoke whose
+    # padded-mode wire-byte prediction is gated EXACTLY against the
+    # measured device counters (docs/OBSERVABILITY.md "Explain &
+    # cost model").
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m 'analysis or explain' \
+      --continue-on-collection-errors \
       -p no:cacheprovider -p no:xdist -p no:randomly
+    tmp="$(mktemp -d /tmp/djtpu_explain.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.benchmarks.distributed_join \
+      --platform cpu --n-ranks 8 \
+      --build-table-nrows 8000 --probe-table-nrows 8000 \
+      --iterations 1 --out-capacity-factor 3.0 \
+      --telemetry "$tmp/tel" --explain \
+      --json-output "$tmp/record.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/tel/explain.json"
+    # The hard gate: padded-mode predicted wire bytes must EXACTLY
+    # equal the measured Metrics counters (exit 2 on any drift).
+    python -m distributed_join_tpu.telemetry.analyze explain \
+      "$tmp/tel/explain.json" --record "$tmp/record.json" \
+      --gate-wire-bytes
+    exit $?
     ;;
   perfgate)
     # The perf gate (docs/OBSERVABILITY.md "Diagnosis & baselines"):
@@ -81,10 +109,11 @@ case "$lane" in
       --platform cpu --n-ranks 8 \
       --build-table-nrows 8000 --probe-table-nrows 8000 \
       --iterations 1 --shuffle ragged --out-capacity-factor 3.0 \
-      --telemetry "$tmp/tel" --diagnose \
+      --telemetry "$tmp/tel" --diagnose --explain \
       --json-output "$tmp/record.json"
     python -m distributed_join_tpu.telemetry.analyze check \
       "$tmp/tel/summary.json" "$tmp/tel/diagnosis.json" \
+      "$tmp/tel/explain.json" \
       "$tmp/tel/trace.rank0.json" "$tmp/tel/events.rank0.jsonl"
     python -m distributed_join_tpu.telemetry.analyze compare \
       "$tmp/record.json" --baseline cpu_mesh_smoke
