@@ -164,16 +164,31 @@ def main(argv=None) -> int:
         # leave join telemetry behind — exactly the run --diagnose is
         # for — so they get the diagnosis run_guarded's finally would
         # have given them; an outage has nothing to read.
-        from distributed_join_tpu.benchmarks import maybe_diagnose
+        from distributed_join_tpu.benchmarks import (
+            maybe_diagnose,
+            maybe_history,
+        )
 
         summ = telemetry.finalize()
         if not is_outage:
             maybe_diagnose(args, summ, record=record)
+        # --history gets the failure/proxy entry BEFORE the hard exit
+        # (os._exit skips the finally below) — a failing headline
+        # workload is exactly the trend the store exists to show.
+        maybe_history(args, summ, record=record)
         os._exit(0 if is_outage else 1)
     finally:
-        from distributed_join_tpu.benchmarks import maybe_diagnose
+        from distributed_join_tpu.benchmarks import (
+            maybe_diagnose,
+            maybe_history,
+        )
 
-        maybe_diagnose(args, telemetry.finalize(), record=result)
+        summ = telemetry.finalize()
+        maybe_diagnose(args, summ, record=result)
+        # --history: the headline run feeds the same per-workload
+        # store the drivers and the join service write (its identity
+        # keys ride the record; telemetry/history.run_entry).
+        maybe_history(args, summ, record=result)
 
 
 def _try_proxy(outage) -> dict | None:
@@ -300,14 +315,46 @@ def _run(args=None) -> dict:
     )
     from distributed_join_tpu.parallel.faults import CapacityLadder
 
+    # --auto-tune: pre-size both measured ladders from this protocol's
+    # own history (capacity knobs only — benchmarks.tuned_driver_record
+    # documents the driver-path contract). The workload identity keys
+    # ride the record so the end-of-run --history entry files under
+    # the same signature the lookup used.
+    workload = {
+        "benchmark": "bench",
+        "n_ranks": n_dev,
+        "build_table_nrows": BUILD_NROWS,
+        "probe_table_nrows": PROBE_NROWS,
+        "selectivity": SELECTIVITY,
+    }
+    tuned_sizing, tuned_rung, tuned_rec = {}, 0, None
+    if args is not None:
+        from distributed_join_tpu.benchmarks import (
+            resolve_tuner,
+            tuned_driver_record,
+        )
+
+        tuner = resolve_tuner(args)
+        if tuner is not None:
+            tuned_sizing, tuned_rung, tuned_rec = tuned_driver_record(
+                tuner, workload)
+
     def measure(out_rows_per_rank=None):
         # Overflow escalates instead of crashing (faults.CapacityLadder
         # — the same policy as auto_retry); attempts are returned for
         # the JSON record so a retried headline is never silent.
+        # The match-sized variant keeps its exactly-sized output
+        # (out_rows_per_rank param wins over tuned history).
         ladder = CapacityLadder(
-            shuffle_capacity_factor=DEFAULT_SHUFFLE_CAPACITY_FACTOR,
-            out_capacity_factor=DEFAULT_OUT_CAPACITY_FACTOR,
-            out_rows_per_rank=out_rows_per_rank,
+            shuffle_capacity_factor=tuned_sizing.get(
+                "shuffle_capacity_factor",
+                DEFAULT_SHUFFLE_CAPACITY_FACTOR),
+            out_capacity_factor=tuned_sizing.get(
+                "out_capacity_factor", DEFAULT_OUT_CAPACITY_FACTOR),
+            out_rows_per_rank=(
+                out_rows_per_rank if out_rows_per_rank is not None
+                else tuned_sizing.get("out_rows_per_rank")),
+            base_rung=tuned_rung,
         )
         for attempt in range(_AUTO_RETRY + 1):
             sizing = {k: v for k, v in ladder.sizing().items()
@@ -393,6 +440,10 @@ def _run(args=None) -> dict:
             m_rows_per_chip / BASELINE_M_ROWS_PER_SEC_PER_CHIP, 4
         ),
         "value_capacity_contract": round(m_rows_contract, 3),
+        # workload identity (telemetry/history.WORKLOAD_KEYS) so a
+        # --history entry files this run under a stable signature
+        **workload,
+        "tuned": tuned_rec,
         "out_rows": {
             "match_sized": int(EXPECTED_MATCHES * OUT_SLACK),
             "contract": "out_capacity_factor=1.2 x probe rows",

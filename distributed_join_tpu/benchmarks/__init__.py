@@ -306,24 +306,28 @@ def maybe_history(args, summary, record=None) -> None:
                 val = getattr(args, key, None)
                 if val is not None:
                     record[key] = val
-        if record.get("n_ranks") is None:
-            # n_ranks is runtime-resolved (args default None = all
-            # visible devices), so a failure record would otherwise
-            # hash to a different signature than the workload's
-            # healthy runs. Read it from the ALREADY-initialized
-            # backend only — probing would re-initialize against the
-            # same dead relay on the bootstrap-outage path.
-            try:
-                from jax._src import xla_bridge
+        platform = None
+        # n_ranks is runtime-resolved (args default None = all
+        # visible devices), so a failure record would otherwise hash
+        # to a different signature than the workload's healthy runs.
+        # Read it from the ALREADY-initialized backend only — probing
+        # would re-initialize against the same dead relay on the
+        # bootstrap-outage path. The same guarded read supplies the
+        # PLATFORM stamp (the cost-model calibration seam trusts only
+        # real-hardware walls).
+        try:
+            from jax._src import xla_bridge
 
-                if getattr(xla_bridge, "_backends", None):
-                    import jax
+            if getattr(xla_bridge, "_backends", None):
+                import jax
 
+                if record.get("n_ranks") is None:
                     record["n_ranks"] = jax.device_count()
-            except Exception:  # pragma: no cover - private-API drift
-                pass
+                platform = jax.default_backend()
+        except Exception:  # pragma: no cover - private-API drift
+            pass
         history.WorkloadHistory(path).append(history.run_entry(
-            record=record, summary=summary))
+            record=record, summary=summary, platform=platform))
     except Exception as exc:  # noqa: BLE001 — history is best-effort
         print(f"note: --history failed: {type(exc).__name__}: {exc}",
               file=sys.stderr)
@@ -413,6 +417,18 @@ def add_robustness_args(parser) -> None:
              "with rc 1. Default: DJTPU_GUARD_DEADLINE_S env, else "
              "unguarded (hours-long out-of-core runs are legitimate)",
     )
+    parser.add_argument(
+        "--auto-tune", nargs="?", const="", default=None,
+        metavar="HISTORY",
+        help="consult the history-driven autotuner "
+             "(planning/tuner.py) before sizing: a repeat workload "
+             "whose retry ladder previously escalated starts at the "
+             "final rung it resolved to — zero overflow recompiles. "
+             "HISTORY is the workload-history store to read (bare "
+             "flag: the --history FILE on the drivers, the service's "
+             "own store on tpu-join-service). First run of a "
+             "workload stays the exact static resolution",
+    )
 
 
 # Launcher-level flags every spawned driver understands, as
@@ -426,6 +442,7 @@ FORWARDED_CHILD_FLAGS = (
     ("--diagnose", "diagnose", False),
     ("--history", "history", True),
     ("--explain", "explain", False),
+    ("--auto-tune", "auto_tune", True),
     ("--verify-integrity", "verify_integrity", False),
     ("--chaos-seed", "chaos_seed", True),
     ("--guard-deadline-s", "guard_deadline_s", True),
@@ -462,6 +479,52 @@ def extract_forwarded_flags(args, command) -> list:
     # into their processes) are still writing records.
     args.guard_deadline_s = 0
     return extra
+
+
+def resolve_tuner(args):
+    """The drivers' ``--auto-tune[=HISTORY]`` seam: build the
+    :class:`..planning.tuner.JoinTuner` over the named history store
+    (bare flag: the run's own ``--history FILE``). Returns None when
+    the flag is off; a missing store file is an EMPTY tuner (first
+    run conservative), a missing path is a loud usage error."""
+    val = getattr(args, "auto_tune", None)
+    if val is None:
+        return None
+    path = val or getattr(args, "history", None)
+    if not path:
+        raise SystemExit(
+            "--auto-tune needs a workload-history store: pass "
+            "--auto-tune HISTORY or pair the bare flag with "
+            "--history FILE")
+    from distributed_join_tpu.planning.tuner import JoinTuner
+
+    return JoinTuner(path)
+
+
+def tuned_driver_record(tuner, workload: dict):
+    """Driver-side tuning (capacity PRE-SIZING only): look the
+    workload identity up in the tuner and return ``(sizing_overrides,
+    rung, record)`` — the knob dict for the driver's CapacityLadder,
+    the absolute rung label to seed it with, and the JSON block the
+    driver embeds under ``record["tuned"]`` (carrying the PRE-TUNED
+    workload dict, so ``history.run_entry`` keeps hashing the run to
+    the same signature the lookup used).
+
+    Structural knobs (shuffle mode, skew policy) are deliberately NOT
+    applied on this path: the driver store keys workloads by their
+    flag identity (``history.WORKLOAD_KEYS`` — which includes
+    ``shuffle``/``skew_threshold``), where a mode switch would fork
+    the signature away from its own history. Mode selection lives on
+    the service/library path, whose signatures are shape-canonical."""
+    from distributed_join_tpu.telemetry.history import run_signature
+
+    sig = run_signature(workload)
+    cfg = tuner.recommend(sig)
+    rec = cfg.as_record()
+    rec["workload"] = workload
+    rec["applied"] = dict(cfg.sizing)
+    rec.pop("structural", None)
+    return dict(cfg.sizing), cfg.rung, rec
 
 
 def maybe_chaos_communicator(comm, args):

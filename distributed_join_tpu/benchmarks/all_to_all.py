@@ -90,6 +90,11 @@ def _verified_exchange(comm, x, n: int, per_rank: int):
 
 
 def run(args) -> dict:
+    if args.auto_tune is not None:
+        # One fixed-size exchange has no join knobs to tune.
+        raise SystemExit(
+            "--auto-tune applies to the join drivers; the all_to_all "
+            "microbenchmark has no capacity contract to pre-size")
     apply_platform(args.platform, args.n_ranks)
     comm = maybe_chaos_communicator(
         make_communicator(args.communicator, n_ranks=args.n_ranks),

@@ -337,29 +337,85 @@ def run(args) -> dict:
     from distributed_join_tpu.parallel.faults import CapacityLadder
 
     skew_on = skew_threshold is not None
+    # --auto-tune: pre-size the ladder from this workload's history
+    # (planning/tuner.py) — a repeat run starts at the rung its
+    # ladder previously escalated to instead of re-paying the
+    # overflow recompiles. Capacity knobs only on the driver path
+    # (benchmarks.tuned_driver_record documents why); the workload
+    # identity is hashed PRE-tuning, so the run files under the same
+    # signature its history carries.
+    from distributed_join_tpu.benchmarks import (
+        resolve_tuner,
+        tuned_driver_record,
+    )
+
+    tuned_sizing, tuned_rung, tuned_rec = {}, 0, None
+    tuner = resolve_tuner(args)
+    if tuner is not None:
+        workload = {k: v for k, v in {
+            "benchmark": "distributed_join",
+            "n_ranks": n,
+            "build_table_nrows": b_rows,
+            "probe_table_nrows": p_rows,
+            "selectivity": args.selectivity,
+            "shuffle": args.shuffle,
+            "key_type": args.key_type,
+            "payload_type": args.payload_type,
+            "key_columns": args.key_columns,
+            "over_decomposition_factor": args.over_decomposition_factor,
+            "zipf_alpha": args.zipf_alpha,
+            "skew_threshold": skew_threshold,
+            "string_payload_bytes": args.string_payload_bytes,
+            "string_key_bytes": args.string_key_bytes,
+        }.items() if v is not None}
+        tuned_sizing, tuned_rung, tuned_rec = tuned_driver_record(
+            tuner, workload)
+        if tuned_sizing:
+            print(f"auto-tune: pre-sizing from history rung "
+                  f"{tuned_rung}: " + " ".join(
+                      f"{k}={v}" for k, v in
+                      sorted(tuned_sizing.items())), file=sys.stderr)
+
+    def _tuned(knob, fallback):
+        return tuned_sizing.get(knob, fallback) \
+            if tuned_sizing.get(knob) is not None else fallback
+
     # Resolve the HH defaults here (same resolution as
     # distributed_inner_join) so --auto-retry escalation can enlarge
     # them; the resolved values equal make_join_step's own defaults,
     # so the first program is unchanged.
     ladder = CapacityLadder(
-        shuffle_capacity_factor=args.shuffle_capacity_factor,
-        out_capacity_factor=args.out_capacity_factor,
+        shuffle_capacity_factor=_tuned("shuffle_capacity_factor",
+                                       args.shuffle_capacity_factor),
+        out_capacity_factor=_tuned("out_capacity_factor",
+                                   args.out_capacity_factor),
+        out_rows_per_rank=tuned_sizing.get("out_rows_per_rank"),
+        # Tuned bits only WIDEN an explicitly-requested codec — the
+        # driver workload identity doesn't bind --compression, so
+        # history must never switch the codec on for a run that
+        # didn't ask.
         compression_bits=(
-            args.compression_bits if args.compression else None
+            _tuned("compression_bits", args.compression_bits)
+            if args.compression else None
         ),
         skew=skew_on,
         hh_build_capacity=(
-            args.hh_slots * HH_BUILD_SLOTS_PER_HH if skew_on else None
+            _tuned("hh_build_capacity",
+                   args.hh_slots * HH_BUILD_SLOTS_PER_HH)
+            if skew_on else None
         ),
         hh_probe_capacity=(
-            (hh_probe_cap or max(p_rows // (8 * n), 1024))
+            _tuned("hh_probe_capacity",
+                   hh_probe_cap or max(p_rows // (8 * n), 1024))
             if skew_on else None
         ),
         hh_out_capacity=(
-            (hh_out_cap or max(p_rows // (4 * n), 1024))
+            _tuned("hh_out_capacity",
+                   hh_out_cap or max(p_rows // (4 * n), 1024))
             if skew_on else None
         ),
         local_probe_rows=p_rows // n,
+        base_rung=tuned_rung,
     )
     fixed_opts = dict(
         key=join_key,
@@ -392,7 +448,9 @@ def run(args) -> dict:
     # report() under telemetry.metrics.
     collect_join_metrics(comm, build, probe,
                          dict(fixed_opts, **ladder.sizing()),
-                         attempt=attempt)
+                         # absolute rung label: a tuner-pre-sized run's
+                         # counters must carry the rung it actually ran
+                         attempt=ladder.base_rung + attempt)
     # --verify-integrity: one digest-verified untimed step (same
     # discipline); a wire mismatch raises IntegrityError rather than
     # reporting a throughput computed from corrupt rows.
@@ -450,6 +508,7 @@ def run(args) -> dict:
         "variable_length_strings": args.variable_length_strings,
         "string_key_bytes": args.string_key_bytes,
         "string_wire_bytes": _string_wire_accounting(build, args.shuffle),
+        "tuned": tuned_rec,
         "matches_per_join": matches,
         "overflow": overflow,
         "integrity": integ,

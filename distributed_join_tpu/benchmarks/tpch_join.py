@@ -110,6 +110,14 @@ def _make_consumer(args):
 
 
 def run(args) -> dict:
+    if args.auto_tune is not None:
+        # The batched paths re-plan per key-range batch and the
+        # single-shot path is a fixed TPC-H shape; declining loudly
+        # beats a flag that silently tunes nothing.
+        raise SystemExit(
+            "--auto-tune is wired for tpu-distributed-join, bench.py "
+            "and the join service; the tpch driver does not consult "
+            "the history store yet")
     if ((args.manifest or args.batch_retries
          or args.continue_on_batch_failure)
             and args.batches <= 1 and not args.host_generator):

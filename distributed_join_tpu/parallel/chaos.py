@@ -361,6 +361,239 @@ def _run_out_of_core(build, probe, comm, oracle_cols, oracle_total,
                          corrupting, retries=retries)
 
 
+# -- the tuner slice (poisoned-history grading) -----------------------
+
+
+def poisoned_history_entry(signature: str, *,
+                           shuffle_capacity_factor: float = 0.4,
+                           out_capacity_factor: float = 0.2,
+                           rung: int = 1) -> dict:
+    """A history line CLAIMING a workload resolved at a rung whose
+    capacities are far too small — the lying-history adversary the
+    tuner slice feeds the autotuner. Shaped like a real request entry
+    (escalations recorded, resolved knobs at the bogus sizing) so the
+    trend aggregation adopts it exactly as it would a genuine one."""
+    return {
+        "schema_version": 1,
+        "kind": "request",
+        "request_id": "poisoned",
+        "op": "join",
+        "signature": signature,
+        "outcome": "served",
+        "wall_s": 0.01,
+        "new_traces": 1,
+        "cache_hits": 0,
+        "matches": 1,
+        "retry": {"n_attempts": 2, "escalations": 1,
+                  "integrity_retries": 0},
+        "resolved_knobs": {
+            "shuffle_capacity_factor": shuffle_capacity_factor,
+            "out_capacity_factor": out_capacity_factor,
+        },
+        "rung": rung,
+        "tuned": None,
+        "error": None,
+    }
+
+
+def run_tuner_trial(harness_seed: int, trial: int,
+                    n_ranks: int = 8,
+                    deadline_s: Optional[float] = 300.0) -> dict:
+    """One poisoned-history trial: seed a temp history store with
+    capacities claiming a too-small rung for EXACTLY the workload
+    signature the tuner will compute, run the join with the tuner
+    armed, and grade:
+
+    - the result must be pandas-oracle-exact (the retry ladder
+      catches the mis-size — a self-tuned config must never trade
+      correctness for speed);
+    - the post-run history must record the ESCALATED rung with
+      capacities strictly above the poisoned claim (the tuner
+      *learns* the corrected rung for the next run).
+    """
+    import tempfile
+
+    import distributed_join_tpu as dj
+    from distributed_join_tpu.parallel.watchdog import (
+        HangError,
+        call_with_deadline,
+    )
+    from distributed_join_tpu.planning.tuner import (
+        JoinTuner,
+        workload_signature,
+    )
+    from distributed_join_tpu.telemetry import history as tel_history
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+    )
+
+    rng = _trial_rng(harness_seed, 1_000 + trial)
+    config = {
+        "mode": ("padded", "ragged", "skew")[trial % 3],
+        "build_rows": rng.choice(_BUILD_ROWS),
+        "probe_rows": rng.choice(_PROBE_ROWS),
+        "rand_max": rng.choice(_RAND_MAX),
+        "selectivity": rng.choice(_SELECTIVITY),
+        "table_seed": rng.randrange(1 << 16),
+    }
+    # Recoverable faults only: the poisoned store is this slice's
+    # adversary; corruption grading is the main soak's job.
+    plan = random_fault_plan(rng, corruption=False)
+    record = {
+        "trial": trial,
+        "config": config,
+        "fault": fault_label(plan),
+        "fault_plan": _plan_record(plan),
+        "poisoned_history": True,
+    }
+    t0 = time.perf_counter()
+
+    def body():
+        build, probe = generate_build_probe_tables(
+            seed=config["table_seed"],
+            build_nrows=config["build_rows"],
+            probe_nrows=config["probe_rows"],
+            rand_max=config["rand_max"],
+            selectivity=config["selectivity"],
+        )
+        oracle = _oracle_frame(build, probe)
+        out_names = ["key", "build_payload", "probe_payload"]
+        oracle_cols = _frame_columns(oracle, out_names)
+        comm = FaultInjectingCommunicator(
+            dj.make_communicator("tpu", n_ranks=n_ranks), plan)
+        join_opts = dict(
+            shuffle="ragged" if config["mode"] == "ragged"
+            else "padded",
+        )
+        if config["mode"] == "skew":
+            join_opts["skew_threshold"] = 0.05
+        # The poison must land on EXACTLY the signature the tuner
+        # will look up (same function, same tables, same opts).
+        sig = workload_signature(comm, build, probe, key="key",
+                                 with_integrity=True, **join_opts)
+        poison = poisoned_history_entry(sig)
+        with tempfile.TemporaryDirectory() as tmp:
+            store = tel_history.WorkloadHistory(
+                tmp + "/history.jsonl")
+            store.append(poison)
+            store.close()
+            tuner = JoinTuner(store.path)
+
+            def attempt():
+                return dj.distributed_inner_join(
+                    build, probe, comm, auto_retry=6,
+                    verify_integrity=True, tuner=tuner,
+                    **join_opts)
+
+            res, _ = retry_with_backoff(
+                attempt, max_attempts=3, backoff_s=0.01,
+                retry_on=(FaultInjectedError,),
+            )
+            if bool(res.overflow):
+                return TrialOutcome(
+                    "FAILED:overflow_after_ladder",
+                    expected_total=len(oracle)), None
+            # Grade content against the oracle, THEN verify the
+            # corrected rung landed back in the store.
+            out = _grade_result(
+                _result_columns(res.table), int(res.total),
+                oracle_cols, len(oracle), corrupting=False,
+                retries=res.retry_report.n_attempts - 1,
+            )
+            store2 = tel_history.WorkloadHistory(store.path)
+            store2.append(tel_history.request_entry(
+                request_id=f"tuner-trial-{trial}", op="join",
+                signature=sig, outcome="served",
+                wall_s=time.perf_counter() - t0,
+                retry_record=res.retry_report.as_record(),
+                tuned=getattr(res, "tuned", None)))
+            store2.close()
+            entries, _ = tel_history.load_history(store.path)
+            trend = tel_history.trends_of(entries)[sig].as_dict()
+            learned = trend["resolved_knobs_last"] or {}
+            corrected = (
+                (trend["resolved_rung_last"] or 0)
+                > poison["rung"]
+                and learned.get("out_capacity_factor", 0)
+                > poison["resolved_knobs"]["out_capacity_factor"]
+            )
+            tuned_rec = getattr(res, "tuned", None) or {}
+            presized = (tuned_rec.get("source") == "history")
+            return out, {
+                "tuner_presized": presized,
+                "tuner_corrected": corrected,
+                "learned_rung": trend["resolved_rung_last"],
+                "learned_knobs": learned,
+            }
+
+    try:
+        if deadline_s is not None:
+            out, tuner_verdict = call_with_deadline(
+                body, deadline_s, what=f"tuner trial {trial}")
+        else:
+            out, tuner_verdict = body()
+    except HangError as exc:
+        out, tuner_verdict = TrialOutcome("FAILED:hang",
+                                          error=str(exc)), None
+    except Exception as exc:  # noqa: BLE001 — grading seam
+        out, tuner_verdict = TrialOutcome(
+            "FAILED:crash", error=f"{type(exc).__name__}: {exc}"), None
+    if tuner_verdict is not None:
+        record.update(tuner_verdict)
+        if not out.failed and not (tuner_verdict["tuner_presized"]
+                                   and tuner_verdict["tuner_corrected"]):
+            # Oracle-clean but the loop didn't close: either the
+            # poisoned sizing never applied (the slice tested
+            # nothing) or the corrected rung never landed — both are
+            # harness failures, loudly.
+            out = TrialOutcome(
+                "FAILED:tuner_loop_open",
+                error=str(tuner_verdict),
+                expected_total=out.expected_total,
+                got_total=out.got_total, retries=out.retries)
+    record.update(dataclasses.asdict(out))
+    record["verdict"] = out.verdict
+    record["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    return record
+
+
+def tuner_slice(seed: int, trials: int, n_ranks: int = 8,
+                deadline_s: Optional[float] = 300.0,
+                repro_out: Optional[str] = None) -> dict:
+    """The --tuner-slice soak: N poisoned-history trials; exit
+    contract mirrors the main soak (0 failures = pass)."""
+    records, failures = [], []
+    for k in range(trials):
+        rec = run_tuner_trial(seed, k, n_ranks=n_ranks,
+                              deadline_s=deadline_s)
+        records.append(rec)
+        print(f"tuner trial {k:3d} [{rec['config']['mode']:7s}] "
+              f"fault={rec['fault']:17s} -> {rec['verdict']} "
+              f"(presized={rec.get('tuner_presized')}, "
+              f"corrected={rec.get('tuner_corrected')}, "
+              f"{rec['elapsed_s']}s)", flush=True)
+        if rec["verdict"].startswith("FAILED"):
+            failures.append(rec)
+            if repro_out:
+                path = f"{repro_out}_tuner_{seed}_{k}.json"
+                with open(path, "w") as f:
+                    json.dump({**rec, "harness_seed": seed}, f,
+                              indent=2)
+                print(f"  repro written: {path}", flush=True)
+    verdicts: dict = {}
+    for rec in records:
+        verdicts[rec["verdict"]] = verdicts.get(rec["verdict"], 0) + 1
+    return {
+        "harness_seed": seed,
+        "slice": "tuner_poisoned_history",
+        "n_ranks": n_ranks,
+        "trials": len(records),
+        "verdicts": verdicts,
+        "failures": len(failures),
+        "records": records,
+    }
+
+
 # -- the soak loop ----------------------------------------------------
 
 
@@ -423,6 +656,14 @@ def parse_args(argv=None):
     p.add_argument("--no-corruption", action="store_true",
                    help="restrict schedules to recoverable faults "
                         "(squeezes/transients) — the control arm")
+    p.add_argument("--tuner-slice", type=int, default=None,
+                   metavar="N",
+                   help="instead of the main soak: N poisoned-history "
+                        "autotuner trials (a history file claims a "
+                        "too-small rung; every trial must still grade "
+                        "oracle-clean via the retry ladder, and the "
+                        "post-run history must record the escalated "
+                        "rung)")
     p.add_argument("--trial-deadline-s", type=float, default=300.0,
                    help="hang watchdog per trial (0 disables)")
     p.add_argument("--repro-out", default="chaos_repro.json",
@@ -452,13 +693,20 @@ def main(argv=None) -> int:
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       0.5)
 
-    summary = soak(
-        args.seed, args.trials, n_ranks=args.n_ranks,
-        corruption=not args.no_corruption,
-        only_trial=args.trial,
-        deadline_s=(args.trial_deadline_s or None),
-        repro_out=args.repro_out,
-    )
+    if args.tuner_slice:
+        summary = tuner_slice(args.seed, args.tuner_slice,
+                              n_ranks=args.n_ranks,
+                              deadline_s=(args.trial_deadline_s
+                                          or None),
+                              repro_out=args.repro_out)
+    else:
+        summary = soak(
+            args.seed, args.trials, n_ranks=args.n_ranks,
+            corruption=not args.no_corruption,
+            only_trial=args.trial,
+            deadline_s=(args.trial_deadline_s or None),
+            repro_out=args.repro_out,
+        )
     print(json.dumps({k: v for k, v in summary.items()
                       if k != "records"}))
     if args.json_output:

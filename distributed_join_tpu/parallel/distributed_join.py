@@ -539,6 +539,7 @@ def distributed_inner_join(
     verify_integrity: bool = False,
     program_cache=None,
     explain: bool = False,
+    tuner=None,
     **opts,
 ) -> JoinResult:
     """One-shot convenience: pad to rank-divisible capacity, shard the
@@ -589,6 +590,19 @@ def distributed_inner_join(
     (docs/OBSERVABILITY.md "Explain & cost model"). Plan construction
     is pure host arithmetic — no extra traces or compiles; use
     :func:`..planning.explain_join` for the plan WITHOUT running.
+
+    ``tuner``: a :class:`..planning.tuner.JoinTuner`. When given, the
+    call's workload signature is looked up in the tuner's history
+    table BEFORE the ladder resolves: a repeat workload whose ladder
+    previously escalated starts at the final rung it resolved to —
+    sizing AND rung label, so with a ``program_cache`` the dispatch
+    is the already-resident executable (zero new traces, zero
+    escalations) — and evidence-backed structural knobs (PRPD skew,
+    ragged wire) fill in when the caller left them unset. No history
+    for the signature = the exact static (tuner-off) resolution. The
+    verdict is attached host-side as ``res.tuned``
+    (``TunedConfig.as_record()``); the ladder still guards every
+    run, so a lying history costs recompiles, never wrong rows.
     """
     from distributed_join_tpu.parallel import faults, integrity
 
@@ -601,26 +615,43 @@ def distributed_inner_join(
 
     n = comm.n_ranks
 
+    tuned = None
+    if tuner is not None:
+        # Resolved on the UNPADDED tables and pre-tuned opts — the
+        # same basis JoinService keys its history entries on, so the
+        # signature the tuner reads is the one the store wrote.
+        tuned = tuner.resolve(comm, build, probe, key=key,
+                              with_integrity=verify_integrity,
+                              opts=opts)
+        opts = tuned.apply(opts)
+
     build = build.pad_to(_round_up(build.capacity, n))
     probe = probe.pad_to(_round_up(probe.capacity, n))
     if hasattr(comm, "device_put_sharded"):
         build, probe = comm.device_put_sharded((build, probe))
 
     ladder = resolve_join_ladder(build, probe, n, opts)
+    if tuned is not None:
+        ladder.seed_rung(tuned.rung)
     last_sig = None
     for attempt in range(auto_retry + 1):
+        # The rung label is ABSOLUTE (ladder.base_rung + attempt): a
+        # tuner-pre-sized first attempt carries the same label — hence
+        # the same program signature — as the executable the cold
+        # run's escalation already traced at this sizing.
+        rung = ladder.base_rung + attempt
         if program_cache is not None:
             fn, _ = program_cache.get(
                 build, probe, key=key,
                 with_integrity=verify_integrity,
-                metrics_static={"retry_attempt_max": attempt},
+                metrics_static={"retry_attempt_max": rung},
                 **ladder.sizing(), **opts)
             last_sig = fn.signature
         else:
             fn = make_distributed_join(comm, key=key,
                                        with_integrity=verify_integrity,
                                        metrics_static={
-                                           "retry_attempt_max": attempt},
+                                           "retry_attempt_max": rung},
                                        **ladder.sizing(), **opts)
         if faults.plan_validation_enabled():
             # The violation record is process-global; drop leftovers
@@ -647,6 +678,8 @@ def distributed_inner_join(
             # JoinResult traces through shard_map, and the report only
             # exists outside the compiled program.
             object.__setattr__(res, "retry_report", ladder.report())
+            if tuned is not None:
+                object.__setattr__(res, "tuned", tuned.as_record())
             if explain:
                 # Host arithmetic only (no trace/compile): the plan of
                 # the attempt that produced THIS result — its digest is
@@ -656,7 +689,7 @@ def distributed_inner_join(
                 object.__setattr__(res, "plan", planning.build_plan(
                     comm, build, probe, key=key,
                     with_integrity=verify_integrity,
-                    metrics_static={"retry_attempt_max": attempt},
+                    metrics_static={"retry_attempt_max": rung},
                     **ladder.sizing(), **opts))
             if report is not None:
                 object.__setattr__(res, "integrity_report", report)

@@ -666,9 +666,14 @@ class RetryReport:
         return None if last is None else not last
 
     def as_record(self) -> Optional[dict]:
-        """JSON-shaped record (None when the join ran once, clean — so
-        drivers can emit ``"retry": null`` for the common case)."""
-        if self.n_attempts <= 1 and self.resolved:
+        """JSON-shaped record (None when the join ran once, clean,
+        from rung 0 — so drivers can emit ``"retry": null`` for the
+        common case). A tuner-seeded ladder (``base_rung > 0``) keeps
+        its record even for a single clean attempt: the rung label
+        and its sizing ARE the information the workload-history store
+        persists for the next pre-size."""
+        if self.n_attempts <= 1 and self.resolved and (
+                not self.attempts or self.attempts[0].attempt == 0):
             return None
         return {
             "n_attempts": self.n_attempts,
@@ -705,7 +710,8 @@ class CapacityLadder:
                  hh_build_capacity: Optional[int] = None,
                  hh_probe_capacity: Optional[int] = None,
                  hh_out_capacity: Optional[int] = None,
-                 local_probe_rows: Optional[int] = None):
+                 local_probe_rows: Optional[int] = None,
+                 base_rung: int = 0):
         self.shuffle_f = shuffle_capacity_factor
         self.out_f = out_capacity_factor
         self.out_rows = out_rows_per_rank
@@ -715,8 +721,29 @@ class CapacityLadder:
         self.hh_probe = hh_probe_capacity
         self.hh_out = hh_out_capacity
         self.p_local = local_probe_rows
-        self._action = "initial"
+        # Rung-label offset for a history-pre-sized ladder (the
+        # autotuner, planning/tuner.py): a warm run starting at the
+        # sizing a cold run escalated to carries the SAME absolute
+        # rung label, so its program signature equals the executable
+        # already resident in the cache — the zero-retrace contract.
+        self.base_rung = base_rung
+        self._action = ("initial" if base_rung == 0
+                        else "tuned_presize")
         self._attempts: list = []
+
+    @property
+    def next_rung(self) -> int:
+        """Absolute rung label of the attempt about to run."""
+        return self.base_rung + len(self._attempts)
+
+    def seed_rung(self, rung: int) -> None:
+        """Start the ladder at an absolute rung label (the autotuner
+        pre-sized the knobs to a rung a previous run escalated to;
+        the sizing itself was already applied to the ladder's
+        construction kwargs). No-op for rung 0."""
+        if rung:
+            self.base_rung = int(rung)
+            self._action = "tuned_presize"
 
     def sizing(self) -> dict:
         """Keyword arguments for ``make_join_step`` /
@@ -738,7 +765,7 @@ class CapacityLadder:
         per-attempt record, streamed as it happens — a killed run
         keeps the trail its report would have carried)."""
         att = RetryAttempt(
-            attempt=len(self._attempts),
+            attempt=self.base_rung + len(self._attempts),
             action=self._action,
             overflow=overflow,
             shuffle_capacity_factor=self.shuffle_f,

@@ -9,7 +9,12 @@ cost model").
 - :mod:`.cost` — :class:`CostModel` / :func:`predict`: ROOFLINE.md's
   measured per-primitive costs as an executable per-stage wall-time
   predictor, graded post-run by ``analyze explain`` and the
-  workload-history store.
+  workload-history store, and refit from that store's measured wall
+  ratios via :func:`calibrate_from_history`;
+- :mod:`.tuner` — :class:`JoinTuner`: the history-driven autotuner
+  (ROADMAP item 5's closed loop) pre-sizing repeat workloads from the
+  per-signature trends so the retry ladder never recompiles twice for
+  the same lesson.
 """
 
 from distributed_join_tpu.planning.cost import (
@@ -17,6 +22,7 @@ from distributed_join_tpu.planning.cost import (
     DEFAULT_COST_MODEL,
     DEFAULT_PREDICTION_BAND,
     CostModel,
+    calibrate_from_history,
     predict,
     predict_exchange,
 )
@@ -29,19 +35,30 @@ from distributed_join_tpu.planning.plan import (
     build_plan,
     explain_join,
 )
+from distributed_join_tpu.planning.tuner import (
+    TUNER_SCHEMA_VERSION,
+    JoinTuner,
+    TunedConfig,
+    workload_signature,
+)
 
 __all__ = [
     "COST_MODEL_VERSION",
     "DEFAULT_COST_MODEL",
     "DEFAULT_PREDICTION_BAND",
     "EXPLAIN_SCHEMA_VERSION",
+    "TUNER_SCHEMA_VERSION",
     "CostModel",
     "JoinPlan",
+    "JoinTuner",
     "SidePlan",
+    "TunedConfig",
     "abstract_tables",
     "build_exchange_plan",
     "build_plan",
+    "calibrate_from_history",
     "explain_join",
     "predict",
     "predict_exchange",
+    "workload_signature",
 ]

@@ -82,6 +82,10 @@ class CostModel:
     collective_latency_s: float = 2.0e-5
     # v5e HBM per chip, for the footprint verdict (16 GiB).
     hbm_capacity_bytes: int = 16 * 1024**3
+    # Set by calibrate_from_history: the global measured/predicted
+    # wall scale this model was refit with (None = the as-shipped
+    # ROOFLINE.md constants).
+    calibrated_scale: Optional[float] = None
 
     @property
     def provenance(self) -> dict:
@@ -97,7 +101,10 @@ class CostModel:
                 "ici_bytes_per_s", "collective_latency_s",
                 "hbm_capacity_bytes",
             ],
-            "source": "docs/ROOFLINE.md §1/§6; BASELINE.md",
+            "source": "docs/ROOFLINE.md §1/§6; BASELINE.md"
+                      + ("" if self.calibrated_scale is None else
+                         f"; calibrated x{self.calibrated_scale:g} "
+                         "from measured history"),
         }
 
     def as_record(self) -> dict:
@@ -225,6 +232,85 @@ def _col_groups(n_cols: int) -> float:
     """ROOFLINE §1 fact 3: a packed row gather is flat in k for k <= 4
     columns, so materialization pays one gather per group of 4."""
     return max((n_cols + 3) // 4, 1)
+
+
+# The per-stage cost constants a calibration scale applies to:
+# time-per-element constants scale WITH the measured/predicted ratio,
+# bandwidth constants scale AGAINST it (wall = bytes / bandwidth).
+_TIME_CONSTANTS = (
+    "sort_ns_per_elem", "sort_lane_ns_per_elem", "scan_ns_per_elem",
+    "gather_ns_per_elem", "row_gather_ns_per_row",
+    "compact_ns_per_elem", "expand_ns_per_out_row",
+    "collective_latency_s",
+)
+_BANDWIDTH_CONSTANTS = ("hbm_bytes_per_s", "codec_bytes_per_s",
+                        "ici_bytes_per_s")
+
+
+def calibrate_from_history(entries, model: Optional[CostModel] = None,
+                           *, min_entries: int = 3,
+                           platform: Optional[str] = "tpu"):
+    """Refit the cost model from a workload-history store's
+    measured/predicted wall ratios (``prediction.wall_ratio`` per
+    entry, ``telemetry/history.py``) — the calibration seam ROADMAP
+    item 1's hardware session feeds.
+
+    Honesty contract: per-run entries carry ONE total-wall ratio, so
+    the only fit the data supports is a single multiplicative
+    correction applied uniformly — time constants scale with the
+    median ratio, bandwidth constants against it (separating
+    per-stage error needs ``--trace`` device profiles, not history
+    lines). Only entries measured on ``platform`` count (default
+    "tpu": CPU-mesh walls measure emulation, and a model refit from
+    them would be confidently wrong about the chip — the exact
+    failure mode the provenance block exists to prevent); pass
+    ``platform=None`` to calibrate against whatever was measured
+    (testing only).
+
+    Returns ``(model_or_None, report)``: None with
+    ``report["calibrated"] = False`` when fewer than ``min_entries``
+    eligible entries exist — an uncalibratable store refuses loudly
+    instead of shipping a model refit from noise.
+    """
+    base = model or DEFAULT_COST_MODEL
+    ratios = []
+    for e in entries or []:
+        pred = e.get("prediction")
+        if not isinstance(pred, dict) or not pred.get("wall_ratio"):
+            continue
+        if e.get("outcome") not in ("ok", "served", "recovered"):
+            continue
+        if platform is not None and e.get("platform") != platform:
+            continue
+        ratios.append(float(pred["wall_ratio"]))
+    report = {
+        "platform": platform,
+        "n_eligible": len(ratios),
+        "min_entries": min_entries,
+        "base_calibrated_scale": base.calibrated_scale,
+    }
+    if len(ratios) < min_entries:
+        report.update(
+            calibrated=False,
+            reason=(f"need >= {min_entries} measured "
+                    f"{platform or 'any'}-platform entries with a "
+                    f"wall ratio, have {len(ratios)}"))
+        return None, report
+    ratios.sort()
+    scale = ratios[len(ratios) // 2]
+    fields = {k: getattr(base, k) * scale for k in _TIME_CONSTANTS}
+    fields.update({k: getattr(base, k) / scale
+                   for k in _BANDWIDTH_CONSTANTS})
+    calibrated = dataclasses.replace(
+        base, calibrated_scale=round(scale, 6), **fields)
+    report.update(
+        calibrated=True,
+        scale=round(scale, 6),
+        ratio_min=round(ratios[0], 4),
+        ratio_median=round(scale, 4),
+        ratio_max=round(ratios[-1], 4),
+    )
+    return calibrated, report
 
 
 def predict_exchange(n_ranks: int, bytes_per_rank: int,

@@ -1,0 +1,556 @@
+"""History-driven autotuner — the closed loop of ROADMAP item 5.
+
+The repo *measures* everything needed to pick an execution
+configuration: the workload-history store (:mod:`..telemetry.history`)
+records, per workload signature, what the retry ladder resolved to,
+the device-counter skew/headroom indicators, and the cost-model
+prediction error; the :class:`~.plan.JoinPlan` makes the static knob
+resolution inspectable. Until this module a human had to read them
+(``analyze history`` / ``analyze diagnose``) and re-run with better
+flags. :class:`JoinTuner` closes the loop:
+
+- **first run conservative** — no history for a signature means no
+  overrides: the join runs exactly ``build_plan``'s static resolution
+  (the tuner-off program, byte-identical);
+- **later runs pre-sized** — a workload whose ladder previously
+  escalated starts at the FINAL rung it resolved to: the adopted
+  sizing *and* the rung label, so the dispatch signature equals the
+  executable the cold run already traced — a warm tuned repeat is a
+  program-cache hit with ZERO new traces and ZERO ladder escalations
+  (the cost ADVICE.md flags the skew auto-policy paying when its
+  sizing model is wrong, eliminated for repeat workloads);
+- **structural knobs from evidence** — PRPD skew handling turns on
+  when the observed per-rank key-skew Gini crosses the diagnosis
+  threshold; the exact-size ragged wire replaces padded when the
+  measured wire efficiency shows padding dominating the bytes. These
+  change the program (one trace on the first tuned run, warm after),
+  and only ever fill knobs the caller left UNSET — an explicit
+  structural choice is never overridden;
+- **never correctness for speed** — the tuner only picks starting
+  points; the capacity ladder still guards every run, so a lying
+  history (too-small claimed capacities) costs recompiles, not wrong
+  rows, and the corrected rung lands back in the store for the next
+  run (the chaos harness grades exactly this, ``chaos --tuner-slice``).
+
+Sizing knobs (capacity factors, ``out_rows_per_rank``, compression
+width, HH block sizes) OVERRIDE caller values: the history is
+evidence that this exact workload signature — which already binds the
+caller's values — overflowed them, and pre-applying the ladder's own
+escalation is the entire point. Structural knobs (``shuffle`` mode,
+``skew_threshold``) fill only when absent.
+
+Surfaces: ``distributed_inner_join(tuner=)``,
+``JoinService`` / ``tpu-join-service --auto-tune``, the drivers' and
+bench.py's ``--auto-tune[=HISTORY]`` (capacity pre-sizing only — the
+driver store keys workloads by flag identity, where a mode switch
+would fork the signature), and ``analyze tune`` (the dry run: knob
+delta vs the static plan, per signature).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import os
+from typing import Optional
+
+TUNER_SCHEMA_VERSION = 1
+
+# The ladder's own axes: pre-applied from history, overriding caller
+# values (see module docstring for why override is correct here).
+SIZING_KNOBS = (
+    "shuffle_capacity_factor", "out_capacity_factor",
+    "out_rows_per_rank", "compression_bits",
+    "hh_build_capacity", "hh_probe_capacity", "hh_out_capacity",
+)
+# Program-shape knobs: filled only when the caller left them unset.
+STRUCTURAL_KNOBS = ("shuffle", "skew_threshold")
+
+# The measured sweep default the skew recommendation names
+# (telemetry/analyze.recommend's skew_enable_prpd flag).
+DEFAULT_SKEW_THRESHOLD = 0.001
+# Headroom bump mirrors analyze.recommend's shuffle_headroom advice.
+HEADROOM_BUMP = 1.5
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def workload_signature(comm, build, probe, key="key",
+                       with_metrics=None, with_integrity: bool = False,
+                       **opts) -> str:
+    """THE rung-stable workload identity (16 hex chars): the program
+    cache's canonical signature digest over the tables and the
+    caller's PRE-TUNED options — the ladder resolves its sizing at
+    dispatch and the tuner applies its overrides after this hash, so
+    one workload keeps one identity across rungs and tuned re-runs.
+    Shared by :class:`~..service.server.JoinService` (its history
+    entries and live-metrics keys) and
+    ``distributed_inner_join(tuner=)`` so writer and reader can never
+    disagree. Unknown option combinations still get an identity (the
+    join itself refuses them loudly) via a shape+options hash."""
+    from distributed_join_tpu import telemetry
+
+    if with_metrics is None:
+        with_metrics = telemetry.enabled()
+    try:
+        from distributed_join_tpu.service.programs import JoinSignature
+
+        return JoinSignature.of(
+            comm, build, probe, key=key, with_metrics=with_metrics,
+            with_integrity=with_integrity, **opts).digest()[:16]
+    except Exception:
+        basis = json.dumps(
+            {"key": key,
+             "build": sorted(build.columns),
+             "probe": sorted(probe.columns),
+             "opts": sorted((k, repr(v)) for k, v in opts.items())},
+            sort_keys=True, default=str)
+        return hashlib.sha256(basis.encode()).hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class TunedConfig:
+    """One signature's tuning verdict: what to override (sizing), what
+    to fill (structural), which rung to label the first attempt with,
+    and the evidence. ``source`` is ``"history"`` when anything was
+    adopted, ``"static"`` for the conservative no-history first run."""
+
+    signature: str
+    source: str = "static"
+    rung: int = 0
+    sizing: dict = dataclasses.field(default_factory=dict)
+    structural: dict = dataclasses.field(default_factory=dict)
+    basis: dict = dataclasses.field(default_factory=dict)
+    applied: dict = dataclasses.field(default_factory=dict)
+
+    def apply(self, opts: dict) -> dict:
+        """Merge this verdict into a join's option dict (returns a new
+        dict; ``opts`` untouched). Structural knobs fill only when
+        absent; sizing knobs override. HH capacities apply only when
+        the merged options actually run the skew path — injecting them
+        into a skew-off program would fork its cache signature for
+        options the step never reads. Records what changed in
+        ``self.applied``."""
+        new = dict(opts)
+        applied = {}
+        for k, v in self.structural.items():
+            if k not in new:
+                new[k] = v
+                applied[k] = v
+        skew_on = new.get("skew_threshold") is not None
+        for k, v in self.sizing.items():
+            if k.startswith("hh_") and not skew_on:
+                continue
+            if new.get(k) != v:
+                applied[k] = v
+            new[k] = v
+        self.applied = applied
+        return new
+
+    def as_record(self) -> dict:
+        return {
+            "schema_version": TUNER_SCHEMA_VERSION,
+            "signature": self.signature,
+            "source": self.source,
+            "rung": self.rung,
+            "sizing": dict(self.sizing),
+            "structural": dict(self.structural),
+            "applied": dict(self.applied),
+            "basis": dict(self.basis),
+        }
+
+
+class JoinTuner:
+    """Per-signature knob selection from a :class:`~..telemetry.
+    history.WorkloadHistory` store plus the roofline cost model.
+
+    In-memory table of :class:`~..telemetry.history.SignatureTrend`
+    aggregates: load once from a history file (missing file = empty =
+    every workload static), then feed live entries via
+    :meth:`observe_entry` (the JoinService wiring — the tuner learns
+    within one server lifetime, including the corrected rung after a
+    mis-sized pre-size). Thresholds default to the diagnosis layer's
+    (``telemetry/analyze.py``), so the tuner automates exactly the
+    recommendations ``analyze diagnose`` prints."""
+
+    def __init__(self, history: Optional[str] = None, *,
+                 min_entries: int = 1,
+                 skew_gini_warn: Optional[float] = None,
+                 wire_efficiency_warn: Optional[float] = None,
+                 headroom_ratio_warn: Optional[float] = None):
+        from distributed_join_tpu.telemetry.analyze import (
+            HEADROOM_RATIO_WARN,
+            SKEW_GINI_WARN,
+            WIRE_EFFICIENCY_WARN,
+        )
+
+        self.path = None
+        self.min_entries = int(min_entries)
+        self.skew_gini_warn = (skew_gini_warn if skew_gini_warn
+                               is not None else SKEW_GINI_WARN)
+        self.wire_efficiency_warn = (
+            wire_efficiency_warn if wire_efficiency_warn is not None
+            else WIRE_EFFICIENCY_WARN)
+        self.headroom_ratio_warn = (
+            headroom_ratio_warn if headroom_ratio_warn is not None
+            else HEADROOM_RATIO_WARN)
+        self._trends: dict = {}
+        self.observed = 0
+        self.recommendations = 0
+        self.history_hits = 0
+        if history:
+            self.load(history)
+
+    # -- the write side (what the tuner knows) -------------------------
+
+    def load(self, history: str) -> int:
+        """(Re)load a history store; missing file = empty table (every
+        workload starts static). Returns entries loaded."""
+        from distributed_join_tpu.telemetry import history as hist
+
+        self.path = hist.history_path(history)
+        self._trends = {}
+        self.observed = 0
+        if not os.path.exists(self.path):
+            return 0
+        entries, _ = hist.load_history(self.path)
+        for e in entries:
+            self.observe_entry(e)
+        return len(entries)
+
+    def observe_entry(self, entry: dict) -> None:
+        """Fold one history entry (request/run/rollup line) into the
+        per-signature table — the JoinService calls this right after
+        appending to its store, so a pre-size that still escalated is
+        corrected for the very next request."""
+        from distributed_join_tpu.telemetry.history import (
+            SignatureTrend,
+        )
+
+        sig = entry.get("signature") or "?"
+        self._trends.setdefault(sig, SignatureTrend()).add(entry)
+        self.observed += 1
+
+    def stats(self) -> dict:
+        return {
+            "signatures": len(self._trends),
+            "observed": self.observed,
+            "recommendations": self.recommendations,
+            "history_hits": self.history_hits,
+            "min_entries": self.min_entries,
+            "history_path": self.path,
+        }
+
+    # -- the read side (the decision) ----------------------------------
+
+    def recommend(self, signature: str, user_opts: Optional[dict] = None,
+                  *, side_geometry: Optional[dict] = None
+                  ) -> TunedConfig:
+        """The knob verdict for one workload signature.
+
+        ``user_opts`` is the caller's raw option dict (structural
+        knobs present there are never filled). ``side_geometry`` —
+        ``{"b_local", "p_local", "nb", "row_bytes": {side: int}}`` —
+        enables the shape-dependent policies (headroom ratio, wire
+        efficiency); :meth:`resolve` supplies it from real tables.
+
+        Policy, in order (each clause records its evidence in
+        ``basis``):
+
+        1. no trend / fewer than ``min_entries`` entries / no
+           successful run / counter DRIFT at unchanged sizing ->
+           static (conservative; drift means the data moved and old
+           sizing is stale evidence);
+        2. ladder escalations on record -> adopt the final rung's
+           sizing AND its rung label (the zero-recompile warm path);
+        3. else tight overflow-margin headroom (margin below
+           ``headroom_ratio_warn`` of the per-bucket capacity) ->
+           bump ``shuffle_capacity_factor`` by ``HEADROOM_BUMP``
+           before the next data drift trips a recompile;
+        4. key-skew Gini over the warn threshold -> enable PRPD
+           (``skew_threshold=0.001``) when the caller didn't choose;
+        5. padded wire efficiency under the warn threshold -> switch
+           to the exact-size ragged wire when the caller didn't
+           choose a mode and compression is off.
+        """
+        user_opts = user_opts or {}
+        self.recommendations += 1
+        cfg = TunedConfig(signature=signature)
+        trend = self._trends.get(signature)
+        if trend is None or trend.entries < self.min_entries:
+            cfg.basis["note"] = (
+                f"no history for signature ({trend.entries if trend else 0}"
+                f"/{self.min_entries} entries) — static plan")
+            return cfg
+        cfg.basis["entries"] = trend.entries
+        if trend.successes == 0:
+            cfg.basis["note"] = ("no successful run on record — "
+                                 "refusing to pre-size from failures")
+            return cfg
+        if trend.counter_drift:
+            cfg.basis["note"] = (
+                "counter signature drifted at unchanged sizing — data "
+                "moved; re-observing before pre-sizing")
+            return cfg
+
+        # 2. adopt the escalated rung's sizing.
+        if trend.escalations and trend.resolved_knobs_last:
+            cfg.sizing = {k: v for k, v
+                          in trend.resolved_knobs_last.items()
+                          if k in SIZING_KNOBS}
+            cfg.rung = int(trend.resolved_rung_last or 0)
+            cfg.source = "history"
+            cfg.basis["adopted_rung"] = {
+                "escalations": trend.escalations,
+                "rung": cfg.rung,
+            }
+        elif side_geometry:
+            # 3. no escalations: check the recorded headroom against
+            # the capacity the observed factor implies.
+            bump = self._headroom_bump(trend, user_opts,
+                                       side_geometry)
+            if bump is not None:
+                cfg.sizing["shuffle_capacity_factor"] = bump[0]
+                cfg.source = "history"
+                cfg.basis["headroom"] = bump[1]
+
+        # 4. skew: enable PRPD on observed per-rank imbalance.
+        if "skew_threshold" not in user_opts:
+            gini = self._worst_gini(trend.indicators_last)
+            if gini is not None and gini[1] > self.skew_gini_warn:
+                cfg.structural["skew_threshold"] = \
+                    DEFAULT_SKEW_THRESHOLD
+                cfg.source = "history"
+                cfg.basis["skew"] = {"counter": gini[0],
+                                     "gini": gini[1],
+                                     "warn": self.skew_gini_warn}
+
+        # 5. wire: padding-dominated bytes -> ragged exact-size wire.
+        if ("shuffle" not in user_opts
+                and user_opts.get("compression_bits") is None
+                and "compression_bits" not in cfg.sizing
+                and side_geometry):
+            eff = self._wire_efficiency(trend.counters_last,
+                                        side_geometry)
+            if eff is not None and eff[1] < self.wire_efficiency_warn:
+                cfg.structural["shuffle"] = "ragged"
+                cfg.source = "history"
+                cfg.basis["wire"] = {"side": eff[0],
+                                     "efficiency": eff[1],
+                                     "warn": self.wire_efficiency_warn}
+        if cfg.source == "history":
+            self.history_hits += 1
+        return cfg
+
+    def resolve(self, comm, build, probe, *, key="key",
+                with_integrity: bool = False,
+                opts: Optional[dict] = None) -> TunedConfig:
+        """The full library-path resolution
+        (``distributed_inner_join(tuner=)``): compute the workload
+        signature from the call exactly as the service's history
+        entries are keyed, derive the shape geometry for the
+        shape-dependent policies, and return the verdict."""
+        opts = dict(opts or {})
+        wm = opts.pop("with_metrics", None)
+        wi = opts.pop("with_integrity", with_integrity)
+        sig = workload_signature(comm, build, probe, key=key,
+                                 with_metrics=wm, with_integrity=wi,
+                                 **opts)
+        n = comm.n_ranks
+        k = int(opts.get("over_decomposition") or 1)
+        geometry = {
+            "nb": n * k,
+            "n_ranks": n,
+            "b_local": _round_up(build.capacity, n) // n,
+            "p_local": _round_up(probe.capacity, n) // n,
+            "row_bytes": {
+                "build": _fixed_row_bytes(build),
+                "probe": _fixed_row_bytes(probe),
+            },
+        }
+        return self.recommend(sig, user_opts=opts,
+                              side_geometry=geometry)
+
+    # -- policy helpers ------------------------------------------------
+
+    @staticmethod
+    def _worst_gini(indicators):
+        worst = None
+        for name, d in (indicators or {}).items():
+            if not isinstance(d, dict) or "gini" not in d:
+                continue
+            if worst is None or d["gini"] > worst[1]:
+                worst = (name, d["gini"])
+        return worst
+
+    def _headroom_bump(self, trend, user_opts: dict,
+                       geometry: dict):
+        """(new_factor, basis) when any side's recorded minimum
+        overflow margin is within ``headroom_ratio_warn`` of its
+        per-bucket capacity — the pre-emptive half of capacity tuning
+        (the reactive half is rung adoption)."""
+        ind = trend.indicators_last or {}
+        factor = float(
+            (trend.resolved_knobs_last or {}).get(
+                "shuffle_capacity_factor")
+            or user_opts.get("shuffle_capacity_factor")
+            or _static_defaults()["shuffle_capacity_factor"])
+        nb = geometry["nb"]
+        if nb <= 1:
+            return None
+        tight = None
+        for side, local in (("build", geometry["b_local"]),
+                            ("probe", geometry["p_local"])):
+            margin = ind.get(f"{side}.overflow_margin_min")
+            if margin is None:
+                continue
+            cap = _round_up(
+                int(math.ceil(local / nb * factor)), 8)
+            if cap <= 0:
+                continue
+            ratio = margin / cap
+            if 0 <= ratio < self.headroom_ratio_warn:
+                if tight is None or ratio < tight["ratio"]:
+                    tight = {"side": side, "margin_rows": int(margin),
+                             "capacity_rows": cap,
+                             "ratio": round(ratio, 4)}
+        if tight is None:
+            return None
+        new_factor = round(factor * HEADROOM_BUMP, 6)
+        tight["factor"] = {"from": factor, "to": new_factor}
+        return new_factor, tight
+
+    def _wire_efficiency(self, counters, geometry: dict):
+        """(side, efficiency) of the worst side from the last
+        recorded device counters: actual payload bytes over wire
+        bytes (fixed-width schema estimate — the same basis as
+        ``analyze``'s wire-efficiency indicator)."""
+        if not counters:
+            return None
+        worst = None
+        for side in ("build", "probe"):
+            wire = counters.get(f"{side}.wire_bytes")
+            rows = counters.get(f"{side}.rows_shuffled")
+            row_bytes = geometry["row_bytes"].get(side)
+            if not wire or not rows or not row_bytes:
+                continue
+            eff = round((rows * row_bytes) / wire, 4)
+            if worst is None or eff < worst[1]:
+                worst = (side, eff)
+        return worst
+
+    # -- the dry run (analyze tune) ------------------------------------
+
+    def dry_run(self, signature: Optional[str] = None) -> dict:
+        """The ``analyze tune`` record: every known signature's (or
+        one signature's) verdict plus the knob delta vs the static
+        defaults — what a tuned run WOULD change, with evidence,
+        executing nothing."""
+        statics = _static_defaults()
+        sigs = ([signature] if signature
+                else sorted(self._trends))
+        out: dict = {}
+        for sig in sigs:
+            cfg = self.recommend(sig)
+            trend = self._trends.get(sig)
+            t = trend.as_dict() if trend is not None else None
+            knobs = {**cfg.structural, **cfg.sizing}
+            out[sig] = {
+                "source": cfg.source,
+                "rung": cfg.rung,
+                "knobs": knobs,
+                "delta": {
+                    k: {"static": statics.get(k), "tuned": v}
+                    for k, v in sorted(knobs.items())
+                    if statics.get(k) != v
+                },
+                "basis": cfg.basis,
+                "trend": {
+                    "entries": t["entries"],
+                    "outcomes": t["outcomes"],
+                    "escalations": t["escalations"],
+                    "counter_drift": t["counter_drift"],
+                } if t else None,
+            }
+        return {
+            "schema_version": TUNER_SCHEMA_VERSION,
+            "kind": "tune",
+            "history": self.path,
+            "n_signatures": len(out),
+            "signatures": out,
+        }
+
+
+def _fixed_row_bytes(table) -> Optional[int]:
+    """Fixed-width bytes/row over a Table's columns (length-companion
+    columns excluded — they describe, not ship). A shape-level
+    estimate for the wire-efficiency policy, not the exact wire
+    schema (string-key packing happens downstream)."""
+    import numpy as np
+
+    total = 0
+    try:
+        for name, c in table.columns.items():
+            if name.endswith("#len"):
+                continue
+            trailing = 1
+            for d in c.shape[1:]:
+                trailing *= int(d)
+            total += np.dtype(c.dtype).itemsize * trailing
+    except Exception:
+        return None
+    return total or None
+
+
+def _static_defaults() -> dict:
+    """The knob values a tuner-off run resolves to (the "static plan"
+    column of ``analyze tune``'s delta)."""
+    from distributed_join_tpu.parallel.distributed_join import (
+        DEFAULT_OUT_CAPACITY_FACTOR,
+        DEFAULT_SHUFFLE_CAPACITY_FACTOR,
+    )
+
+    return {
+        "shuffle_capacity_factor": DEFAULT_SHUFFLE_CAPACITY_FACTOR,
+        "out_capacity_factor": DEFAULT_OUT_CAPACITY_FACTOR,
+        "out_rows_per_rank": None,
+        "compression_bits": None,
+        "hh_build_capacity": None,
+        "hh_probe_capacity": None,
+        "hh_out_capacity": None,
+        "shuffle": "padded",
+        "skew_threshold": None,
+    }
+
+
+def format_tune(record: dict) -> str:
+    """Human rendering of :meth:`JoinTuner.dry_run` (the ``analyze
+    tune`` default output)."""
+    lines = [f"tune: {record['n_signatures']} signature(s)"
+             + (f"  [{record['history']}]" if record.get("history")
+                else "")]
+    for sig, v in record["signatures"].items():
+        trend = v.get("trend") or {}
+        lines.append(
+            f"  {sig}: {v['source']}"
+            + (f" (rung {v['rung']})" if v["rung"] else "")
+            + (f"  [{trend.get('entries', 0)} run(s), "
+               f"{trend.get('escalations', 0)} escalation(s)]"
+               if trend else ""))
+        for k, d in (v.get("delta") or {}).items():
+            lines.append(f"    {k}: {d['static']} -> {d['tuned']}")
+        basis = v.get("basis") or {}
+        note = basis.get("note")
+        if note:
+            lines.append(f"    note: {note}")
+        for kind in ("adopted_rung", "headroom", "skew", "wire"):
+            if kind in basis:
+                lines.append(f"    evidence[{kind}]: "
+                             f"{json.dumps(basis[kind], sort_keys=True)}")
+        if not v.get("delta") and not note:
+            lines.append("    no knob changes vs the static plan")
+    return "\n".join(lines)

@@ -78,6 +78,19 @@ class AdmissionError(RuntimeError):
     refusal instead of an unbounded queue hiding an overloaded mesh."""
 
 
+def _backend_platform() -> Optional[str]:
+    """The serving backend's platform name for history entries (the
+    cost-model calibration seam trusts only real-hardware walls). The
+    service has long since touched devices by observe time, so this
+    never triggers a fresh backend init."""
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # pragma: no cover - backend-dependent
+        return None
+
+
 @dataclasses.dataclass
 class ServiceConfig:
     """Serving policy knobs (the per-run driver flags, made resident).
@@ -87,10 +100,21 @@ class ServiceConfig:
     wire-integrity contracts of ``distributed_inner_join``, applied to
     every request; ``persist_dir`` arms the cache's on-disk AOT tier.
     ``history_dir`` (default: ``persist_dir``) arms the per-request
-    workload-history store; ``flight_records`` sizes the postmortem
-    ring, and ``flight_recorder_path`` pins where a poison/terminal
-    dump lands (default: the telemetry session dir, else the history
-    dir, else cwd).
+    workload-history store, bounded by ``history_max_entries`` (live
+    entries kept per signature before the file compacts older ones
+    into rollup lines; None = unbounded); ``flight_records`` sizes the
+    postmortem ring, and ``flight_recorder_path`` pins where a
+    poison/terminal dump lands (default: the telemetry session dir,
+    else the history dir, else cwd).
+
+    ``auto_tune`` arms the history-driven autotuner
+    (:class:`~..planning.tuner.JoinTuner`): every join consults the
+    per-signature tuned-config table — fed live by this service's own
+    request history, pre-loaded from ``tuner_history`` (default: the
+    history store's file, so a restarted server keeps its tuning) —
+    and repeat workloads dispatch pre-sized at the rung their ladder
+    previously escalated to (zero retry recompiles). Off by default:
+    tuner-off is the exact historical dispatch path.
     """
 
     auto_retry: int = 2
@@ -101,6 +125,9 @@ class ServiceConfig:
     max_programs: int = 128
     persist_dir: Optional[str] = None
     history_dir: Optional[str] = None
+    history_max_entries: Optional[int] = None
+    auto_tune: bool = False
+    tuner_history: Optional[str] = None
     flight_records: int = 256
     flight_recorder_path: Optional[str] = None
 
@@ -137,8 +164,21 @@ class JoinService:
         # explicit join: the dir may not exist yet, and history_path
         # only maps EXISTING directories to their history.jsonl
         self.history = (tel_history.WorkloadHistory(
-            os.path.join(hist_dir, tel_history.HISTORY_FILENAME))
+            os.path.join(hist_dir, tel_history.HISTORY_FILENAME),
+            max_entries_per_signature=self.config.history_max_entries)
             if hist_dir else None)
+        # The autotuner (docs/OBSERVABILITY.md "Autotuner"): per-
+        # signature tuned-config table, pre-loaded from the persisted
+        # history (restart warmth) and fed live by _observe so a
+        # mis-sized pre-size is corrected for the very next request.
+        self.tuner = None
+        if self.config.auto_tune:
+            from distributed_join_tpu.planning.tuner import JoinTuner
+
+            preload = self.config.tuner_history or (
+                self.history.path if self.history is not None
+                else None)
+            self.tuner = JoinTuner(preload)
         # Per-signature predicted-wall memo (plan construction is
         # cheap host arithmetic, but one join stream hits the same
         # signature thousands of times). Bounded; cleared wholesale.
@@ -262,7 +302,8 @@ class JoinService:
                         build, probe, self.comm, key=key,
                         auto_retry=self.config.auto_retry,
                         verify_integrity=self.config.verify_integrity,
-                        program_cache=self.cache, **opts)
+                        program_cache=self.cache,
+                        tuner=self.tuner, **opts)
 
                 deadline = self.config.request_deadline_s
                 traces0 = self.cache.traces
@@ -404,6 +445,22 @@ class JoinService:
                 "cost": plan.cost,
                 "cache": self.cache.predict_hit(plan.digest),
             }
+            if self.tuner is not None:
+                # The tuner's verdict for this workload — which knobs
+                # a join would dispatch with and WHY (the operator's
+                # "why was this knob chosen" surface). Resolved via
+                # the SAME path the join's dispatch uses (tables +
+                # opts through tuner.resolve, shape geometry
+                # included), so the shape-gated policies — headroom
+                # bump, ragged wire switch — answer here exactly as
+                # they would at dispatch. Note the plan/cache verdict
+                # above describes the STATIC resolution; a
+                # history-tuned join dispatches under the tuned
+                # sizing's own signature.
+                out["tuned"] = self.tuner.resolve(
+                    self.comm, build, probe, key=key,
+                    with_integrity=self.config.verify_integrity,
+                    opts=opts).as_record()
         except BaseException:
             # A failing dry run (unknown option, malformed spec) must
             # be visible on the operator surfaces too, not only to the
@@ -460,27 +517,20 @@ class JoinService:
         cache's canonical signature digest, truncated. Coarser than
         the per-rung entries the cache stores (the ladder resolves its
         sizing at dispatch) — one workload keeps one hash across its
-        rungs."""
+        rungs. Delegates to :func:`..planning.tuner.workload_
+        signature` — the SAME function the tuner's lookup inside
+        ``distributed_inner_join`` uses, so the history's writer and
+        its reader can never key apart."""
+        from distributed_join_tpu.planning.tuner import (
+            workload_signature,
+        )
+
         o = dict(opts)
         wm = o.pop("with_metrics", None)
         wi = o.pop("with_integrity", self.config.verify_integrity)
-        try:
-            return self.cache.signature(
-                build, probe, key=key, with_metrics=wm,
-                with_integrity=wi, **o).digest()[:16]
-        except Exception:
-            # Unknown option combinations still deserve an identity
-            # (the join itself will refuse them loudly) — hash the
-            # shapes + options directly.
-            import hashlib
-
-            basis = json.dumps(
-                {"key": key,
-                 "build": sorted(build.columns),
-                 "probe": sorted(probe.columns),
-                 "opts": sorted((k, repr(v)) for k, v in opts.items())},
-                sort_keys=True, default=str)
-            return hashlib.sha256(basis.encode()).hexdigest()[:16]
+        return workload_signature(self.comm, build, probe, key=key,
+                                  with_metrics=wm, with_integrity=wi,
+                                  **o)
 
     def _observe(self, rid, op, sig, outcome, res, err, elapsed_s,
                  new_traces, cache_hits, predicted_wall_s=None,
@@ -495,6 +545,8 @@ class JoinService:
             rung_path = None
             matches = None
             overflow = None
+            tuned = (getattr(res, "tuned", None)
+                     if res is not None else None)
             if res is not None and outcome == "served":
                 rr = getattr(res, "retry_report", None)
                 if rr is not None:
@@ -522,18 +574,27 @@ class JoinService:
                 elapsed_s=round(elapsed_s, 6), matches=matches,
                 overflow=overflow, new_traces=new_traces,
                 cache_hits=cache_hits, rung_path=rung_path,
+                tuned=tel_history.tuned_summary(tuned),
                 error=error)
-            if self.history is not None:
+            if self.history is not None or self.tuner is not None:
                 tel = (getattr(res, "telemetry", None)
                        if res is not None else None)
-                self.history.append(tel_history.request_entry(
+                entry = tel_history.request_entry(
                     request_id=rid, op=op, signature=sig,
                     outcome=outcome, wall_s=elapsed_s,
                     new_traces=new_traces, cache_hits=cache_hits,
                     matches=matches, retry_record=retry_rec,
                     metrics=tel.to_dict() if tel is not None else None,
                     predicted_wall_s=predicted_wall_s,
-                    error=error))
+                    tuned=tuned, platform=_backend_platform(),
+                    error=error)
+                if self.history is not None:
+                    self.history.append(entry)
+                if self.tuner is not None:
+                    # Close the loop in-process: the next request of
+                    # this signature sees this outcome — including a
+                    # corrected rung after a mis-sized pre-size.
+                    self.tuner.observe_entry(entry)
             if outcome == "hang":
                 self.dump_flight_recorder(
                     f"poisoned: request {rid} blew its deadline")
@@ -581,6 +642,8 @@ class JoinService:
             "latency_by_op": self.live.latency_by_op(),
             "poisoned": self.poisoned,
             "cache": self.cache.stats(),
+            "tuner": (self.tuner.stats() if self.tuner is not None
+                      else None),
         }
 
     def metrics_snapshot(self) -> dict:
@@ -914,6 +977,14 @@ def parse_args(argv=None):
                         "counter signature, indicators, resolved "
                         "knobs, wall time; summarize with `analyze "
                         "history`). Default: --persist-dir when set")
+    p.add_argument("--history-max-entries", type=int, default=None,
+                   metavar="N",
+                   help="bound the history store: keep the last N "
+                        "live entries per workload signature, "
+                        "compacting older ones into one rolled-up "
+                        "summary line per signature (the per-"
+                        "signature trend survives, the file stops "
+                        "growing). Default: unbounded")
     p.add_argument("--flight-records", type=int, default=256,
                    help="flight-recorder ring size: the last-N "
                         "per-request records dumped as "
@@ -978,6 +1049,12 @@ def _service_from_args(args) -> JoinService:
         max_programs=args.max_programs,
         persist_dir=args.persist_dir,
         history_dir=args.history_dir,
+        history_max_entries=args.history_max_entries,
+        # --auto-tune (shared flag, benchmarks.add_robustness_args):
+        # bare = learn from this service's own history store; a PATH
+        # additionally pre-loads that file's trends at startup.
+        auto_tune=args.auto_tune is not None,
+        tuner_history=(args.auto_tune or None),
         flight_records=args.flight_records,
         flight_recorder_path=args.flight_recorder_path,
     )

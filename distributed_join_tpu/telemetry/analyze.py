@@ -24,15 +24,18 @@ the loop:
   ``--diagnose`` flag lands here via ``benchmarks.run_guarded``);
 - the CLI (``python -m distributed_join_tpu.telemetry.analyze``)
   exposes ``diagnose`` / ``report`` / ``compare`` / ``explain`` /
-  ``history`` / ``check``, where ``compare`` is the perf gate:
+  ``history`` / ``tune`` / ``check``, where ``compare`` is the perf
+  gate:
   non-zero exit on counter-signature drift or banded wall-time
   regression against a committed baseline (:mod:`.baselines`; the
   ``perfgate`` lane of ``scripts/run_tier1.sh``); ``explain`` grades
   an ``explain.json`` plan's predictions against measured counters
   (EXPLAIN ANALYZE — the padded-mode wire-byte prediction is an
-  exact CI gate via ``--gate-wire-bytes``); and ``history``
-  summarizes a workload-history store (:mod:`.history`) per
-  signature, including cost-model prediction drift.
+  exact CI gate via ``--gate-wire-bytes``); ``history`` summarizes a
+  workload-history store (:mod:`.history`) per signature, including
+  cost-model prediction drift; and ``tune`` dry-runs the autotuner
+  (:mod:`..planning.tuner`) against a store, printing the knob delta
+  a tuned run would dispatch with vs the static plan.
 
 Deliberately device-free: analysis runs on the artifacts, never the
 accelerators, so it works on a laptop against files scp'd from a pod.
@@ -754,8 +757,8 @@ def _sniff_history_lines(path: str) -> bool:
         doc = json.loads(first)
     except (OSError, ValueError):
         return False
-    return isinstance(doc, dict) and doc.get("kind") in ("request",
-                                                         "run")
+    return isinstance(doc, dict) and doc.get("kind") in (
+        "request", "run", "rollup")
 
 
 def check_file(path: str) -> list:
@@ -782,7 +785,17 @@ def check_file(path: str) -> list:
                     torn.append((i, exc))
                     continue
                 kind = ev.get("kind")
-                if history_file or kind in ("request", "run"):
+                if kind == "rollup":
+                    # Compaction summary line (history.WorkloadHistory
+                    # with --history-max-entries): per-signature
+                    # aggregate of rolled-up entries.
+                    for key in ("schema_version", "signature",
+                                "entries"):
+                        if key not in ev:
+                            problems.append(
+                                f"line {i}: rollup entry missing "
+                                f"{key!r}")
+                elif history_file or kind in ("request", "run"):
                     # Workload-history lines (telemetry/history.py):
                     # recognized by basename OR by their own kind
                     # stamp (the --history flag accepts any filename).
@@ -964,6 +977,23 @@ def main(argv=None) -> int:
                     help="print the summary JSON instead of the "
                          "human report")
 
+    tn = sub.add_parser(
+        "tune",
+        help="dry-run the autotuner (planning/tuner.py) against a "
+             "history store: per signature, the knobs a tuned run "
+             "would dispatch with and the delta vs the static plan "
+             "— nothing executes")
+    tn.add_argument("path",
+                    help="history.jsonl, or a directory containing it")
+    tn.add_argument("--signature", default=None,
+                    help="dry-run one workload signature only")
+    tn.add_argument("--min-entries", type=int, default=1,
+                    help="history entries a signature needs before "
+                         "the tuner pre-sizes (default 1)")
+    tn.add_argument("--json", action="store_true",
+                    help="print the tune record JSON instead of the "
+                         "human report")
+
     ex = sub.add_parser(
         "explain",
         help="EXPLAIN ANALYZE: grade an explain.json's predictions "
@@ -1033,6 +1063,20 @@ def main(argv=None) -> int:
             else:
                 print(history.format_summary(
                     summary, path=history.history_path(args.path)))
+            return 0
+        if args.cmd == "tune":
+            from distributed_join_tpu.planning.tuner import (
+                JoinTuner,
+                format_tune,
+            )
+
+            tuner = JoinTuner(args.path,
+                              min_entries=args.min_entries)
+            record = tuner.dry_run(signature=args.signature)
+            if args.json:
+                print(json.dumps(record, indent=1))
+            else:
+                print(format_tune(record))
             return 0
         if args.cmd == "explain":
             with open(args.explain) as f:

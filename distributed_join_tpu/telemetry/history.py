@@ -21,7 +21,18 @@ that substrate:
 - :func:`load_history` / :func:`summarize` / :func:`format_summary` —
   the read side behind ``python -m distributed_join_tpu.telemetry.
   analyze history``: per-signature trends (runs, outcomes, wall-time
-  quantiles, escalations, latest resolved knobs).
+  quantiles, escalations, latest resolved knobs);
+- :class:`SignatureTrend` — ONE incremental per-signature aggregate
+  shared by ``summarize`` and the autotuner
+  (:mod:`..planning.tuner`), so what the summary prints and what the
+  tuner pre-sizes from can never drift apart.
+
+Under heavy traffic the store is bounded: pass
+``max_entries_per_signature`` (the service's ``--history-max-entries``
+knob) and the file compacts itself — the last N entries per signature
+stay verbatim, everything older rolls up into one ``kind: "rollup"``
+summary line per signature (counts, outcomes, escalations, last
+resolved knobs), so the trend survives while the file stops growing.
 
 Deliberately device-free, like :mod:`.analyze`: the store is files,
 and the summarizer runs anywhere the files do.
@@ -73,12 +84,25 @@ class WorkloadHistory:
     """Append-only JSONL store. Thread-safe appends over one
     persistent line-buffered handle (the TelemetrySink log pattern:
     flushed per line, so a killed server keeps its history; no
-    per-request open/close on the serving hot path)."""
+    per-request open/close on the serving hot path).
 
-    def __init__(self, path: str):
+    ``max_entries_per_signature`` (None = unbounded, the historical
+    behavior) arms size-bounded compaction: when a signature
+    accumulates more than 2N live entries the whole file is rewritten
+    atomically keeping the newest N per signature plus one rolled-up
+    ``kind: "rollup"`` summary line per signature (the dropped
+    entries' counts/outcomes/escalations/last-resolved-knobs, merged
+    into any prior rollup) — the per-signature trend the autotuner
+    reads survives, the file stops growing."""
+
+    def __init__(self, path: str,
+                 max_entries_per_signature: Optional[int] = None):
         self.path = history_path(path)
+        self.max_entries_per_signature = max_entries_per_signature
+        self.compactions = 0
         self._lock = threading.Lock()
         self._f = None
+        self._counts = None     # sig -> live (non-rollup) line count
 
     def _handle(self):
         if self._f is None or self._f.closed:
@@ -88,18 +112,98 @@ class WorkloadHistory:
             self._f = open(self.path, "a", buffering=1)
         return self._f
 
+    def _load_counts_locked(self) -> dict:
+        if self._counts is None:
+            self._counts = {}
+            if os.path.exists(self.path):
+                entries, _ = load_history(self.path)
+                for e in entries:
+                    if e.get("kind") == "rollup":
+                        continue
+                    sig = e.get("signature") or "?"
+                    self._counts[sig] = self._counts.get(sig, 0) + 1
+        return self._counts
+
     def append(self, entry: dict) -> dict:
         entry = dict(entry)
         entry.setdefault("schema_version", HISTORY_SCHEMA_VERSION)
         line = json.dumps(entry, default=str)
         with self._lock:
             self._handle().write(line + "\n")
+            bound = self.max_entries_per_signature
+            if bound:
+                counts = self._load_counts_locked()
+                sig = entry.get("signature") or "?"
+                counts[sig] = counts.get(sig, 0) + 1
+                if counts[sig] > 2 * bound:
+                    self._compact_locked(bound)
         return entry
+
+    def compact(self) -> None:
+        """Force one compaction pass (normally automatic on append)."""
+        if not self.max_entries_per_signature:
+            return
+        with self._lock:
+            self._compact_locked(self.max_entries_per_signature)
+
+    def _compact_locked(self, keep: int) -> None:
+        if self._f is not None and not self._f.closed:
+            self._f.close()
+        entries, _ = load_history(self.path)
+        by_sig: dict = {}        # sig -> [entries], insertion-ordered
+        for e in entries:
+            by_sig.setdefault(e.get("signature") or "?", []).append(e)
+        tmp = self.path + ".tmp"
+        counts: dict = {}
+        with open(tmp, "w") as f:
+            for sig, sig_entries in by_sig.items():
+                live = [e for e in sig_entries
+                        if e.get("kind") != "rollup"]
+                rolled = [e for e in sig_entries
+                          if e.get("kind") == "rollup"]
+                drop = live[:-keep] if len(live) > keep else []
+                kept = live[-keep:] if len(live) > keep else live
+                if drop or rolled:
+                    trend = SignatureTrend()
+                    for e in rolled + drop:
+                        trend.add(e)
+                    f.write(json.dumps(
+                        _rollup_line(sig, trend), default=str) + "\n")
+                for e in kept:
+                    f.write(json.dumps(e, default=str) + "\n")
+                counts[sig] = len(kept)
+        os.replace(tmp, self.path)
+        self._counts = counts
+        self.compactions += 1
 
     def close(self) -> None:
         with self._lock:
             if self._f is not None and not self._f.closed:
                 self._f.close()
+
+
+def _rollup_line(sig: str, trend: "SignatureTrend") -> dict:
+    """One compacted summary line carrying everything the trend
+    aggregation (and hence the autotuner) needs from the dropped
+    entries. Wall-time quantiles and prediction ratios deliberately
+    reflect only RETAINED entries after compaction (quantiles do not
+    merge); counts, outcomes, escalations, and the last resolved
+    sizing survive exactly."""
+    return {
+        "schema_version": HISTORY_SCHEMA_VERSION,
+        "kind": "rollup",
+        "signature": sig,
+        "entries": trend.entries,
+        "outcomes": dict(trend.outcomes),
+        "ops": dict(trend.ops),
+        "escalations": trend.escalations,
+        "integrity_retries": trend.integrity_retries,
+        "new_traces": trend.new_traces,
+        "resolved_knobs_last": trend.resolved_knobs_last,
+        "resolved_rung_last": trend.resolved_rung_last,
+        "tuned_entries": trend.tuned_entries,
+        "platform_last": trend.platform_last,
+    }
 
 
 # -- entry builders ---------------------------------------------------
@@ -124,6 +228,30 @@ def retry_counts(retry_record: Optional[dict]) -> dict:
             1 for a in attempts
             if a.get("action") == "retry_integrity"),
     }
+
+
+def resolved_rung(retry_record: Optional[dict],
+                  tuned: Optional[dict] = None) -> int:
+    """The absolute ladder rung the entry settled at: the final
+    attempt's rung label when a retry trail exists (attempts carry
+    absolute indices — a tuner-seeded ladder starts above 0), else
+    the tuned base rung, else 0."""
+    attempts = (retry_record or {}).get("attempts") or []
+    if attempts and attempts[-1].get("attempt") is not None:
+        return int(attempts[-1]["attempt"])
+    if tuned and tuned.get("rung") is not None:
+        return int(tuned["rung"])
+    return 0
+
+
+def tuned_summary(tuned: Optional[dict]) -> Optional[dict]:
+    """The compact per-entry record of what the autotuner did (the
+    ``TunedConfig.as_record()`` dict, reduced to the fields the trend
+    aggregation keys on)."""
+    if not tuned:
+        return None
+    return {k: tuned[k] for k in ("source", "rung", "applied")
+            if tuned.get(k) is not None}
 
 
 def quick_indicators(metrics: Optional[dict]) -> Optional[dict]:
@@ -177,11 +305,17 @@ def request_entry(*, request_id: str, op: str, signature: str,
                   retry_record: Optional[dict] = None,
                   metrics: Optional[dict] = None,
                   predicted_wall_s: Optional[float] = None,
+                  tuned: Optional[dict] = None,
+                  platform: Optional[str] = None,
                   error: Optional[str] = None) -> dict:
     """One serving request's history line (the JoinService write
     path). ``metrics`` is the request's ``Metrics.to_dict()`` block
     when telemetry rode the program, else None; ``predicted_wall_s``
-    the plan's cost-model prediction when the service computed one."""
+    the plan's cost-model prediction when the service computed one;
+    ``tuned`` the autotuner's ``TunedConfig.as_record()`` when the
+    request dispatched pre-sized; ``platform`` the backend the wall
+    was measured on (the calibration seam only trusts real-hardware
+    entries)."""
     from distributed_join_tpu.telemetry import baselines
 
     return {
@@ -197,6 +331,9 @@ def request_entry(*, request_id: str, op: str, signature: str,
         "matches": matches,
         "retry": retry_counts(retry_record),
         "resolved_knobs": _resolved_knobs(retry_record),
+        "rung": resolved_rung(retry_record, tuned),
+        "tuned": tuned_summary(tuned),
+        "platform": platform,
         "counter_signature": baselines.counter_signature(metrics),
         "indicators": quick_indicators(metrics),
         "prediction": prediction_block(wall_s, predicted_wall_s),
@@ -204,20 +341,48 @@ def request_entry(*, request_id: str, op: str, signature: str,
     }
 
 
+def run_signature(workload: dict) -> str:
+    """THE one hash of a driver run's workload-identity dict (the
+    keys of :data:`WORKLOAD_KEYS`, non-None only) — shared by
+    :func:`run_entry` and the drivers' ``--auto-tune`` pre-run lookup
+    so the two can never disagree."""
+    return hashlib.sha256(
+        json.dumps(workload, sort_keys=True, default=str).encode()
+    ).hexdigest()[:16]
+
+
+def _retry_view(record: dict) -> Optional[dict]:
+    """A record's retry trail in RetryReport.as_record() shape.
+    bench.py nests two trails ({"match_sized", "capacity_contract"});
+    the capacity-contract one is the general-contract sizing the
+    autotuner cares about."""
+    r = record.get("retry")
+    if isinstance(r, dict) and "attempts" not in r \
+            and isinstance(r.get("capacity_contract"), dict):
+        return r["capacity_contract"]
+    return r if isinstance(r, dict) else None
+
+
 def run_entry(record: Optional[dict] = None,
-              summary: Optional[dict] = None) -> dict:
+              summary: Optional[dict] = None,
+              platform: Optional[str] = None) -> dict:
     """One benchmark run's history line (the ``--history`` driver
     flag): the workload identity is hashed from the record's
     workload-shaped keys, the knobs/wall/counters from wherever the
-    record carries them."""
+    record carries them. A ``--auto-tune`` run embeds its PRE-TUNED
+    workload dict under ``record["tuned"]["workload"]`` — that is the
+    identity hashed here, so a tuner-adjusted knob never forks the
+    workload's signature away from its own history."""
     from distributed_join_tpu.telemetry import baselines
 
     record = record or {}
-    workload = {k: record.get(k) for k in WORKLOAD_KEYS
-                if record.get(k) is not None}
-    digest = hashlib.sha256(
-        json.dumps(workload, sort_keys=True, default=str).encode()
-    ).hexdigest()[:16]
+    tuned = record.get("tuned") if isinstance(record.get("tuned"),
+                                              dict) else None
+    workload = (tuned or {}).get("workload") or {
+        k: record.get(k) for k in WORKLOAD_KEYS
+        if record.get(k) is not None
+    }
+    digest = run_signature(workload)
     metrics = None
     if summary and isinstance(summary.get("metrics"), dict):
         metrics = summary["metrics"]
@@ -227,6 +392,7 @@ def run_entry(record: Optional[dict] = None,
     # --explain runs embed their prediction summary in the record;
     # grade it here so the store carries per-signature model error.
     predicted = (record.get("explain") or {}).get("predicted_wall_s")
+    retry = _retry_view(record)
     return {
         "schema_version": HISTORY_SCHEMA_VERSION,
         "kind": "run",
@@ -239,8 +405,11 @@ def run_entry(record: Optional[dict] = None,
         "new_traces": 0,
         "cache_hits": 0,
         "matches": record.get("matches_per_join"),
-        "retry": retry_counts(record.get("retry")),
-        "resolved_knobs": _resolved_knobs(record.get("retry")),
+        "retry": retry_counts(retry),
+        "resolved_knobs": _resolved_knobs(retry),
+        "rung": resolved_rung(retry, tuned),
+        "tuned": tuned_summary(tuned),
+        "platform": platform,
         "counter_signature": baselines.counter_signature(
             metrics if metrics is not None else record),
         "indicators": quick_indicators(metrics),
@@ -308,57 +477,142 @@ def _prediction_stats(ratios) -> Optional[dict]:
     }
 
 
-def summarize(entries) -> dict:
-    """Per-signature trends over a history store — the view the
-    autotuner (ROADMAP item 5) will pre-size from."""
-    sigs: dict = {}
-    for e in entries:
-        digest = e.get("signature") or "?"
-        s = sigs.setdefault(digest, {
-            "entries": 0, "outcomes": {}, "ops": {}, "walls": [],
-            "escalations": 0, "integrity_retries": 0, "new_traces": 0,
-            "resolved_knobs_last": None, "counter_drift": False,
-            "_counters_seen": None, "_pred_ratios": [],
-        })
-        s["entries"] += 1
+class SignatureTrend:
+    """Incremental per-signature aggregate over history entries — THE
+    one definition of "what this workload's history says", shared by
+    :func:`summarize` (the CLI view) and the autotuner's in-memory
+    table (:class:`..planning.tuner.JoinTuner` feeds it one entry per
+    request). Understands the compaction rollup lines, so a bounded
+    store keeps its counts."""
+
+    def __init__(self):
+        self.entries = 0
+        self.outcomes: dict = {}
+        self.ops: dict = {}
+        self.walls: list = []
+        self.escalations = 0
+        self.integrity_retries = 0
+        self.new_traces = 0
+        self.resolved_knobs_last = None
+        self.resolved_rung_last = None
+        self.counter_drift = False
+        self.counters_last = None
+        self.indicators_last = None
+        self.tuned_entries = 0
+        self.platform_last = None
+        self.rolled_up = 0
+        self.pred_ratios: list = []
+        # counters keyed by the sizing that produced them: the SAME
+        # workload at a DIFFERENT rung (or with different tuner-applied
+        # knobs) legitimately moves wire/margin counters — drift means
+        # the data moved under an UNCHANGED sizing.
+        self._counters_by_sizing: dict = {}
+
+    def add(self, e: dict) -> None:
+        if e.get("kind") == "rollup":
+            self.entries += int(e.get("entries") or 0)
+            self.rolled_up += int(e.get("entries") or 0)
+            for k, v in (e.get("outcomes") or {}).items():
+                self.outcomes[k] = self.outcomes.get(k, 0) + int(v)
+            for k, v in (e.get("ops") or {}).items():
+                self.ops[k] = self.ops.get(k, 0) + int(v)
+            self.escalations += int(e.get("escalations") or 0)
+            self.integrity_retries += int(
+                e.get("integrity_retries") or 0)
+            self.new_traces += int(e.get("new_traces") or 0)
+            self.tuned_entries += int(e.get("tuned_entries") or 0)
+            if e.get("resolved_knobs_last"):
+                self.resolved_knobs_last = e["resolved_knobs_last"]
+                self.resolved_rung_last = e.get("resolved_rung_last")
+            if e.get("platform_last"):
+                self.platform_last = e["platform_last"]
+            return
+        self.entries += 1
         outcome = e.get("outcome") or "?"
-        s["outcomes"][outcome] = s["outcomes"].get(outcome, 0) + 1
+        self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
         op = e.get("op") or "?"
-        s["ops"][op] = s["ops"].get(op, 0) + 1
-        s["walls"].append(e.get("wall_s"))
+        self.ops[op] = self.ops.get(op, 0) + 1
+        self.walls.append(e.get("wall_s"))
         retry = e.get("retry") or {}
-        s["escalations"] += int(retry.get("escalations") or 0)
-        s["integrity_retries"] += int(
+        self.escalations += int(retry.get("escalations") or 0)
+        self.integrity_retries += int(
             retry.get("integrity_retries") or 0)
-        s["new_traces"] += int(e.get("new_traces") or 0)
+        self.new_traces += int(e.get("new_traces") or 0)
+        if (e.get("tuned") or {}).get("source") == "history":
+            self.tuned_entries += 1
+        if e.get("platform"):
+            self.platform_last = e["platform"]
         if e.get("resolved_knobs"):
-            s["resolved_knobs_last"] = e["resolved_knobs"]
+            self.resolved_knobs_last = e["resolved_knobs"]
+            rung = e.get("rung")
+            if rung is None:
+                # Pre-rung-stamp entries (PR 7/8 stores): the ladder
+                # always started at rung 0 then, so the final rung IS
+                # n_attempts - 1. Without this back-fill a tuner fed
+                # an old store would adopt escalated sizing under
+                # rung label 0 — a signature matching NO resident
+                # executable, silently re-tracing every warm run.
+                rung = max(int(retry.get("n_attempts") or 1) - 1, 0)
+            self.resolved_rung_last = int(rung)
+        if e.get("indicators"):
+            self.indicators_last = e["indicators"]
         csig = e.get("counter_signature")
         if isinstance(csig, dict) and csig.get("counters"):
-            if s["_counters_seen"] is None:
-                s["_counters_seen"] = csig["counters"]
-            elif s["_counters_seen"] != csig["counters"]:
-                # Same workload signature, different device counters:
-                # the data (or a seam) moved — the drift the autotuner
-                # must re-observe before trusting old sizing.
-                s["counter_drift"] = True
+            self.counters_last = csig["counters"]
+            key = (int(e.get("rung") or 0), json.dumps(
+                (e.get("tuned") or {}).get("applied") or {},
+                sort_keys=True, default=str))
+            seen = self._counters_by_sizing.get(key)
+            if seen is None:
+                self._counters_by_sizing[key] = csig["counters"]
+            elif seen != csig["counters"]:
+                # Same workload signature, same sizing, different
+                # device counters: the data (or a seam) moved — the
+                # drift the autotuner must re-observe before trusting
+                # old sizing.
+                self.counter_drift = True
         pred = e.get("prediction")
         if isinstance(pred, dict) and pred.get("wall_ratio"):
-            s["_pred_ratios"].append(float(pred["wall_ratio"]))
-    out = {}
-    for digest, s in sigs.items():
-        out[digest] = {
-            "entries": s["entries"],
-            "outcomes": s["outcomes"],
-            "ops": s["ops"],
-            "wall": _wall_stats(s["walls"]),
-            "escalations": s["escalations"],
-            "integrity_retries": s["integrity_retries"],
-            "new_traces": s["new_traces"],
-            "resolved_knobs_last": s["resolved_knobs_last"],
-            "counter_drift": s["counter_drift"],
-            "prediction": _prediction_stats(s["_pred_ratios"]),
+            self.pred_ratios.append(float(pred["wall_ratio"]))
+
+    @property
+    def successes(self) -> int:
+        return sum(self.outcomes.get(k, 0)
+                   for k in ("ok", "served", "recovered"))
+
+    def as_dict(self) -> dict:
+        return {
+            "entries": self.entries,
+            "outcomes": dict(self.outcomes),
+            "ops": dict(self.ops),
+            "wall": _wall_stats(self.walls),
+            "escalations": self.escalations,
+            "integrity_retries": self.integrity_retries,
+            "new_traces": self.new_traces,
+            "resolved_knobs_last": self.resolved_knobs_last,
+            "resolved_rung_last": self.resolved_rung_last,
+            "counter_drift": self.counter_drift,
+            "tuned_entries": self.tuned_entries,
+            "platform_last": self.platform_last,
+            "rolled_up": self.rolled_up,
+            "prediction": _prediction_stats(self.pred_ratios),
         }
+
+
+def trends_of(entries) -> dict:
+    """{signature: SignatureTrend} over a loaded store."""
+    sigs: dict = {}
+    for e in entries:
+        sigs.setdefault(e.get("signature") or "?",
+                        SignatureTrend()).add(e)
+    return sigs
+
+
+def summarize(entries) -> dict:
+    """Per-signature trends over a history store — the view the
+    autotuner (:mod:`..planning.tuner`) pre-sizes from."""
+    sigs = trends_of(entries)
+    out = {digest: t.as_dict() for digest, t in sigs.items()}
     return {
         "schema_version": HISTORY_SCHEMA_VERSION,
         "n_entries": len(entries),
@@ -392,7 +646,16 @@ def format_summary(summary: dict, path: str = "") -> str:
         if s.get("resolved_knobs_last"):
             knobs = " ".join(f"{k}={v}" for k, v in
                              sorted(s["resolved_knobs_last"].items()))
-            lines.append(f"    resolved: {knobs}")
+            rung = s.get("resolved_rung_last")
+            lines.append(f"    resolved"
+                         + (f" (rung {rung})" if rung else "")
+                         + f": {knobs}")
+        if s.get("tuned_entries"):
+            lines.append(f"    tuned: {s['tuned_entries']} pre-sized "
+                         "run(s)")
+        if s.get("rolled_up"):
+            lines.append(f"    compacted: {s['rolled_up']} older "
+                         "entr(ies) rolled up")
         if s.get("counter_drift"):
             lines.append("    counter signature DRIFTED across runs "
                          "(data moved; re-observe before pre-sizing)")

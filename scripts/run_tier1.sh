@@ -30,6 +30,17 @@
 #                                   # + schema checks over the flight
 #                                   # recorder and workload-history
 #                                   # artifacts, on the CPU mesh
+#   scripts/run_tier1.sh tuner      # autotuner: -m tuner suite + a
+#                                   # cold/warm driver A/B (warm run
+#                                   # must start at the escalated
+#                                   # rung: zero ladder escalations)
+#                                   # + a service-level zero-trace
+#                                   # warm gate + `analyze tune`
+#                                   # schema check. Tuner-off stays
+#                                   # the exact current path (the
+#                                   # lint/perfgate lanes keep the
+#                                   # schedule-golden and baseline
+#                                   # byte-identity gates)
 #
 # Notes:
 # - tests/conftest.py points the persistent XLA compile cache at
@@ -206,8 +217,105 @@ print("history store:", s["n_entries"], "entries,",
       s["n_signatures"], "signatures")'
     exit $?
     ;;
+  tuner)
+    # History-driven autotuner (docs/OBSERVABILITY.md "Autotuner").
+    # 1. the -m tuner unit suite (zero-trace warm locks via
+    #    CountingComm, poisoned-history chaos slice, compaction,
+    #    calibration, CLI schema);
+    # 2. driver cold/warm A/B on an overflow-prone workload: the cold
+    #    run pays the ladder and records the rung, the warm tuned
+    #    re-run must dispatch with ZERO ladder escalations;
+    # 3. a service-level warm gate: the tuned second request must add
+    #    zero new traces AND zero escalations (CountingComm-locked);
+    # 4. `analyze tune --json` output schema-checked.
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m tuner --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    tmp="$(mktemp -d /tmp/djtpu_tuner.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    for phase in cold warm; do
+      timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+        python -m distributed_join_tpu.benchmarks.distributed_join \
+        --platform cpu --n-ranks 8 \
+        --build-table-nrows 8000 --probe-table-nrows 8000 \
+        --iterations 1 --out-capacity-factor 0.1 --auto-retry 6 \
+        --auto-tune --history "$tmp/history.jsonl" \
+        --telemetry "$tmp/tel_$phase" \
+        --json-output "$tmp/$phase.json"
+    done
+    python - "$tmp" <<'PY'
+import json, sys
+tmp = sys.argv[1]
+cold = json.load(open(f"{tmp}/cold.json"))
+warm = json.load(open(f"{tmp}/warm.json"))
+def escalations(rec):
+    return sum(1 for a in (rec.get("retry") or {}).get("attempts", [])
+               if a.get("overflow"))
+assert escalations(cold) >= 1, "cold run never escalated: the A/B tested nothing"
+assert escalations(warm) == 0, f"warm tuned run escalated: {warm.get('retry')}"
+assert warm["tuned"]["source"] == "history", warm["tuned"]
+assert warm["tuned"]["rung"] >= 1, warm["tuned"]
+print(f"tuner A/B: cold {escalations(cold)} escalation(s) -> warm 0 "
+      f"(pre-sized at rung {warm['tuned']['rung']})")
+PY
+    # Service-level zero-trace warm gate: the tuned repeat must be a
+    # pure dict-lookup dispatch (no new SPMD programs built at all).
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python - "$tmp" <<'PY'
+import sys
+from distributed_join_tpu.benchmarks import force_cpu_platform
+force_cpu_platform(8)
+from distributed_join_tpu.parallel.communicator import TpuCommunicator
+from distributed_join_tpu.service.server import JoinService, ServiceConfig
+from distributed_join_tpu.utils.generators import generate_build_probe_tables
+
+class CountingComm(TpuCommunicator):
+    def __init__(self):
+        super().__init__(n_ranks=8)
+        self.programs_built = 0
+    def spmd(self, fn, *, sharded_out=None):
+        self.programs_built += 1
+        return super().spmd(fn, sharded_out=sharded_out)
+
+comm = CountingComm()
+svc = JoinService(comm, ServiceConfig(
+    auto_retry=6, auto_tune=True, history_dir=sys.argv[1] + "/svc_hist"))
+b, p = generate_build_probe_tables(
+    seed=11, build_nrows=512, probe_nrows=1024, rand_max=256,
+    selectivity=0.5)
+r1 = svc.join(b, p, out_capacity_factor=0.1)
+assert r1.retry_report.n_attempts > 1, "cold service run never escalated"
+built = comm.programs_built
+r2 = svc.join(b, p, out_capacity_factor=0.1)
+assert r2.new_traces == 0 and comm.programs_built == built, \
+    f"warm tuned request traced: {r2.new_traces}"
+assert r2.retry_report.n_attempts == 1, "warm tuned request escalated"
+assert int(r1.total) == int(r2.total)
+print(f"service warm gate: cold {r1.retry_report.n_attempts} attempt(s) "
+      f"-> warm 1 attempt, 0 new traces")
+PY
+    # analyze tune: dry-run the tuner over the A/B history; the JSON
+    # output must carry the documented schema.
+    python -m distributed_join_tpu.telemetry.analyze tune \
+      "$tmp/history.jsonl"
+    python -m distributed_join_tpu.telemetry.analyze tune \
+      "$tmp/history.jsonl" --json | python -c '
+import json, sys
+doc = json.load(sys.stdin)
+assert doc["kind"] == "tune" and doc["schema_version"] == 1, doc
+assert doc["n_signatures"] >= 1, doc
+sig = next(iter(doc["signatures"].values()))
+for key in ("source", "rung", "knobs", "delta", "basis"):
+    assert key in sig, (key, sig)
+assert sig["source"] == "history" and sig["delta"], sig
+print("analyze tune schema: OK,", doc["n_signatures"], "signature(s)")'
+    exit $?
+    ;;
   *)
-    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service]" >&2
+    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|tuner]" >&2
     exit 2
     ;;
 esac

@@ -1,0 +1,447 @@
+"""History-driven autotuner (distributed_join_tpu/planning/tuner.py)
+on the 8-virtual-device CPU mesh.
+
+Four contracts (docs/OBSERVABILITY.md "Autotuner"):
+
+- **Tuner-off is the exact current path.** ``tuner=None`` (the
+  default everywhere) changes nothing — rung labels, retry records,
+  program signatures all byte-identical to before.
+- **Warm tuned re-runs are free.** A repeat of an overflow-prone
+  workload, tuned from its own history, dispatches the executable the
+  cold run's ladder already traced: ZERO new SPMD programs
+  (CountingComm-locked) and ZERO ladder escalations — the ISSUE 9
+  acceptance bar.
+- **Never correctness for speed.** A poisoned history (capacities
+  claiming a too-small rung) still grades pandas-oracle-clean via the
+  retry ladder, and the corrected rung lands back in the store
+  (chaos.run_tuner_trial).
+- **The read surfaces tell the truth.** ``analyze tune`` dry-runs
+  the store with the documented schema; compaction bounds the file
+  while preserving the trend; calibration refuses thin evidence.
+"""
+
+import json
+
+import pytest
+
+import distributed_join_tpu as dj
+from distributed_join_tpu import telemetry
+from distributed_join_tpu.parallel.communicator import TpuCommunicator
+from distributed_join_tpu.planning.tuner import (
+    JoinTuner,
+    workload_signature,
+)
+from distributed_join_tpu.service.programs import JoinProgramCache
+from distributed_join_tpu.telemetry import history as tel_history
+from distributed_join_tpu.utils.generators import (
+    generate_build_probe_tables,
+)
+
+pytestmark = pytest.mark.tuner
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_session():
+    telemetry.finalize()
+    yield
+    telemetry.finalize()
+
+
+class CountingComm(TpuCommunicator):
+    """Counts built SPMD programs — a warm tuned run must add zero."""
+
+    def __init__(self, n_ranks: int = 8):
+        super().__init__(n_ranks=n_ranks)
+        self.programs_built = 0
+
+    def spmd(self, fn, *, sharded_out=None):
+        self.programs_built += 1
+        return super().spmd(fn, sharded_out=sharded_out)
+
+
+def _tables(seed=11):
+    return generate_build_probe_tables(
+        seed=seed, build_nrows=512, probe_nrows=1024, rand_max=256,
+        selectivity=0.5,
+    )
+
+
+def _oracle(build, probe) -> int:
+    return len(build.to_pandas().merge(probe.to_pandas(), on="key"))
+
+
+def _escalated_entry(sig, *, shuffle_f=6.4, out_f=0.8, rung=2,
+                     outcome="served", **extra):
+    """A synthetic history line shaped like a real escalated request."""
+    entry = {
+        "kind": "request", "signature": sig, "outcome": outcome,
+        "wall_s": 0.5, "op": "join",
+        "retry": {"n_attempts": rung + 1, "escalations": rung,
+                  "integrity_retries": 0},
+        "resolved_knobs": {"shuffle_capacity_factor": shuffle_f,
+                           "out_capacity_factor": out_f},
+        "rung": rung,
+    }
+    entry.update(extra)
+    return entry
+
+
+# -- the decision policy ----------------------------------------------
+
+
+def test_no_history_is_static():
+    t = JoinTuner()
+    cfg = t.recommend("deadbeef")
+    assert cfg.source == "static" and not cfg.sizing \
+        and not cfg.structural and cfg.rung == 0
+    assert "no history" in cfg.basis["note"]
+
+
+def test_adopts_escalated_rung_and_overrides_explicit_sizing():
+    t = JoinTuner()
+    t.observe_entry(_escalated_entry("s1"))
+    cfg = t.recommend("s1")
+    assert cfg.source == "history" and cfg.rung == 2
+    assert cfg.sizing == {"shuffle_capacity_factor": 6.4,
+                          "out_capacity_factor": 0.8}
+    # Sizing OVERRIDES an explicit caller value (the signature already
+    # binds it, and it provably overflowed); structural knobs only
+    # ever fill absences.
+    out = cfg.apply({"out_capacity_factor": 0.1, "shuffle": "padded"})
+    assert out["out_capacity_factor"] == 0.8
+    assert out["shuffle"] == "padded"
+    assert cfg.applied["out_capacity_factor"] == 0.8
+
+
+def test_legacy_entries_without_rung_backfill_from_attempts():
+    """PR 7/8-era history lines carry resolved_knobs but no 'rung';
+    the ladder always started at 0 then, so the final rung is
+    n_attempts - 1 — adopting those knobs under rung 0 would dispatch
+    a signature matching no resident executable."""
+    t = JoinTuner()
+    legacy = _escalated_entry("old")
+    del legacy["rung"]                      # n_attempts stays 3
+    t.observe_entry(legacy)
+    cfg = t.recommend("old")
+    assert cfg.source == "history" and cfg.rung == 2
+
+
+def test_structural_fill_respects_explicit_and_skew_gates_hh():
+    t = JoinTuner()
+    t.observe_entry(_escalated_entry(
+        "s2",
+        indicators={"matches": {"gini": 0.5, "max_over_mean": 3.0}}))
+    # caller chose no skew policy -> filled from evidence
+    cfg = t.recommend("s2")
+    assert cfg.structural.get("skew_threshold") == 0.001
+    # caller chose explicitly -> never overridden
+    cfg2 = t.recommend("s2", user_opts={"skew_threshold": 0.05})
+    assert "skew_threshold" not in cfg2.structural
+    # hh sizing only applies when the merged opts actually run skew
+    t2 = JoinTuner()
+    t2.observe_entry(_escalated_entry(
+        "s3",
+        resolved_knobs={"out_capacity_factor": 0.8,
+                        "hh_probe_capacity": 4096}))
+    cfg3 = t2.recommend("s3")
+    applied = cfg3.apply({})
+    assert "hh_probe_capacity" not in applied     # skew off -> gated
+    applied_skew = cfg3.apply({"skew_threshold": 0.05})
+    assert applied_skew["hh_probe_capacity"] == 4096
+
+
+def test_counter_drift_and_failures_refuse_presizing():
+    t = JoinTuner()
+    counters = {"matches": 100, "build.wire_bytes": 1000}
+    moved = {"matches": 100, "build.wire_bytes": 2000}
+    t.observe_entry(_escalated_entry(
+        "s4", counter_signature={"counters": counters}))
+    t.observe_entry(_escalated_entry(
+        "s4", counter_signature={"counters": moved}))
+    cfg = t.recommend("s4")
+    assert cfg.source == "static" and "drift" in cfg.basis["note"]
+    # same counters at a DIFFERENT rung is not drift
+    t2 = JoinTuner()
+    t2.observe_entry(_escalated_entry(
+        "s5", counter_signature={"counters": counters}))
+    t2.observe_entry(_escalated_entry(
+        "s5", rung=3, counter_signature={"counters": moved}))
+    assert t2.recommend("s5").source == "history"
+    # a signature with only failures never pre-sizes
+    t3 = JoinTuner()
+    t3.observe_entry(_escalated_entry("s6", outcome="failed"))
+    cfg3 = t3.recommend("s6")
+    assert cfg3.source == "static" \
+        and "failures" in cfg3.basis["note"]
+
+
+def test_workload_signature_is_rung_stable_and_matches_service():
+    comm = TpuCommunicator(n_ranks=8)
+    b, p = _tables()
+    s1 = workload_signature(comm, b, p, out_capacity_factor=0.1)
+    s2 = workload_signature(comm, b, p, out_capacity_factor=0.1)
+    s3 = workload_signature(comm, b, p, out_capacity_factor=4.0)
+    assert s1 == s2 and s1 != s3 and len(s1) == 16
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+
+    svc = JoinService(comm, ServiceConfig())
+    assert svc._workload_signature(
+        b, p, "key", {"out_capacity_factor": 0.1}) == s1
+
+
+# -- the acceptance bar: warm tuned re-runs are free -------------------
+
+
+def test_warm_tuned_service_rerun_zero_traces_zero_escalations(
+        tmp_path):
+    """ISSUE 9 acceptance: on a repeated overflow-prone workload the
+    tuned second run dispatches with zero new traces and zero ladder
+    escalations, and still matches the pandas oracle."""
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+
+    b, p = _tables()
+    want = _oracle(b, p)
+    comm = CountingComm()
+    svc = JoinService(comm, ServiceConfig(
+        auto_retry=6, auto_tune=True,
+        history_dir=str(tmp_path / "hist")))
+    r1 = svc.join(b, p, out_capacity_factor=0.1)
+    assert r1.retry_report.n_attempts > 1          # the ladder paid
+    assert int(r1.total) == want
+    built = comm.programs_built
+    r2 = svc.join(b, p, out_capacity_factor=0.1)
+    assert int(r2.total) == want
+    assert r2.new_traces == 0
+    assert comm.programs_built == built            # zero new programs
+    assert r2.retry_report.n_attempts == 1         # zero escalations
+    assert r2.tuned["source"] == "history"
+    assert r2.tuned["rung"] == r1.retry_report.attempts[-1].attempt
+    # the tuned dispatch is recorded on every operator surface
+    entries, _ = tel_history.load_history(str(tmp_path / "hist"))
+    assert entries[-1]["tuned"]["source"] == "history"
+    assert entries[-1]["rung"] == r2.tuned["rung"]
+    recs = svc.recorder.snapshot()["records"]
+    assert recs[-1]["tuned"]["source"] == "history"
+    assert svc.stats()["tuner"]["history_hits"] >= 1
+
+
+def test_warm_tuned_library_rerun_via_program_cache(tmp_path):
+    """The library path: distributed_inner_join(tuner=) + a program
+    cache reproduces the same zero-trace warm contract, with the
+    history fed by hand (the library does not auto-write stores)."""
+    b, p = _tables()
+    want = _oracle(b, p)
+    comm = CountingComm()
+    cache = JoinProgramCache(comm)
+    store = tel_history.WorkloadHistory(str(tmp_path / "h.jsonl"))
+    tuner = JoinTuner(store.path)
+    r1 = dj.distributed_inner_join(
+        b, p, comm, auto_retry=6, program_cache=cache, tuner=tuner,
+        out_capacity_factor=0.1)
+    assert r1.retry_report.n_attempts > 1
+    sig = r1.tuned["signature"]
+    store.append(tel_history.request_entry(
+        request_id="r1", op="join", signature=sig, outcome="served",
+        wall_s=0.1, retry_record=r1.retry_report.as_record(),
+        tuned=r1.tuned))
+    tuner.load(store.path)
+    built = comm.programs_built
+    r2 = dj.distributed_inner_join(
+        b, p, comm, auto_retry=6, program_cache=cache, tuner=tuner,
+        out_capacity_factor=0.1)
+    assert comm.programs_built == built
+    assert r2.retry_report.n_attempts == 1
+    assert r2.retry_report.attempts[0].action == "tuned_presize"
+    assert int(r1.total) == int(r2.total) == want
+
+
+def test_tuner_off_rung_labels_and_retry_records_unchanged():
+    """tuner=None keeps the exact historical behavior: rung labels
+    start at 0 and a single clean attempt still reports retry=None."""
+    b, p = _tables()
+    res = dj.distributed_inner_join(b, p, TpuCommunicator(n_ranks=8),
+                                    out_capacity_factor=4.0)
+    assert res.retry_report.as_record() is None
+    assert res.retry_report.attempts[0].attempt == 0
+    assert res.retry_report.attempts[0].action == "initial"
+    assert not hasattr(res, "tuned")
+
+
+# -- lies cost recompiles, never wrong rows ---------------------------
+
+
+@pytest.mark.chaos
+def test_poisoned_history_chaos_slice_grades_clean():
+    """The chaos tuner slice: a history claiming a too-small rung must
+    still yield oracle-exact rows via the ladder, and the post-run
+    store must record the escalated rung (the tuner learns)."""
+    from distributed_join_tpu.parallel.chaos import tuner_slice
+
+    summary = tuner_slice(seed=7, trials=2)
+    assert summary["failures"] == 0, summary
+    for rec in summary["records"]:
+        assert rec["verdict"] in ("ok", "recovered"), rec
+        assert rec["tuner_presized"] and rec["tuner_corrected"], rec
+
+
+# -- the satellites ----------------------------------------------------
+
+
+def test_history_compaction_bounds_file_and_keeps_trend(tmp_path):
+    path = str(tmp_path / "h.jsonl")
+    store = tel_history.WorkloadHistory(
+        path, max_entries_per_signature=5)
+    for i in range(23):
+        store.append(_escalated_entry("sigA", out_f=0.1 * (i + 1)))
+    for i in range(3):
+        store.append(_escalated_entry("sigB"))
+    store.close()
+    assert store.compactions >= 1
+    entries, malformed = tel_history.load_history(path)
+    assert malformed == 0
+    live_a = [e for e in entries if e["signature"] == "sigA"
+              and e.get("kind") != "rollup"]
+    rollups = [e for e in entries if e.get("kind") == "rollup"]
+    # compaction fires past 2N live entries and keeps the newest N,
+    # so the live set is always bounded by 2N regardless of phase
+    assert len(live_a) <= 10
+    assert any(r["signature"] == "sigA" for r in rollups)
+    # the trend preserves TOTALS across compaction
+    summary = tel_history.summarize(entries)
+    siga = summary["signatures"]["sigA"]
+    assert siga["entries"] == 23
+    assert siga["escalations"] == 23 * 2
+    assert siga["rolled_up"] >= 1
+    # the latest resolved sizing survives (newest entries are live)
+    assert siga["resolved_knobs_last"]["out_capacity_factor"] == \
+        pytest.approx(2.3)
+    # the tuner reads the compacted store like any other
+    tuner = JoinTuner(path)
+    assert tuner.recommend("sigA").source == "history"
+    # and the store passes the artifact schema check (rollup lines)
+    from distributed_join_tpu.telemetry.analyze import check_file
+
+    assert check_file(path) == []
+
+
+def test_calibration_refits_or_refuses():
+    from distributed_join_tpu.planning.cost import (
+        CostModel,
+        calibrate_from_history,
+    )
+
+    mk = lambda ratio, plat: {  # noqa: E731 - table-building lambda
+        "prediction": {"wall_ratio": ratio}, "outcome": "ok",
+        "platform": plat}
+    # thin evidence refuses
+    model, report = calibrate_from_history([mk(2.0, "tpu")] * 2,
+                                           min_entries=3)
+    assert model is None and report["calibrated"] is False
+    # CPU-mesh walls never calibrate the chip model
+    model, report = calibrate_from_history([mk(2.0, "cpu")] * 5,
+                                           min_entries=3)
+    assert model is None and report["n_eligible"] == 0
+    # enough real entries: median scale, times up, bandwidths down
+    base = CostModel()
+    model, report = calibrate_from_history(
+        [mk(1.0, "tpu"), mk(2.0, "tpu"), mk(4.0, "tpu")],
+        min_entries=3)
+    assert report["calibrated"] and report["scale"] == 2.0
+    assert model.calibrated_scale == 2.0
+    assert model.sort_ns_per_elem == base.sort_ns_per_elem * 2.0
+    assert model.ici_bytes_per_s == base.ici_bytes_per_s / 2.0
+    assert "calibrated" in model.provenance["source"]
+    # a calibrated model predicts scaled walls end to end
+    from distributed_join_tpu import planning
+
+    b, p = _tables()
+    comm = TpuCommunicator(n_ranks=8)
+    plan0 = planning.explain_join(b, p, comm)
+    plan1 = planning.explain_join(b, p, comm, cost_model=model)
+    assert plan1.cost["total_s"] == pytest.approx(
+        2.0 * plan0.cost["total_s"], rel=1e-6)
+
+
+def test_service_explain_op_carries_tuned_verdict(tmp_path):
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+
+    b, p = _tables()
+    comm = TpuCommunicator(n_ranks=8)
+    svc = JoinService(comm, ServiceConfig(
+        auto_retry=6, auto_tune=True,
+        history_dir=str(tmp_path / "hist")))
+    out = svc.explain(b, p, out_capacity_factor=0.1)
+    assert out["tuned"]["source"] == "static"     # nothing learned yet
+    svc.join(b, p, out_capacity_factor=0.1)       # pays the ladder
+    out2 = svc.explain(b, p, out_capacity_factor=0.1)
+    assert out2["tuned"]["source"] == "history"
+    assert out2["tuned"]["sizing"]
+    assert out2["tuned"]["rung"] >= 1
+
+
+def test_analyze_tune_cli_schema(tmp_path, capsys):
+    from distributed_join_tpu.telemetry.analyze import main
+
+    path = str(tmp_path / "h.jsonl")
+    store = tel_history.WorkloadHistory(path)
+    store.append(_escalated_entry("sigZ"))
+    store.close()
+    assert main(["tune", path, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["kind"] == "tune" and doc["schema_version"] == 1
+    assert doc["n_signatures"] == 1
+    sig = doc["signatures"]["sigZ"]
+    assert sig["source"] == "history" and sig["rung"] == 2
+    assert sig["delta"]["out_capacity_factor"]["tuned"] == 0.8
+    assert sig["trend"]["escalations"] == 2
+    # the human rendering runs too
+    assert main(["tune", path]) == 0
+    assert "sigZ" in capsys.readouterr().out
+
+
+def test_auto_tune_flag_forwarding():
+    """tpu-launch forwards --auto-tune to spawned drivers (the
+    FORWARDED_CHILD_FLAGS table)."""
+    import argparse
+
+    from distributed_join_tpu.benchmarks import (
+        extract_forwarded_flags,
+    )
+
+    args = argparse.Namespace(
+        telemetry=None, trace=False, diagnose=False, history="h.jsonl",
+        explain=False, auto_tune="", verify_integrity=False,
+        chaos_seed=None, guard_deadline_s=None)
+    extra = extract_forwarded_flags(args, ["tpu-distributed-join"])
+    assert "--auto-tune" in extra
+    assert extra[extra.index("--auto-tune") + 1] == ""
+    assert args.auto_tune is None                  # stripped
+    # and the child parser round-trips the bare form
+    from distributed_join_tpu.benchmarks.distributed_join import (
+        parse_args,
+    )
+
+    child = parse_args(["--auto-tune", "", "--history", "h.jsonl"])
+    assert child.auto_tune == "" and child.history == "h.jsonl"
+
+
+def test_resolve_tuner_usage_errors():
+    import argparse
+
+    from distributed_join_tpu.benchmarks import resolve_tuner
+
+    assert resolve_tuner(argparse.Namespace(auto_tune=None)) is None
+    with pytest.raises(SystemExit):
+        resolve_tuner(argparse.Namespace(auto_tune="", history=None))
+    tuner = resolve_tuner(
+        argparse.Namespace(auto_tune="", history="/nonexistent/h.jsonl"))
+    assert tuner is not None and tuner.stats()["signatures"] == 0
