@@ -430,6 +430,17 @@ def _run(args=None) -> dict:
         ).explain_record()
         write_explain(args, doc)
         explain_rec = explain_summary(doc)
+
+    # --stage-profile: stage-segmented profiling of the match-sized
+    # protocol's settled sizing (untimed side pass after both timed
+    # regions; telemetry/stageprof.py).
+    stage_rec = None
+    if args is not None and getattr(args, "stage_profile", None):
+        from distributed_join_tpu.benchmarks import maybe_stage_profile
+
+        stage_rec = maybe_stage_profile(
+            args, comm, build, probe,
+            dict(key="key", over_decomposition=1, **sizing_match))
     from distributed_join_tpu.benchmarks import stamp_record
 
     record = stamp_record({
@@ -454,6 +465,7 @@ def _run(args=None) -> dict:
         },
         "integrity": integ,
         "explain": explain_rec,
+        "stage_profile": stage_rec,
     })
     print(json.dumps(record))
     return record

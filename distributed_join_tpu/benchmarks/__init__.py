@@ -272,6 +272,50 @@ def explain_summary(explain_record) -> dict:
     }
 
 
+def maybe_stage_profile(args, comm, build, probe, join_opts: dict):
+    """Driver seam for ``--stage-profile``: run the stage-segmented
+    profiling harness (telemetry/stageprof.py) on the real inputs —
+    untimed side pass AFTER the timed region, the same discipline as
+    :func:`collect_join_metrics` — write the kind-stamped
+    ``stageprofile.json`` into the telemetry session directory
+    (rank 0), render the dedicated Perfetto track, and return the
+    compact summary block the driver embeds in its JSON record under
+    ``"stage_profile"`` (which ``history.run_entry`` persists as the
+    entry's ``stages`` block). None when the flag is off.
+
+    Every rank executes the profiling programs (they are SPMD over the
+    mesh); only rank 0 writes the artifact and prints the report."""
+    repeats = getattr(args, "stage_profile", None)
+    if not repeats:
+        return None
+    import json
+    import os
+
+    from distributed_join_tpu import telemetry
+    from distributed_join_tpu.parallel.bootstrap import is_coordinator
+    from distributed_join_tpu.telemetry import stageprof
+
+    opts = dict(join_opts)
+    key = opts.pop("key", "key")
+    prof = stageprof.profile_join_stages(
+        comm, build, probe, key=key, repeats=int(repeats), **opts)
+    rec = prof.as_record()
+    telemetry.stage_profile(rec)
+    if not is_coordinator():
+        return prof.summary()
+    s = telemetry.sink()
+    out_dir = s.dir if s is not None else "."
+    path = os.path.join(out_dir, "stageprofile.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(prof.format())
+    print(f"stage profile: plan {rec['plan_digest'][:16]} -> {path}")
+    return prof.summary()
+
+
 def maybe_history(args, summary, record=None) -> None:
     """End-of-run ``--history FILE`` hook (next to :func:`maybe_
     diagnose`): append one workload-history entry — workload
@@ -379,6 +423,21 @@ def add_telemetry_args(parser) -> None:
              "summarizes. Implies --telemetry; rank 0 only",
     )
     parser.add_argument(
+        "--stage-profile", nargs="?", const=3, type=int, default=None,
+        metavar="N",
+        help="after the timed region, run the stage-segmented "
+             "profiling harness (telemetry/stageprof.py): each "
+             "pipeline stage (partition/shuffle/join) compiled as its "
+             "own program at the plan's exact capacities and timed "
+             "with barriers, N repeats (default 3), median — plus the "
+             "monolithic seed step; the delta is the MEASURED overlap "
+             "credit. Writes the kind-stamped stageprofile.json "
+             "beside diagnosis.json (graded by `telemetry.analyze "
+             "stages`; refit constants with planning.cost."
+             "calibrate_from_stage_profile). The timed hot path is "
+             "untouched. Implies --telemetry",
+    )
+    parser.add_argument(
         "--explain", action="store_true",
         help="materialize the fully-resolved JoinPlan + roofline cost "
              "prediction (distributed_join_tpu/planning; zero extra "
@@ -442,6 +501,7 @@ FORWARDED_CHILD_FLAGS = (
     ("--diagnose", "diagnose", False),
     ("--history", "history", True),
     ("--explain", "explain", False),
+    ("--stage-profile", "stage_profile", True),
     ("--auto-tune", "auto_tune", True),
     ("--verify-integrity", "verify_integrity", False),
     ("--chaos-seed", "chaos_seed", True),

@@ -95,6 +95,11 @@ def run(args) -> dict:
         raise SystemExit(
             "--auto-tune applies to the join drivers; the all_to_all "
             "microbenchmark has no capacity contract to pre-size")
+    if getattr(args, "stage_profile", None):
+        raise SystemExit(
+            "--stage-profile needs the multi-stage join pipeline; "
+            "this microbenchmark IS one shuffle stage — its timed "
+            "wall already answers per-stage timing")
     apply_platform(args.platform, args.n_ranks)
     comm = maybe_chaos_communicator(
         make_communicator(args.communicator, n_ranks=args.n_ranks),

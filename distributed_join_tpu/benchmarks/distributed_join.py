@@ -195,6 +195,20 @@ def _string_wire_accounting(build, shuffle_mode):
 
 
 def run(args) -> dict:
+    if getattr(args, "stage_profile", None) and (
+            args.string_key_bytes or args.zipf_alpha is not None
+            or (args.skew_threshold or 0) > 0
+            or (args.shuffle == "ragged"
+                and args.string_payload_bytes)):
+        # The stage-segmentation scope (telemetry/stageprof.py): the
+        # skew sidecar, string keys, and ragged varwidth columns are
+        # not segmentable yet — refuse up front rather than dying
+        # after the timed region already ran.
+        raise SystemExit(
+            "--stage-profile supports the scalar-key, non-skew "
+            "pipeline (any shuffle mode; ragged without string "
+            "payload columns) — drop --zipf-alpha/--skew-threshold/"
+            "--string-key-bytes, or profile the padded form")
     apply_platform(args.platform, args.n_ranks)
     if args.registration_method:
         print(f"note: --registration-method={args.registration_method} "
@@ -480,6 +494,18 @@ def run(args) -> dict:
         write_explain(args, doc)
         explain_rec = explain_summary(doc)
 
+    # --stage-profile: the stage-segmented profiling harness on the
+    # SAME resolved sizing the timed program ran (untimed side pass;
+    # telemetry/stageprof.py). The compact summary rides the record so
+    # the history store can show per-stage drift.
+    stage_rec = None
+    if getattr(args, "stage_profile", None):
+        from distributed_join_tpu.benchmarks import maybe_stage_profile
+
+        stage_rec = maybe_stage_profile(
+            args, comm, build, probe,
+            dict(fixed_opts, **ladder.sizing()))
+
     rows = b_rows + p_rows
     rows_per_sec = rows / sec_per_join
     record = {
@@ -513,6 +539,7 @@ def run(args) -> dict:
         "overflow": overflow,
         "integrity": integ,
         "explain": explain_rec,
+        "stage_profile": stage_rec,
         "chaos_seed": args.chaos_seed,
         "retry": ladder.report().as_record(),
         "elapsed_per_join_s": sec_per_join,

@@ -118,6 +118,14 @@ def run(args) -> dict:
             "--auto-tune is wired for tpu-distributed-join, bench.py "
             "and the join service; the tpch driver does not consult "
             "the history store yet")
+    if getattr(args, "stage_profile", None):
+        # The TPC-H paths stage fixed real-schema tables (and the
+        # batched variants re-plan per key-range batch); the stage
+        # harness segments the generator join pipeline only.
+        raise SystemExit(
+            "--stage-profile is wired for tpu-distributed-join and "
+            "bench.py; profile the equivalent generator workload "
+            "(tpu-distributed-join --stage-profile) instead")
     if ((args.manifest or args.batch_retries
          or args.continue_on_batch_failure)
             and args.batches <= 1 and not args.host_generator):

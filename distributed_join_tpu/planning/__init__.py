@@ -9,8 +9,10 @@ cost model").
 - :mod:`.cost` — :class:`CostModel` / :func:`predict`: ROOFLINE.md's
   measured per-primitive costs as an executable per-stage wall-time
   predictor, graded post-run by ``analyze explain`` and the
-  workload-history store, and refit from that store's measured wall
-  ratios via :func:`calibrate_from_history`;
+  workload-history store, refit from that store's measured wall
+  ratios via :func:`calibrate_from_history` (one global scale), and
+  refit PER CONSTANT from a stage-segmented profile via
+  :func:`calibrate_from_stage_profile` (``telemetry/stageprof.py``);
 - :mod:`.tuner` — :class:`JoinTuner`: the history-driven autotuner
   (ROADMAP item 5's closed loop) pre-sizing repeat workloads from the
   per-signature trends so the retry ladder never recompiles twice for
@@ -21,8 +23,10 @@ from distributed_join_tpu.planning.cost import (
     COST_MODEL_VERSION,
     DEFAULT_COST_MODEL,
     DEFAULT_PREDICTION_BAND,
+    STAGE_CONSTANTS,
     CostModel,
     calibrate_from_history,
+    calibrate_from_stage_profile,
     predict,
     predict_exchange,
 )
@@ -47,6 +51,7 @@ __all__ = [
     "DEFAULT_COST_MODEL",
     "DEFAULT_PREDICTION_BAND",
     "EXPLAIN_SCHEMA_VERSION",
+    "STAGE_CONSTANTS",
     "TUNER_SCHEMA_VERSION",
     "CostModel",
     "JoinPlan",
@@ -57,6 +62,7 @@ __all__ = [
     "build_exchange_plan",
     "build_plan",
     "calibrate_from_history",
+    "calibrate_from_stage_profile",
     "explain_join",
     "predict",
     "predict_exchange",

@@ -34,6 +34,7 @@ byte-identical across runs of the same query spec).
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Optional
 
 COST_MODEL_VERSION = 1
@@ -86,6 +87,11 @@ class CostModel:
     # wall scale this model was refit with (None = the as-shipped
     # ROOFLINE.md constants).
     calibrated_scale: Optional[float] = None
+    # Set by calibrate_from_stage_profile: ((stage, scale), ...) of
+    # the PER-STAGE measured/predicted ratios the stage-owned
+    # constants were refit with (None = no stage-level refit). A tuple
+    # (not a dict) so the frozen dataclass stays hashable.
+    calibrated_stage_scales: Optional[tuple] = None
 
     @property
     def provenance(self) -> dict:
@@ -104,7 +110,12 @@ class CostModel:
             "source": "docs/ROOFLINE.md §1/§6; BASELINE.md"
                       + ("" if self.calibrated_scale is None else
                          f"; calibrated x{self.calibrated_scale:g} "
-                         "from measured history"),
+                         "from measured history")
+                      + ("" if self.calibrated_stage_scales is None
+                         else "; stage-calibrated "
+                         + " ".join(f"{s}=x{v:g}" for s, v in
+                                    self.calibrated_stage_scales)
+                         + " from a stage profile"),
         }
 
     def as_record(self) -> dict:
@@ -258,9 +269,10 @@ def calibrate_from_history(entries, model: Optional[CostModel] = None,
     Honesty contract: per-run entries carry ONE total-wall ratio, so
     the only fit the data supports is a single multiplicative
     correction applied uniformly — time constants scale with the
-    median ratio, bandwidth constants against it (separating
-    per-stage error needs ``--trace`` device profiles, not history
-    lines). Only entries measured on ``platform`` count (default
+    median ratio, bandwidth constants against it. Separating
+    per-stage error needs per-stage measurements: that is
+    :func:`calibrate_from_stage_profile`, fed by a ``--stage-profile``
+    run (``telemetry/stageprof.py``). Only entries measured on ``platform`` count (default
     "tpu": CPU-mesh walls measure emulation, and a model refit from
     them would be confidently wrong about the chip — the exact
     failure mode the provenance block exists to prevent); pass
@@ -309,6 +321,124 @@ def calibrate_from_history(entries, model: Optional[CostModel] = None,
         ratio_min=round(ratios[0], 4),
         ratio_median=round(scale, 4),
         ratio_max=round(ratios[-1], 4),
+    )
+    return calibrated, report
+
+
+# Which constants each pipeline stage OWNS for the per-constant refit
+# (calibrate_from_stage_profile). Honesty note: sort_ns_per_elem
+# appears in both the partition and join predictions; the partition
+# stage — a pure bucket sort + materialization gather — owns it, and
+# the join stage's merged-sort share of any error is absorbed into the
+# join-owned constants. hbm_bytes_per_s feeds no stage prediction and
+# is never refit here.
+STAGE_CONSTANTS = {
+    "partition": {
+        "time": ("sort_ns_per_elem", "gather_ns_per_elem",
+                 "row_gather_ns_per_row"),
+        "bandwidth": (),
+    },
+    "shuffle": {
+        "time": ("collective_latency_s",),
+        "bandwidth": ("ici_bytes_per_s", "codec_bytes_per_s"),
+    },
+    "join": {
+        "time": ("sort_lane_ns_per_elem", "scan_ns_per_elem",
+                 "compact_ns_per_elem", "expand_ns_per_out_row"),
+        "bandwidth": (),
+    },
+}
+
+
+def calibrate_from_stage_profile(profiles,
+                                 model: Optional[CostModel] = None,
+                                 *, min_profiles: int = 1,
+                                 platform: Optional[str] = "tpu"):
+    """Refit INDIVIDUAL cost constants from stage-segmented profiles
+    (``telemetry/stageprof.py``'s ``stageprofile.json`` records) — the
+    per-constant seam ``calibrate_from_history`` cannot provide: a
+    history entry carries one total-wall ratio, while a stage profile
+    carries one measured/predicted ratio PER stage, so the sort
+    constants (partition stage), the ICI bandwidth + collective
+    latency (shuffle stage), and the merge/compact/expand constants
+    (join stage) refit independently (:data:`STAGE_CONSTANTS` is the
+    ownership map).
+
+    ``profiles`` is one record dict or a sequence of them. Per stage
+    the median measured/predicted ratio over eligible profiles becomes
+    that stage's scale: stage-owned time constants multiply by it,
+    bandwidth constants divide. Eligibility mirrors
+    ``calibrate_from_history``: overflowed profiles never count, and
+    only ``platform``-stamped profiles do (default "tpu" — a CPU-mesh
+    stage wall measures emulation; pass ``platform=None`` to calibrate
+    against whatever was measured, testing only).
+
+    Returns ``(model_or_None, report)``; fewer than ``min_profiles``
+    eligible profiles refuses loudly (``report["calibrated"] =
+    False``) instead of shipping a model refit from noise.
+    """
+    base = model or DEFAULT_COST_MODEL
+    if isinstance(profiles, dict):
+        profiles = [profiles]
+    ratios: dict = {}
+    eligible = 0
+    for p in profiles or []:
+        if not isinstance(p, dict) or p.get("kind") != "stageprofile":
+            continue
+        if p.get("overflow"):
+            continue
+        if platform is not None and p.get("platform") != platform:
+            continue
+        counted = False
+        for stage, info in (p.get("stages") or {}).items():
+            if stage not in STAGE_CONSTANTS:
+                continue
+            if not isinstance(info, dict) or not info.get("ran"):
+                continue
+            pred, wall = info.get("predicted_s"), info.get("wall_s")
+            if pred and wall:
+                ratios.setdefault(stage, []).append(
+                    float(wall) / float(pred))
+                counted = True
+        if counted:
+            eligible += 1
+    report = {
+        "platform": platform,
+        "n_eligible": eligible,
+        "min_profiles": min_profiles,
+    }
+    if eligible < min_profiles:
+        report.update(
+            calibrated=False,
+            reason=(f"need >= {min_profiles} non-overflowed "
+                    f"{platform or 'any'}-platform stage profiles "
+                    f"with per-stage ratios, have {eligible}"))
+        return None, report
+    fields: dict = {}
+    scales: dict = {}
+    refit: dict = {}
+    for stage, rs in sorted(ratios.items()):
+        rs.sort()
+        scale = round(rs[len(rs) // 2], 6)
+        scales[stage] = scale
+        owned = STAGE_CONSTANTS[stage]
+        for k in owned["time"]:
+            fields[k] = getattr(base, k) * scale
+        for k in owned["bandwidth"]:
+            fields[k] = getattr(base, k) / scale
+        refit[stage] = list(owned["time"]) + list(owned["bandwidth"])
+    calibrated = dataclasses.replace(
+        base,
+        calibrated_stage_scales=tuple(sorted(scales.items())),
+        **fields)
+    report.update(
+        calibrated=True,
+        stage_scales=scales,
+        refit=refit,
+        # the stage the shipped model mispredicts hardest (log scale:
+        # x4 optimistic and x0.25 pessimistic are equally wrong)
+        worst_stage=max(scales, key=lambda s: abs(math.log(scales[s]))),
+        unfit_stages=[s for s in STAGE_CONSTANTS if s not in scales],
     )
     return calibrated, report
 

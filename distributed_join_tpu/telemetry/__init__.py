@@ -55,7 +55,7 @@ __all__ = [
     "configure", "configure_from_args", "counter_add", "emit_metrics",
     "enabled", "event", "finalize", "maybe_start_xla_trace",
     "request_scope", "session", "sink", "span", "span_complete",
-    "summary",
+    "stage_profile", "summary",
 ]
 
 _active: Optional[TelemetrySink] = None
@@ -97,18 +97,19 @@ def configure(out_dir: str, *, trace: bool = False,
 
 def configure_from_args(args) -> bool:
     """Driver seam: activate from ``--telemetry[=DIR]`` / ``--trace``
-    / ``--diagnose`` / ``--history`` / ``--explain`` flags (see
-    ``benchmarks.add_telemetry_args``). ``--trace``, ``--diagnose``,
-    ``--history`` or ``--explain`` alone imply telemetry at the
-    default directory (all need a session — diagnosis reads its
-    files, a history entry wants the counter signature, explain.json
-    lands beside diagnosis.json). Returns whether a session was
+    / ``--diagnose`` / ``--history`` / ``--explain`` /
+    ``--stage-profile`` flags (see ``benchmarks.add_telemetry_args``).
+    Any of them alone implies telemetry at the default directory (all
+    need a session — diagnosis reads its files, a history entry wants
+    the counter signature, explain.json and stageprofile.json land
+    beside diagnosis.json). Returns whether a session was
     configured."""
     out_dir = getattr(args, "telemetry", None)
     trace = bool(getattr(args, "trace", False))
     if out_dir is None and (trace or getattr(args, "diagnose", False)
                             or getattr(args, "history", None)
-                            or getattr(args, "explain", False)):
+                            or getattr(args, "explain", False)
+                            or getattr(args, "stage_profile", None)):
         out_dir = "telemetry"
     if out_dir is None:
         return False
@@ -230,6 +231,15 @@ def emit_metrics(metrics: Metrics) -> Optional[dict]:
         _active.set_metrics(d)
         _active.event("metrics", payload={"reduced": d["reduced"]})
     return d
+
+
+def stage_profile(record: dict) -> None:
+    """Render a stage-profile record (``stageprof.StageProfile.
+    as_record()``) into the session's Chrome trace as a dedicated
+    Perfetto track with counter flow links (no-op when telemetry is
+    off)."""
+    if _active is not None:
+        _active.add_stage_profile(record)
 
 
 def summary() -> Optional[dict]:

@@ -24,16 +24,20 @@ the loop:
   ``--diagnose`` flag lands here via ``benchmarks.run_guarded``);
 - the CLI (``python -m distributed_join_tpu.telemetry.analyze``)
   exposes ``diagnose`` / ``report`` / ``compare`` / ``explain`` /
-  ``history`` / ``tune`` / ``check``, where ``compare`` is the perf
-  gate:
+  ``stages`` / ``history`` / ``tune`` / ``check``, where ``compare``
+  is the perf gate:
   non-zero exit on counter-signature drift or banded wall-time
   regression against a committed baseline (:mod:`.baselines`; the
   ``perfgate`` lane of ``scripts/run_tier1.sh``); ``explain`` grades
   an ``explain.json`` plan's predictions against measured counters
   (EXPLAIN ANALYZE — the padded-mode wire-byte prediction is an
-  exact CI gate via ``--gate-wire-bytes``); ``history`` summarizes a
-  workload-history store (:mod:`.history`) per signature, including
-  cost-model prediction drift; and ``tune`` dry-runs the autotuner
+  exact CI gate via ``--gate-wire-bytes``); ``stages`` grades a
+  stage-segmented profile (:mod:`.stageprof`'s ``stageprofile.json``
+  — measured per-stage walls vs the model, overlap credit, ICI
+  utilization, the worst-mispredicted constant set); ``history``
+  summarizes a workload-history store (:mod:`.history`) per
+  signature, including cost-model prediction drift and per-stage
+  drift; and ``tune`` dry-runs the autotuner
   (:mod:`..planning.tuner`) against a store, printing the knob delta
   a tuned run would dispatch with vs the static plan.
 
@@ -52,6 +56,12 @@ import sys
 from typing import Optional
 
 from distributed_join_tpu.telemetry import baselines
+# THE stage-key contract (1:1 with planning.cost.predict's stage
+# keys) — one definition, owned by the profiling harness (whose
+# module-level imports are deliberately light).
+from distributed_join_tpu.telemetry.stageprof import (
+    STAGE_KEYS as _STAGEPROFILE_STAGES,
+)
 
 DIAGNOSIS_SCHEMA_VERSION = 1
 
@@ -673,17 +683,25 @@ def grade_explain(explain: dict, metrics: Optional[dict],
         "wall": None,
         "predicted_stages": cost.get("stages"),
     }
+    exact = bool(wire.get("exact"))
     for side in ("build", "probe"):
         pred = (wire.get(side) or {}).get("bytes_total")
         meas = red.get(f"{side}.wire_bytes")
         if pred is not None and meas is not None:
-            out["wire"][side] = {
+            entry = {
                 "predicted_bytes": int(pred),
                 "measured_bytes": int(meas),
-                "match": int(pred) == int(meas),
                 "error_ratio": (round(meas / pred, 6) if pred
                                 else None),
             }
+            if exact:
+                entry["match"] = int(pred) == int(meas)
+            else:
+                # Estimate-only plans (ragged) are graded, not
+                # pass/failed: an exact-equality verdict on an upper
+                # bound would read every run as MISMATCH.
+                entry["estimate"] = True
+            out["wire"][side] = entry
         prows = (wire.get(side) or {}).get("rows_estimate")
         mrows = red.get(f"{side}.rows_shuffled")
         if prows is not None and mrows is not None:
@@ -710,8 +728,11 @@ def format_explain_grade(grade: dict) -> str:
              f"[{grade.get('pipeline')}]  wire prediction: "
              + ("EXACT" if grade.get("wire_exact") else "estimate")]
     for side, d in sorted(grade["wire"].items()):
-        verdict = ("MATCH" if d["match"]
-                   else f"MISMATCH x{d['error_ratio']}")
+        if d.get("estimate"):
+            verdict = f"ESTIMATE x{d['error_ratio']}"
+        else:
+            verdict = ("MATCH" if d["match"]
+                       else f"MISMATCH x{d['error_ratio']}")
         lines.append(
             f"  wire {side}: predicted {d['predicted_bytes']} B, "
             f"measured {d['measured_bytes']} B -> {verdict}")
@@ -734,6 +755,78 @@ def format_explain_grade(grade: dict) -> str:
     return "\n".join(lines)
 
 
+# -- stage-profile grading (measured per-stage walls vs the model) ----
+
+
+def grade_stages(profile: dict) -> dict:
+    """Grade a ``stageprofile.json`` (``telemetry/stageprof.py``):
+    per-stage predicted-vs-measured ratios, the overlap credit, and
+    the worst-mispredicted stage with the cost constants it owns
+    (``planning.cost.STAGE_CONSTANTS``) — the read side of the
+    per-constant calibration loop."""
+    import math
+
+    from distributed_join_tpu.planning.cost import STAGE_CONSTANTS
+
+    stages = profile.get("stages") or {}
+    graded = {}
+    worst = (None, 0.0)
+    ordered = [s for s in _STAGEPROFILE_STAGES if s in stages] + \
+        sorted(s for s in stages if s not in _STAGEPROFILE_STAGES)
+    for name in ordered:
+        info = stages[name]
+        if not isinstance(info, dict):
+            continue
+        entry = {
+            "ran": bool(info.get("ran")),
+            "wall_s": info.get("wall_s"),
+            "predicted_s": info.get("predicted_s"),
+            "ratio": info.get("ratio"),
+            "constants": list(
+                STAGE_CONSTANTS.get(name, {}).get("time", ())
+            ) + list(STAGE_CONSTANTS.get(name, {}).get("bandwidth",
+                                                       ())),
+        }
+        if info.get("ici"):
+            entry["ici"] = info["ici"]
+        graded[name] = entry
+        ratio = info.get("ratio")
+        if info.get("ran") and ratio:
+            off = abs(math.log(float(ratio)))
+            if off > worst[1]:
+                worst = (name, off)
+    return {
+        "kind": "stages_grade",
+        "plan_digest": profile.get("plan_digest"),
+        "shuffle": profile.get("shuffle"),
+        "n_ranks": profile.get("n_ranks"),
+        "platform": profile.get("platform"),
+        "overflow": profile.get("overflow"),
+        "stages": graded,
+        "sum_of_stages_s": profile.get("sum_of_stages_s"),
+        "monolithic_wall_s": (profile.get("monolithic")
+                              or {}).get("wall_s"),
+        "overlap": profile.get("overlap"),
+        "worst_stage": worst[0],
+        "worst_constants": (graded.get(worst[0], {}).get("constants")
+                            if worst[0] else None),
+    }
+
+
+def format_stages(profile: dict) -> str:
+    """Human rendering of a stage-profile ARTIFACT: the shared
+    renderer (``stageprof.format_stage_record`` — the same lines the
+    driver prints) plus the grade's worst-mispredicted verdict."""
+    from distributed_join_tpu.telemetry.stageprof import (
+        format_stage_record,
+    )
+
+    grade = grade_stages(profile)
+    return format_stage_record(
+        profile, worst_stage=grade.get("worst_stage"),
+        worst_constants=grade.get("worst_constants"))
+
+
 # -- schema checks (the perfgate lane's artifact validation) ----------
 
 _SUMMARY_REQUIRED = ("telemetry_format_version", "rank", "counters",
@@ -746,6 +839,9 @@ _FLIGHTRECORDER_REQUIRED = ("schema_version", "kind", "reason",
 _EXPLAIN_REQUIRED = ("schema_version", "kind", "plan", "cost")
 _EXPLAIN_PLAN_REQUIRED = ("pipeline", "signature_digest", "wire")
 _EXPLAIN_COST_REQUIRED = ("stages", "total_s")
+_STAGEPROFILE_REQUIRED = ("schema_version", "kind", "plan_digest",
+                          "stages", "sum_of_stages_s", "monolithic",
+                          "overlap")
 
 
 def _sniff_history_lines(path: str) -> bool:
@@ -857,6 +953,24 @@ def check_file(path: str) -> list:
                     problems.append(f"cost missing {key!r}")
         elif "cost" in doc:
             problems.append("cost is not an object")
+        return problems
+    elif name.startswith("stageprofile") or \
+            doc.get("kind") == "stageprofile":
+        # The stage-segmented profiling artifact
+        # (telemetry/stageprof.py), recognized by basename OR kind.
+        for key in _STAGEPROFILE_REQUIRED:
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        if isinstance(doc.get("stages"), dict):
+            for sk in _STAGEPROFILE_STAGES:
+                if sk not in doc["stages"]:
+                    problems.append(f"stages missing {sk!r} (must "
+                                    "match cost.predict's stage keys)")
+        elif "stages" in doc:
+            problems.append("stages is not an object")
+        if isinstance(doc.get("monolithic"), dict) and \
+                "wall_s" not in doc["monolithic"]:
+            problems.append("monolithic missing 'wall_s'")
         return problems
     elif name == "flightrecorder.json" or \
             doc.get("kind") == "flightrecorder":
@@ -1017,11 +1131,33 @@ def main(argv=None) -> int:
                          "counter; refuses (exit 1) on estimate-only "
                          "plans (ragged) — only static-block modes "
                          "are gateable")
+    ex.add_argument("--no-gate", action="store_true",
+                    help="grade only, never gate — overrides "
+                         "--gate-wire-bytes (for wrappers that pass "
+                         "the gate unconditionally): estimate-only "
+                         "(ragged) plans grade rows/wall normally "
+                         "with wire bytes labeled ESTIMATE instead "
+                         "of refusing")
+
+    st = sub.add_parser(
+        "stages",
+        help="grade a stage-segmented profile (stageprofile.json, "
+             "telemetry/stageprof.py): measured per-stage walls vs "
+             "the cost model's per-stage prediction, the measured "
+             "overlap credit (sum-of-stages minus monolithic wall), "
+             "per-stage ICI utilization, and the worst-mispredicted "
+             "stage with the constants "
+             "calibrate_from_stage_profile would refit")
+    st.add_argument("profile", help="stageprofile.json path")
+    st.add_argument("--json", action="store_true",
+                    help="print the grade JSON instead of the human "
+                         "report")
 
     k = sub.add_parser("check",
                        help="shape-validate telemetry artifacts "
                             "(summary/diagnosis/baseline/trace/"
-                            "explain/events); exit 1 on any problem")
+                            "explain/stageprofile/events); exit 1 on "
+                            "any problem")
     k.add_argument("files", nargs="+")
 
     args = p.parse_args(argv)
@@ -1096,7 +1232,7 @@ def main(argv=None) -> int:
                 print(json.dumps(grade, indent=1))
             else:
                 print(format_explain_grade(grade))
-            if args.gate_wire_bytes:
+            if args.gate_wire_bytes and not args.no_gate:
                 if not grade.get("wire_exact"):
                     print("error: --gate-wire-bytes needs an exact "
                           "(padded/compressed) plan; this plan's "
@@ -1111,6 +1247,19 @@ def main(argv=None) -> int:
                 if not all(d["match"] for d in
                            grade["wire"].values()):
                     return 2
+            return 0
+        if args.cmd == "stages":
+            with open(args.profile) as f:
+                profile = json.load(f)
+            if profile.get("kind") != "stageprofile":
+                print(f"error: {args.profile} is not a stageprofile "
+                      "artifact (kind "
+                      f"{profile.get('kind')!r})", file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(grade_stages(profile), indent=1))
+            else:
+                print(format_stages(profile))
             return 0
         if args.cmd == "check":
             bad = 0

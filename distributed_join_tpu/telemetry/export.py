@@ -191,6 +191,81 @@ class TelemetrySink:
                 "args": {"value": total},
             })
 
+    # Synthetic tid for the stage-profile tracks: far above any real
+    # thread-ident modulus collision risk matters for display only.
+    _STAGEPROF_TID = 990001
+    _STAGEPROF_COUNTER_TID = 990002
+
+    def add_stage_profile(self, record: dict) -> None:
+        """Render a stage profile (``telemetry/stageprof.py``
+        ``as_record()``) as a dedicated Perfetto track: one named
+        thread of back-to-back ``"X"`` slices per measured stage
+        (median walls laid out sequentially — the profile's stages
+        ran barriered, so the sequential layout IS the measured
+        timeline), with a flow event (``"ph": "s"``/``"f"``) linking
+        each stage slice to a slice on a second track carrying that
+        stage's device-counter totals as args. The monolithic wall is
+        drawn after the stages for visual overlap comparison."""
+        from distributed_join_tpu.telemetry.stageprof import STAGE_KEYS
+
+        stages = record.get("stages") or {}
+        with self._lock:
+            if self._closed:
+                return
+            base = self._us()
+            tid, ctid = self._STAGEPROF_TID, self._STAGEPROF_COUNTER_TID
+            for t, label in ((tid, "stage profile (measured)"),
+                             (ctid, "stage profile (device counters)")):
+                self._push_trace({
+                    "name": "thread_name", "ph": "M", "ts": 0,
+                    "pid": self.rank, "tid": t,
+                    "args": {"name": label},
+                })
+            t_us = base
+            for name in STAGE_KEYS:
+                info = stages.get(name)
+                if not isinstance(info, dict) or not info.get("ran"):
+                    continue
+                dur = max(float(info.get("wall_s") or 0.0), 0.0) * 1e6
+                counters = info.get("counters") or {}
+                args = {"predicted_s": info.get("predicted_s"),
+                        "ratio": info.get("ratio"), **counters}
+                self._push_trace({
+                    "name": name, "cat": "stageprof", "ph": "X",
+                    "ts": t_us, "dur": dur, "pid": self.rank,
+                    "tid": tid, "args": args,
+                })
+                if counters:
+                    fid = f"stageprof-{self.rank}-{name}"
+                    mid = t_us + dur / 2
+                    # flow: stage slice -> its counter-totals slice.
+                    self._push_trace({
+                        "name": "stage_counters", "cat": "stageprof",
+                        "ph": "s", "id": fid, "ts": mid,
+                        "pid": self.rank, "tid": tid,
+                    })
+                    self._push_trace({
+                        "name": f"{name} counters",
+                        "cat": "stageprof", "ph": "X", "ts": mid,
+                        "dur": max(dur / 4, 1.0), "pid": self.rank,
+                        "tid": ctid, "args": dict(counters),
+                    })
+                    self._push_trace({
+                        "name": "stage_counters", "cat": "stageprof",
+                        "ph": "f", "bp": "e", "id": fid, "ts": mid,
+                        "pid": self.rank, "tid": ctid,
+                    })
+                t_us += dur
+            mono = (record.get("monolithic") or {}).get("wall_s")
+            if mono:
+                self._push_trace({
+                    "name": "monolithic", "cat": "stageprof",
+                    "ph": "X", "ts": t_us,
+                    "dur": float(mono) * 1e6, "pid": self.rank,
+                    "tid": tid,
+                    "args": {"overlap": record.get("overlap")},
+                })
+
     def set_metrics(self, metrics_dict: dict) -> None:
         """Install the host-fetched device-metrics summary (already
         cross-rank merged by the in-program all_gather)."""

@@ -49,6 +49,12 @@ from typing import Optional
 HISTORY_SCHEMA_VERSION = 1
 HISTORY_FILENAME = "history.jsonl"
 
+# Per-stage wall drift: the same workload signature's measured stage
+# wall moving more than this factor across runs flags the trend (the
+# per-stage analog of counter_drift — re-profile before trusting a
+# stage-level calibration refit).
+STAGE_DRIFT_RATIO = 2.0
+
 # The resolved-knob fields worth persisting from a retry ladder's
 # final rung (the values the autotuner would pre-size from).
 _KNOB_FIELDS = (
@@ -284,6 +290,24 @@ def quick_indicators(metrics: Optional[dict]) -> Optional[dict]:
     return out or None
 
 
+def stages_block(stage_profile: Optional[dict]) -> Optional[dict]:
+    """The optional per-entry ``stages`` block: the compact summary a
+    ``--stage-profile`` run embeds in its record
+    (``stageprof.StageProfile.summary()``), reduced to what the trend
+    aggregation keys on — per-stage measured walls, per-stage
+    measured/predicted ratios, and the overlap fraction. None when the
+    run carried no stage profile (the common case)."""
+    if not isinstance(stage_profile, dict) or \
+            not stage_profile.get("wall_s"):
+        return None
+    return {
+        "wall_s": dict(stage_profile["wall_s"]),
+        "ratio": dict(stage_profile.get("ratio") or {}),
+        "overlap_fraction": stage_profile.get("overlap_fraction"),
+        "monolithic_wall_s": stage_profile.get("monolithic_wall_s"),
+    }
+
+
 def prediction_block(wall_s, predicted_wall_s) -> Optional[dict]:
     """The cost-model grading carried per entry: predicted wall vs
     measured, as a ratio (measured / predicted — >1 means the model
@@ -307,6 +331,7 @@ def request_entry(*, request_id: str, op: str, signature: str,
                   predicted_wall_s: Optional[float] = None,
                   tuned: Optional[dict] = None,
                   platform: Optional[str] = None,
+                  stage_profile: Optional[dict] = None,
                   error: Optional[str] = None) -> dict:
     """One serving request's history line (the JoinService write
     path). ``metrics`` is the request's ``Metrics.to_dict()`` block
@@ -337,6 +362,7 @@ def request_entry(*, request_id: str, op: str, signature: str,
         "counter_signature": baselines.counter_signature(metrics),
         "indicators": quick_indicators(metrics),
         "prediction": prediction_block(wall_s, predicted_wall_s),
+        "stages": stages_block(stage_profile),
         "error": error,
     }
 
@@ -414,6 +440,9 @@ def run_entry(record: Optional[dict] = None,
             metrics if metrics is not None else record),
         "indicators": quick_indicators(metrics),
         "prediction": prediction_block(wall, predicted),
+        # A --stage-profile run embeds its compact per-stage summary;
+        # the trend shows per-stage drift next to counter drift.
+        "stages": stages_block(record.get("stage_profile")),
         "error": record.get("error"),
     }
 
@@ -502,6 +531,9 @@ class SignatureTrend:
         self.platform_last = None
         self.rolled_up = 0
         self.pred_ratios: list = []
+        self.stages_last = None
+        self.stage_drift = False
+        self._stage_walls: dict = {}   # stage -> [measured walls]
         # counters keyed by the sizing that produced them: the SAME
         # workload at a DIFFERENT rung (or with different tuner-applied
         # knobs) legitimately moves wire/margin counters — drift means
@@ -556,15 +588,20 @@ class SignatureTrend:
             self.resolved_rung_last = int(rung)
         if e.get("indicators"):
             self.indicators_last = e["indicators"]
+        # ONE sizing identity for every drift signal: the SAME
+        # workload at a DIFFERENT rung (or with different tuner-
+        # applied knobs) legitimately moves counters AND stage walls
+        # (doubled capacities mean more partition/shuffle work) —
+        # drift means the measurement moved under an UNCHANGED sizing.
+        sizing_key = (int(e.get("rung") or 0), json.dumps(
+            (e.get("tuned") or {}).get("applied") or {},
+            sort_keys=True, default=str))
         csig = e.get("counter_signature")
         if isinstance(csig, dict) and csig.get("counters"):
             self.counters_last = csig["counters"]
-            key = (int(e.get("rung") or 0), json.dumps(
-                (e.get("tuned") or {}).get("applied") or {},
-                sort_keys=True, default=str))
-            seen = self._counters_by_sizing.get(key)
+            seen = self._counters_by_sizing.get(sizing_key)
             if seen is None:
-                self._counters_by_sizing[key] = csig["counters"]
+                self._counters_by_sizing[sizing_key] = csig["counters"]
             elif seen != csig["counters"]:
                 # Same workload signature, same sizing, different
                 # device counters: the data (or a seam) moved — the
@@ -574,6 +611,25 @@ class SignatureTrend:
         pred = e.get("prediction")
         if isinstance(pred, dict) and pred.get("wall_ratio"):
             self.pred_ratios.append(float(pred["wall_ratio"]))
+        st = e.get("stages")
+        if isinstance(st, dict) and st.get("wall_s"):
+            self.stages_last = st
+            for stage, wall in st["wall_s"].items():
+                if not wall:
+                    continue
+                # Keyed per sizing, like the counters above: a
+                # re-profiled run at an escalated rung does MORE
+                # partition/shuffle work by design and must not read
+                # as drift.
+                walls = self._stage_walls.setdefault(
+                    (sizing_key, stage), [])
+                walls.append(float(wall))
+                if max(walls) / min(walls) > STAGE_DRIFT_RATIO:
+                    # The same workload's measured stage wall moved
+                    # more than the drift band across runs at one
+                    # unchanged sizing — the per-stage analog of
+                    # counter drift.
+                    self.stage_drift = True
 
     @property
     def successes(self) -> int:
@@ -596,6 +652,8 @@ class SignatureTrend:
             "platform_last": self.platform_last,
             "rolled_up": self.rolled_up,
             "prediction": _prediction_stats(self.pred_ratios),
+            "stages_last": self.stages_last,
+            "stage_drift": self.stage_drift,
         }
 
 
@@ -659,6 +717,19 @@ def format_summary(summary: dict, path: str = "") -> str:
         if s.get("counter_drift"):
             lines.append("    counter signature DRIFTED across runs "
                          "(data moved; re-observe before pre-sizing)")
+        st = s.get("stages_last")
+        if st:
+            walls = " ".join(f"{k}={v}" for k, v in
+                             sorted((st.get("wall_s") or {}).items()))
+            of = st.get("overlap_fraction")
+            lines.append("    stages (s): " + walls
+                         + (f"  overlap={of:.0%}"
+                            if of is not None else ""))
+            if s.get("stage_drift"):
+                lines.append(
+                    f"    stage walls DRIFTED >x{STAGE_DRIFT_RATIO:g} "
+                    "across runs (re-profile before trusting "
+                    "per-stage calibration)")
         pred = s.get("prediction")
         if pred:
             tag = (" OUTSIDE prediction band" if pred["drift"]

@@ -23,10 +23,20 @@ order:
      ladder, the warm run must start at the escalated rung (zero
      escalations); walls + retry trails of both land in
                                -> results/tuner_ab_r6.json
-  5. cost-model calibration: refit the roofline constants from the
+  5. stage capture, once per shuffle mode: the stage-segmented
+     profiling harness (telemetry/stageprof.py, `--stage-profile 5`)
+     records per-stage real-chip walls + the measured overlap credit
+     OVERLAP.md §1 could so far only infer from HLO structure — the
+     padded-vs-ppermute credits ARE the showdown in stage terms
+                    -> results/stageprofile_{padded,ppermute}_r6.json
+  6. per-constant calibration: refit the sort/join/ICI constants
+     INDEPENDENTLY from the stage profiles' per-stage ratios
+     (planning.cost.calibrate_from_stage_profile)
+                               -> results/stage_calibration_r6.json
+  7. cost-model calibration: refit the roofline constants from the
      session's accumulated real-hardware history entries
-     (planning.cost.calibrate_from_history — refuses under
-     --calibration-min-entries eligible entries)
+     (planning.cost.calibrate_from_history — one global scale;
+     refuses under --calibration-min-entries eligible entries)
                                -> results/cost_calibration_r6.json
 
 Each step is skipped when its artifact already exists (delete to
@@ -182,7 +192,73 @@ def main() -> None:
             print(json.dumps(verdict), flush=True)
             ok["tuner_ab"] = verdict["pass"]
 
-    # 5. Calibration: refit the roofline constants from this
+    # 5. Per-stage real-chip walls, ONE CAPTURE PER SHUFFLE MODE: the
+    # stage-segmented profiling harness (telemetry/stageprof.py)
+    # measures partition/shuffle/join separately with barriers AND the
+    # monolithic step. The whole point is the per-mode overlap credit
+    # — ppermute's 112 async pairs vs padded's 20 synchronous
+    # all-to-alls (OVERLAP.md §1) compared in wall seconds, not HLO
+    # structure — so the capture runs the SAME workload under both
+    # lowerings. Each mode's step is resumable independently.
+    captured = []
+    for mode in ("padded", "ppermute"):
+        sp_art = RESULTS / f"stageprofile_{mode}_r6.json"
+        name = f"stage capture {mode}"
+        if sp_art.exists():
+            print(f"== {name}: exists, skipping", flush=True)
+            ok[f"stage_capture_{mode}"] = True
+            captured.append(sp_art)
+            continue
+        sp_tel = RESULTS / f"stageprof_tel_{mode}_r6"
+        done = step(
+            name, f"stageprofile_driver_{mode}_r6.json",
+            drv + ["--build-table-nrows", "10000000",
+                   "--probe-table-nrows", "10000000",
+                   "--iterations", "1", "--communicator", "local",
+                   "--shuffle", mode,
+                   "--telemetry", str(sp_tel), "--stage-profile", "5",
+                   "--history", str(HISTORY),
+                   "--json-output",
+                   f"results/stageprofile_driver_{mode}_r6.json"],
+            timeout_s=10800)
+        src = sp_tel / "stageprofile.json"
+        if done and src.exists():
+            # Promote the session artifact to its committed per-mode
+            # name so a resumed session (and the refit below) finds it.
+            sp_art.write_text(src.read_text())
+            captured.append(sp_art)
+            ok[f"stage_capture_{mode}"] = True
+        else:
+            ok[f"stage_capture_{mode}"] = False
+
+    # 6. Per-CONSTANT calibration from the stage profiles: unlike the
+    # history refit below (one global scale — per-run entries carry
+    # one total-wall ratio), the per-stage ratios refit the sort,
+    # join and ICI constants independently (median across the
+    # captured modes).
+    scal_art = RESULTS / "stage_calibration_r6.json"
+    if scal_art.exists():
+        print("== stage calibration: exists, skipping", flush=True)
+        ok["stage_calibration"] = True
+    elif not captured:
+        print("!! stage calibration: no stage profile captured",
+              flush=True)
+        ok["stage_calibration"] = False
+    else:
+        from distributed_join_tpu.planning.cost import (
+            calibrate_from_stage_profile,
+        )
+
+        profiles = [json.loads(a.read_text()) for a in captured]
+        model, report = calibrate_from_stage_profile(profiles)
+        doc = {"profiles": [a.name for a in captured],
+               "report": report,
+               "model": model.as_record() if model else None}
+        scal_art.write_text(json.dumps(doc, indent=2) + "\n")
+        print(json.dumps(report), flush=True)
+        ok["stage_calibration"] = bool(report.get("calibrated"))
+
+    # 7. Calibration: refit the roofline constants from this
     # session's real-hardware entries. Refuses (and says so) when
     # too few eligible entries accumulated — an uncalibratable
     # session must not ship a model refit from noise.

@@ -30,6 +30,16 @@
 #                                   # + schema checks over the flight
 #                                   # recorder and workload-history
 #                                   # artifacts, on the CPU mesh
+#   scripts/run_tier1.sh stageprof  # stage-segmented profiling: -m
+#                                   # stageprof suite + a deterministic
+#                                   # CPU-mesh --stage-profile driver
+#                                   # smoke — stageprofile.json schema-
+#                                   # checked, `analyze stages` renders
+#                                   # it, the padded per-stage wire-
+#                                   # byte split gated EXACTLY vs the
+#                                   # Metrics counters, and the
+#                                   # stage-sum >= monolithic floor
+#                                   # (noise-robust min walls) gated
 #   scripts/run_tier1.sh tuner      # autotuner: -m tuner suite + a
 #                                   # cold/warm driver A/B (warm run
 #                                   # must start at the escalated
@@ -120,11 +130,11 @@ case "$lane" in
       --platform cpu --n-ranks 8 \
       --build-table-nrows 8000 --probe-table-nrows 8000 \
       --iterations 1 --shuffle ragged --out-capacity-factor 3.0 \
-      --telemetry "$tmp/tel" --diagnose --explain \
+      --telemetry "$tmp/tel" --diagnose --explain --stage-profile 1 \
       --json-output "$tmp/record.json"
     python -m distributed_join_tpu.telemetry.analyze check \
       "$tmp/tel/summary.json" "$tmp/tel/diagnosis.json" \
-      "$tmp/tel/explain.json" \
+      "$tmp/tel/explain.json" "$tmp/tel/stageprofile.json" \
       "$tmp/tel/trace.rank0.json" "$tmp/tel/events.rank0.jsonl"
     python -m distributed_join_tpu.telemetry.analyze compare \
       "$tmp/record.json" --baseline cpu_mesh_smoke
@@ -215,6 +225,54 @@ s = json.load(sys.stdin)
 assert s["n_signatures"] >= 2, s
 print("history store:", s["n_entries"], "entries,",
       s["n_signatures"], "signatures")'
+    exit $?
+    ;;
+  stageprof)
+    # Stage-segmented profiling (docs/OBSERVABILITY.md "Stage
+    # profiling"): the -m stageprof unit suite, then a deterministic
+    # CPU-mesh driver run with --stage-profile. The artifact is
+    # schema-checked, `analyze stages` must render it, the padded
+    # per-stage wire bytes must EXACTLY equal the monolithic Metrics
+    # counters, the stage set must match cost.predict's keys 1:1, and
+    # the segmented sum must dominate the monolithic wall on the
+    # noise-robust minimum walls.
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m stageprof --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    tmp="$(mktemp -d /tmp/djtpu_stageprof.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.benchmarks.distributed_join \
+      --platform cpu --n-ranks 8 \
+      --build-table-nrows 8000 --probe-table-nrows 8000 \
+      --iterations 1 --out-capacity-factor 3.0 \
+      --telemetry "$tmp/tel" --stage-profile 3 \
+      --json-output "$tmp/record.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/tel/stageprofile.json"
+    python -m distributed_join_tpu.telemetry.analyze stages \
+      "$tmp/tel/stageprofile.json"
+    python - "$tmp" <<'PY'
+import json, sys
+tmp = sys.argv[1]
+prof = json.load(open(f"{tmp}/tel/stageprofile.json"))
+rec = json.load(open(f"{tmp}/record.json"))
+red = rec["telemetry"]["metrics"]["reduced"]
+sh = prof["stages"]["shuffle"]["counters"]
+for side in ("build", "probe"):
+    assert sh[f"{side}.wire_bytes"] == red[f"{side}.wire_bytes"], \
+        (side, sh, red)
+assert set(prof["stages"]) == {"partition", "shuffle", "join", "skew"}
+assert prof["stages"]["join"]["counters"]["matches"] == red["matches"]
+assert prof["sum_of_stages_min_s"] >= prof["monolithic"]["wall_min_s"], \
+    (prof["sum_of_stages_min_s"], prof["monolithic"])
+print("stageprof gate: per-stage wire bytes exact, stage set matches "
+      "cost.predict,",
+      f"overlap credit {prof['overlap']['credit_s']:.4f}s "
+      f"({prof['overlap']['fraction']})")
+PY
     exit $?
     ;;
   tuner)
@@ -315,7 +373,7 @@ print("analyze tune schema: OK,", doc["n_signatures"], "signature(s)")'
     exit $?
     ;;
   *)
-    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|tuner]" >&2
+    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|stageprof|tuner]" >&2
     exit 2
     ;;
 esac

@@ -224,6 +224,49 @@ def test_analyze_explain_cli_gate(comm, tables, tmp_path):
     assert rc == 1
 
 
+def test_grade_explain_estimate_plan_labels_not_mismatch(comm, tables):
+    # ISSUE 10 satellite: a ragged (estimate-only) plan grades rows/
+    # wall normally and labels wire bytes ESTIMATE — an exact-equality
+    # MATCH/MISMATCH verdict on an upper bound would read every run
+    # as a failure.
+    doc, metrics = _graded(comm, tables, shuffle="ragged")
+    grade = analyze.grade_explain(
+        doc, metrics, {"elapsed_per_join_s": 0.5})
+    assert grade["wire_exact"] is False
+    for side in ("build", "probe"):
+        d = grade["wire"][side]
+        assert d["estimate"] is True
+        assert "match" not in d
+        assert d["error_ratio"] is not None
+    assert grade["rows"]["build"]["measured_rows"] > 0
+    assert grade["wall"]["ratio"] > 0
+    text = analyze.format_explain_grade(grade)
+    assert "ESTIMATE" in text
+    assert "MISMATCH" not in text
+
+
+def test_analyze_explain_no_gate_grades_estimate_plans(comm, tables,
+                                                       tmp_path):
+    # --no-gate overrides --gate-wire-bytes (for wrappers that pass
+    # the gate unconditionally): the estimate-only refusal becomes a
+    # normal graded exit 0.
+    doc, metrics = _graded(comm, tables, shuffle="ragged")
+    record = {"telemetry": {"metrics": metrics},
+              "elapsed_per_join_s": 0.25}
+    epath = tmp_path / "explain.json"
+    rpath = tmp_path / "record.json"
+    epath.write_text(json.dumps(doc))
+    rpath.write_text(json.dumps(record))
+    rc = analyze.main(["explain", str(epath), "--record", str(rpath),
+                       "--gate-wire-bytes"])
+    assert rc == 1    # the gated refusal, unchanged
+    rc = analyze.main(["explain", str(epath), "--record", str(rpath),
+                       "--gate-wire-bytes", "--no-gate"])
+    assert rc == 0
+    rc = analyze.main(["explain", str(epath), "--record", str(rpath)])
+    assert rc == 0
+
+
 def test_analyze_check_validates_explain_artifacts(comm, tables,
                                                    tmp_path):
     b, p = tables

@@ -1,0 +1,432 @@
+"""Stage-segmented profiling (telemetry/stageprof.py) on the
+8-virtual-device CPU mesh.
+
+The contracts (ISSUE 10 acceptance / docs/OBSERVABILITY.md "Stage
+profiling"):
+
+- **Stage set == cost.predict's keys, 1:1** — the grading joins the
+  two dicts by key.
+- **Padded per-stage wire bytes are EXACT** vs both the monolithic
+  Metrics counters and the plan's prediction.
+- **Stage-sum dominates the monolithic wall** on the noise-robust
+  minimum walls (segments do strictly more work than the fused
+  program; timing noise only ever inflates).
+- **Profile-off byte parity** — running the profiler leaves the seed
+  program's lowering byte-identical, and the profile's plan digest IS
+  the seed program's signature digest.
+- **Per-constant calibration** — ``calibrate_from_stage_profile``
+  refits the sort and ICI constants INDEPENDENTLY from the partition
+  and shuffle stage ratios.
+"""
+
+import json
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import pytest
+
+from distributed_join_tpu import planning, telemetry
+from distributed_join_tpu.parallel.communicator import (
+    LocalCommunicator,
+    TpuCommunicator,
+)
+from distributed_join_tpu.parallel.distributed_join import (
+    JOIN_METRICS_SHARDED_OUT,
+    JOIN_SHARDED_OUT,
+    make_join_step,
+)
+from distributed_join_tpu.planning.cost import (
+    DEFAULT_COST_MODEL,
+    STAGE_CONSTANTS,
+    calibrate_from_stage_profile,
+)
+from distributed_join_tpu.table import Table
+from distributed_join_tpu.telemetry import analyze, history, stageprof
+from distributed_join_tpu.utils.generators import (
+    generate_build_probe_tables,
+)
+
+pytestmark = pytest.mark.stageprof
+
+OPTS = dict(out_capacity_factor=3.0)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_session():
+    telemetry.finalize()
+    yield
+    telemetry.finalize()
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return TpuCommunicator(n_ranks=8)
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return generate_build_probe_tables(
+        seed=42, build_nrows=8000, probe_nrows=8000, selectivity=0.3)
+
+
+def _seed_lowering(comm, b, p):
+    fn = comm.spmd(make_join_step(comm, **OPTS),
+                   sharded_out=JOIN_SHARDED_OUT)
+    return fn.lower(b, p).as_text()
+
+
+@pytest.fixture(scope="module")
+def profiled(comm, tables):
+    """One profiled run shared by the module: (profile, record,
+    seed-program lowering before profiling, lowering after)."""
+    b, p = tables
+    before = _seed_lowering(comm, b, p)
+    prof = stageprof.profile_join_stages(comm, b, p, repeats=3, **OPTS)
+    after = _seed_lowering(comm, b, p)
+    return prof, prof.as_record(), before, after
+
+
+@pytest.fixture(scope="module")
+def mono_metrics(comm, tables):
+    """The monolithic with-metrics counters for the same workload."""
+    b, p = tables
+    step = make_join_step(comm, with_metrics=True, **OPTS)
+    _, metrics = comm.spmd(
+        step, sharded_out=JOIN_METRICS_SHARDED_OUT)(b, p)
+    return metrics.to_dict()["reduced"]
+
+
+# -- the consistency contracts ----------------------------------------
+
+
+def test_stage_set_matches_cost_predict_keys(comm, tables, profiled):
+    b, p = tables
+    _, rec, _, _ = profiled
+    plan = planning.explain_join(b, p, comm, **OPTS)
+    assert set(rec["stages"]) == set(plan.cost["stages"])
+    assert set(rec["stages"]) == set(stageprof.STAGE_KEYS)
+
+
+def test_stage_sum_dominates_monolithic_on_min_walls(profiled):
+    prof, rec, _, _ = profiled
+    # The honest floor: min across repeats (noise only inflates).
+    assert rec["sum_of_stages_min_s"] >= rec["monolithic"]["wall_min_s"]
+    assert prof.sum_of_stages_min_s >= prof.monolithic_wall_min_s
+    # all three pipeline stages ran and measured something
+    for name in ("partition", "shuffle", "join"):
+        assert rec["stages"][name]["ran"]
+        assert rec["stages"][name]["wall_s"] > 0
+    assert rec["stages"]["skew"]["ran"] is False
+    assert rec["overflow"] is False
+
+
+def test_padded_stage_wire_bytes_exact(comm, tables, profiled,
+                                       mono_metrics):
+    b, p = tables
+    _, rec, _, _ = profiled
+    plan = planning.explain_join(b, p, comm, **OPTS)
+    sh = rec["stages"]["shuffle"]["counters"]
+    for side in ("build", "probe"):
+        assert sh[f"{side}.wire_bytes"] == \
+            mono_metrics[f"{side}.wire_bytes"]
+        assert sh[f"{side}.wire_bytes"] == \
+            plan.wire[side]["bytes_total"]
+        assert sh[f"{side}.rows_shuffled"] == \
+            mono_metrics[f"{side}.rows_shuffled"]
+    part = rec["stages"]["partition"]["counters"]
+    for side in ("build", "probe"):
+        assert part[f"{side}.rows_partitioned"] == \
+            mono_metrics[f"{side}.rows_partitioned"]
+        assert part[f"{side}.overflow_margin_min"] == \
+            mono_metrics[f"{side}.overflow_margin_min"]
+    assert rec["stages"]["join"]["counters"]["matches"] == \
+        mono_metrics["matches"]
+    # the ICI block derives from the exact counters
+    ici = rec["stages"]["shuffle"]["ici"]
+    assert ici["wire_bytes_per_rank"] * 8 == \
+        sh["build.wire_bytes"] + sh["probe.wire_bytes"]
+    assert 0 < ici["ici_utilization"]
+
+
+def test_profile_off_byte_parity_and_digest(comm, tables, profiled):
+    b, p = tables
+    prof, rec, before, after = profiled
+    # Profiling left the seed program byte-identical...
+    assert before == after
+    # ...and the profile's identity IS the seed program's signature.
+    from distributed_join_tpu.service.programs import JoinSignature
+
+    sig = JoinSignature.of(comm, b, p, key="key", with_metrics=False,
+                           **OPTS)
+    assert rec["plan_digest"] == sig.digest()
+
+
+def test_single_rank_profile_is_join_only():
+    b, p = generate_build_probe_tables(
+        seed=7, build_nrows=1024, probe_nrows=1024, selectivity=0.3)
+    prof = stageprof.profile_join_stages(
+        LocalCommunicator(), b, p, repeats=1, **OPTS)
+    rec = prof.as_record()
+    assert rec["stages"]["partition"]["ran"] is False
+    assert rec["stages"]["shuffle"]["ran"] is False
+    assert rec["stages"]["join"]["ran"] is True
+    assert rec["stages"]["join"]["wall_s"] > 0
+    assert set(rec["stages"]) == set(stageprof.STAGE_KEYS)
+
+
+# -- loud scope refusals ----------------------------------------------
+
+
+def test_declines_skew_string_keys_and_ragged_varwidth(comm, tables):
+    b, p = tables
+    with pytest.raises(ValueError, match="skew sidecar"):
+        stageprof.profile_join_stages(comm, b, p, repeats=1,
+                                      skew_threshold=0.001, **OPTS)
+    sb = Table({"key": jnp.zeros((64, 8), jnp.uint8),
+                "key#len": jnp.full((64,), 8, jnp.int32)},
+               jnp.ones((64,), bool))
+    with pytest.raises(ValueError, match="string"):
+        stageprof.profile_join_stages(comm, sb, sb, repeats=1, **OPTS)
+    vb = Table({"key": jnp.arange(64, dtype=jnp.int64),
+                "s": jnp.zeros((64, 8), jnp.uint8),
+                "s#len": jnp.full((64,), 8, jnp.int32)},
+               jnp.ones((64,), bool))
+    with pytest.raises(ValueError, match="varwidth"):
+        stageprof.profile_join_stages(comm, vb, vb, repeats=1,
+                                      shuffle="ragged", **OPTS)
+
+
+# -- per-constant calibration -----------------------------------------
+
+
+def _fake_profile(part_ratio=2.0, shuf_ratio=4.0, join_ratio=3.0,
+                  platform="tpu", overflow=False):
+    def stage(ratio):
+        return {"ran": True, "wall_s": 0.001 * ratio,
+                "wall_min_s": 0.001 * ratio,
+                "predicted_s": 0.001, "ratio": ratio, "counters": {}}
+
+    return {
+        "schema_version": 1, "kind": "stageprofile",
+        "plan_digest": "x" * 64, "shuffle": "padded", "n_ranks": 8,
+        "over_decomposition": 1, "repeats": 3, "platform": platform,
+        "overflow": overflow,
+        "stages": {
+            "partition": stage(part_ratio),
+            "shuffle": stage(shuf_ratio),
+            "join": stage(join_ratio),
+            "skew": {"ran": False, "wall_s": 0.0, "wall_min_s": 0.0,
+                     "predicted_s": 0.0, "ratio": None,
+                     "counters": {}},
+        },
+        "sum_of_stages_s": 0.009, "sum_of_stages_min_s": 0.009,
+        "monolithic": {"wall_s": 0.008, "wall_min_s": 0.008,
+                       "walls_s": [0.008]},
+        "overlap": {"credit_s": 0.001, "fraction": 0.1},
+    }
+
+
+def test_calibrate_refits_sort_and_ici_independently():
+    model, report = calibrate_from_stage_profile(_fake_profile())
+    assert report["calibrated"]
+    base = DEFAULT_COST_MODEL
+    # partition ratio 2.0 -> sort constant x2 (stage-owned)
+    assert model.sort_ns_per_elem == pytest.approx(
+        base.sort_ns_per_elem * 2.0)
+    assert model.row_gather_ns_per_row == pytest.approx(
+        base.row_gather_ns_per_row * 2.0)
+    # shuffle ratio 4.0 -> ICI bandwidth /4, latency x4 — INDEPENDENT
+    # of the partition scale
+    assert model.ici_bytes_per_s == pytest.approx(
+        base.ici_bytes_per_s / 4.0)
+    assert model.collective_latency_s == pytest.approx(
+        base.collective_latency_s * 4.0)
+    # join ratio 3.0 -> the merge/compact/expand constants x3
+    assert model.expand_ns_per_out_row == pytest.approx(
+        base.expand_ns_per_out_row * 3.0)
+    # join-owned constants never touched by the partition/shuffle fit
+    assert model.sort_lane_ns_per_elem == pytest.approx(
+        base.sort_lane_ns_per_elem * 3.0)
+    assert model.hbm_bytes_per_s == base.hbm_bytes_per_s
+    assert dict(model.calibrated_stage_scales) == {
+        "partition": 2.0, "shuffle": 4.0, "join": 3.0}
+    assert report["worst_stage"] == "shuffle"
+    assert "stage-calibrated" in model.provenance["source"]
+    # the ownership map covers every refit constant exactly once
+    owned = [c for m in STAGE_CONSTANTS.values()
+             for c in m["time"] + m["bandwidth"]]
+    assert len(owned) == len(set(owned))
+
+
+def test_calibrate_honesty_gates():
+    # platform gate: a cpu-mesh profile must not calibrate a "tpu" fit
+    model, report = calibrate_from_stage_profile(
+        _fake_profile(platform="cpu"), platform="tpu")
+    assert model is None and report["calibrated"] is False
+    # overflowed profiles never count
+    model, report = calibrate_from_stage_profile(
+        _fake_profile(overflow=True), platform=None)
+    assert model is None and report["calibrated"] is False
+    # min_profiles refusal
+    model, report = calibrate_from_stage_profile(
+        [_fake_profile(platform=None)], platform=None, min_profiles=2)
+    assert model is None and "need >=" in report["reason"]
+    # median over several profiles
+    model, report = calibrate_from_stage_profile(
+        [_fake_profile(part_ratio=r, platform="tpu")
+         for r in (1.0, 2.0, 8.0)])
+    assert dict(model.calibrated_stage_scales)["partition"] == 2.0
+
+
+# -- the read-side CLI + artifact schema ------------------------------
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "distributed_join_tpu.telemetry.analyze",
+         *args], capture_output=True, text=True)
+
+
+def test_analyze_check_and_stages_cli(profiled, tmp_path):
+    _, rec, _, _ = profiled
+    path = tmp_path / "stageprofile.json"
+    path.write_text(json.dumps(rec, indent=1))
+    r = _cli("check", str(path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _cli("stages", str(path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "worst-mispredicted" in r.stdout
+    assert "overlap credit" in r.stdout
+    r = _cli("stages", str(path), "--json")
+    grade = json.loads(r.stdout)
+    assert grade["kind"] == "stages_grade"
+    assert grade["worst_stage"] in ("partition", "shuffle", "join")
+    assert grade["worst_constants"]
+    # a mangled artifact fails the schema check loudly
+    bad = dict(rec)
+    bad["stages"] = {k: v for k, v in rec["stages"].items()
+                     if k != "skew"}
+    bad_path = tmp_path / "stageprofile.bad.json"
+    bad_path.write_text(json.dumps(bad))
+    r = _cli("check", str(bad_path))
+    assert r.returncode == 1
+    assert "skew" in r.stdout
+    # kind-stamp recognition under ANY filename
+    any_name = tmp_path / "captured.json"
+    any_name.write_text(json.dumps(rec))
+    assert analyze.check_file(str(any_name)) == []
+    # `stages` refuses a non-stageprofile document
+    not_prof = tmp_path / "explain.json"
+    not_prof.write_text(json.dumps({"kind": "explain"}))
+    r = _cli("stages", str(not_prof))
+    assert r.returncode == 1
+
+
+def test_grade_stages_ici_and_overlap(profiled):
+    _, rec, _, _ = profiled
+    grade = analyze.grade_stages(rec)
+    assert grade["stages"]["shuffle"]["ici"]["ici_utilization"] > 0
+    assert grade["overlap"]["credit_s"] == rec["overlap"]["credit_s"]
+    # refit constants come from the ownership map
+    for name in ("partition", "shuffle", "join"):
+        owned = STAGE_CONSTANTS[name]
+        assert grade["stages"][name]["constants"] == \
+            list(owned["time"]) + list(owned["bandwidth"])
+
+
+# -- Perfetto stage track ---------------------------------------------
+
+
+def test_perfetto_stage_track_with_flows(profiled, tmp_path):
+    _, rec, _, _ = profiled
+    with telemetry.session(str(tmp_path)):
+        telemetry.stage_profile(rec)
+    trace = json.loads((tmp_path / "trace.rank0.json").read_text())
+    evs = trace["traceEvents"]
+    slices = [e for e in evs
+              if e.get("cat") == "stageprof" and e["ph"] == "X"]
+    names = [e["name"] for e in slices]
+    for stage in ("partition", "shuffle", "join"):
+        assert stage in names
+        assert f"{stage} counters" in names
+    assert "monolithic" in names
+    # stage slices carry the device-counter totals as args
+    shuffle_slice = next(e for e in slices if e["name"] == "shuffle")
+    assert shuffle_slice["args"]["build.wire_bytes"] == \
+        rec["stages"]["shuffle"]["counters"]["build.wire_bytes"]
+    # flow events link each stage slice to its counter slice
+    starts = [e for e in evs if e.get("ph") == "s"]
+    finishes = [e for e in evs if e.get("ph") == "f"]
+    assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+    assert len(starts) >= 3
+    # the dedicated tracks are named
+    thread_names = {e["args"]["name"] for e in evs
+                    if e.get("ph") == "M"}
+    assert "stage profile (measured)" in thread_names
+    assert "stage profile (device counters)" in thread_names
+
+
+# -- history integration ----------------------------------------------
+
+
+def test_history_entry_carries_stages_block(profiled):
+    prof, _, _, _ = profiled
+    record = {"benchmark": "distributed_join", "n_ranks": 8,
+              "build_table_nrows": 8000, "probe_table_nrows": 8000,
+              "elapsed_per_join_s": 0.04,
+              "stage_profile": prof.summary()}
+    entry = history.run_entry(record=record, platform="cpu")
+    st = entry["stages"]
+    assert set(st["wall_s"]) == set(stageprof.STAGE_KEYS)
+    assert st["overlap_fraction"] == prof.summary()["overlap_fraction"]
+    # entries without a profile carry stages: None (schema-uniform)
+    assert history.run_entry(record={"benchmark": "x"})["stages"] \
+        is None
+
+
+def _entry_with_stages(walls, rung=0):
+    return {
+        "kind": "run", "signature": "sig", "op": "bench",
+        "outcome": "ok", "wall_s": 0.1, "retry": {}, "rung": rung,
+        "stages": {"wall_s": walls, "ratio": {},
+                   "overlap_fraction": 0.2},
+    }
+
+
+def test_history_trend_flags_stage_drift():
+    t = history.SignatureTrend()
+    t.add(_entry_with_stages({"partition": 0.01, "join": 0.05}))
+    t.add(_entry_with_stages({"partition": 0.011, "join": 0.055}))
+    assert t.stage_drift is False
+    # a bigger wall at a DIFFERENT rung is legitimate (escalated
+    # capacities do more work) — keyed per sizing, never drift
+    t.add(_entry_with_stages({"partition": 0.05, "join": 0.2},
+                             rung=1))
+    assert t.stage_drift is False
+    t.add(_entry_with_stages({"partition": 0.05, "join": 0.055}))
+    assert t.stage_drift is True  # partition moved 5x at ONE sizing
+    d = t.as_dict()
+    assert d["stage_drift"] is True
+    assert d["stages_last"]["wall_s"]["partition"] == 0.05
+    summary = history.summarize(
+        [_entry_with_stages({"partition": 0.01}),
+         _entry_with_stages({"partition": 0.05})])
+    text = history.format_summary(summary)
+    assert "stages (s):" in text
+    assert "DRIFTED" in text
+
+
+def test_stage_profile_flag_forwarded_by_launcher():
+    import argparse
+
+    from distributed_join_tpu.benchmarks import extract_forwarded_flags
+
+    ns = argparse.Namespace(
+        telemetry=None, trace=False, diagnose=False, history=None,
+        explain=False, stage_profile=4, auto_tune=None,
+        verify_integrity=False, chaos_seed=None, guard_deadline_s=None)
+    extra = extract_forwarded_flags(ns, ["tpu-distributed-join"])
+    i = extra.index("--stage-profile")
+    assert extra[i + 1] == "4"
+    assert ns.stage_profile is None  # stripped off the launcher
