@@ -408,6 +408,14 @@ SANCTIONED_CALLBACK_FILES = (
     "distributed_join_tpu/parallel/faults.py",
     "distributed_join_tpu/parallel/integrity.py",
     "distributed_join_tpu/parallel/chaos.py",
+    # Resident build tables (PR 11): the prep/merge/probe-only
+    # programs run host conservation checks AROUND the compiled
+    # steps today; a future in-graph tap (e.g. an io_callback
+    # streaming merge progress) must follow the error-token
+    # discipline, so the seam is registered explicitly (it is also
+    # covered by the service/ dir prefix below — this line is the
+    # documented intent, not a widening).
+    "distributed_join_tpu/service/resident.py",
 )
 SANCTIONED_CALLBACK_DIRS = (
     "distributed_join_tpu/telemetry/",

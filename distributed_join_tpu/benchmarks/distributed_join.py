@@ -150,6 +150,14 @@ def parse_args(argv=None):
                    help="join on a fixed-width STRING key of this many "
                         "bytes (derived from the int key; packed-word "
                         "composite-key machinery)")
+    p.add_argument("--resident-ab", type=int, default=0, metavar="N",
+                   help="after the timed run: register the build "
+                        "table as a resident image (service/"
+                        "resident.py) and time N warm probe-only "
+                        "joins vs N warm cold full joins of the same "
+                        "query — both numbers land in one record "
+                        "under 'resident_ab' (the warm probe-only "
+                        "passes must add zero traces)")
     p.add_argument("--json-output", default=None,
                    help="also write the result record to this file")
     add_platform_arg(p)
@@ -506,6 +514,15 @@ def run(args) -> dict:
             args, comm, build, probe,
             dict(fixed_opts, **ladder.sizing()))
 
+    # --resident-ab: the serving-throughput lever measured in place
+    # (ROADMAP item 4): register this build table once, then N warm
+    # probe-only joins vs N warm cold full joins of the same query.
+    resident_ab = None
+    if args.resident_ab > 0:
+        resident_ab = _resident_ab(
+            comm, build, probe, join_key, args.resident_ab,
+            dict(fixed_opts, **ladder.sizing()))
+
     rows = b_rows + p_rows
     rows_per_sec = rows / sec_per_join
     record = {
@@ -534,6 +551,7 @@ def run(args) -> dict:
         "variable_length_strings": args.variable_length_strings,
         "string_key_bytes": args.string_key_bytes,
         "string_wire_bytes": _string_wire_accounting(build, args.shuffle),
+        "resident_ab": resident_ab,
         "tuned": tuned_rec,
         "matches_per_join": matches,
         "overflow": overflow,
@@ -554,6 +572,84 @@ def run(args) -> dict:
         record, args.json_output,
     )
     return record
+
+
+def _resident_ab(comm, build, probe, join_key, n_joins, join_opts):
+    """The in-driver resident A/B: one registration pays the build
+    side's 2/3, then N warm probe-only dispatches race N warm cold
+    full-join dispatches (same query, same resolved sizing; min wall
+    per side — noise-robust). The probe-only passes must add zero
+    traces; the record says whether they did."""
+    from distributed_join_tpu.service.programs import JoinProgramCache
+    from distributed_join_tpu.service.resident import (
+        ResidentError,
+        ResidentTableRegistry,
+    )
+
+    if not isinstance(join_key, str):
+        return {"skipped": "composite keys not yet resident"}
+    try:
+        cache = JoinProgramCache(comm)
+        registry = ResidentTableRegistry(comm, cache)
+        t0 = time.perf_counter()
+        registry.register("driver_build", build, key=join_key)
+        register_s = time.perf_counter() - t0
+    except ResidentError as exc:
+        # 2-D/string payloads, float keys: the resident subsystem
+        # refuses them by contract — report why instead of dying.
+        return {"skipped": f"{exc}"}
+    sizing = {k: join_opts.get(k) for k in
+              ("shuffle", "over_decomposition",
+               "shuffle_capacity_factor", "out_capacity_factor",
+               "out_rows_per_rank", "compression_bits",
+               "kernel_config")
+              if join_opts.get(k) is not None}
+    step = make_join_step(comm, **join_opts)
+    from distributed_join_tpu.parallel.distributed_join import (
+        JOIN_SHARDED_OUT,
+    )
+
+    cold_fn = comm.spmd(step, sharded_out=JOIN_SHARDED_OUT)
+
+    def run_cold():
+        res = cold_fn(build, probe)
+        jax.block_until_ready(res.total)
+        return res
+
+    def run_probe_only():
+        res = registry.join("driver_build", probe,
+                            with_metrics=False, **sizing)
+        jax.block_until_ready(res.total)
+        return res
+
+    run_cold()                       # warm both programs
+    run_probe_only()
+    traces0 = cache.traces
+    cold_walls, po_walls = [], []
+    cold_matches = po_matches = None
+    for _ in range(n_joins):
+        t0 = time.perf_counter()
+        res = run_cold()
+        cold_walls.append(time.perf_counter() - t0)
+        cold_matches = int(res.total)
+    for _ in range(n_joins):
+        t0 = time.perf_counter()
+        res = run_probe_only()
+        po_walls.append(time.perf_counter() - t0)
+        po_matches = int(res.total)
+    return {
+        "n_joins": n_joins,
+        "register_s": register_s,
+        "cold_wall_min_s": min(cold_walls),
+        "probe_only_wall_min_s": min(po_walls),
+        "probe_only_speedup": (min(cold_walls) / min(po_walls)
+                               if min(po_walls) else None),
+        "warm_probe_new_traces": cache.traces - traces0,
+        "matches_cold": cold_matches,
+        "matches_probe_only": po_matches,
+        "matches_equal": cold_matches == po_matches,
+        "resident": registry.stats()["tables"]["driver_build"],
+    }
 
 
 def _stringify_key(build, probe, join_key, nbytes):

@@ -443,6 +443,170 @@ def make_join_step(
     return step
 
 
+def resolve_probe_capacities(p_local: int, n: int, k: int,
+                             shuffle_capacity_factor: float,
+                             out_capacity_factor: float,
+                             out_rows_per_rank: Optional[int]):
+    """THE one probe-side capacity resolution of the probe-only
+    program: ``(p_cap per (sender, destination) bucket, out_cap per
+    batch)`` — shared by :func:`make_probe_join_step` and
+    :func:`..planning.plan.build_probe_plan` so a probe-only EXPLAIN
+    and the dispatched program can never drift apart (the
+    resolve_join_ladder discipline, applied to the probe side)."""
+    nb = k * n
+    p_cap = _round_up(
+        int(math.ceil(p_local / nb * shuffle_capacity_factor)), 8)
+    if out_rows_per_rank is not None:
+        out_cap = _round_up(
+            int(math.ceil(int(out_rows_per_rank) / k)), 8)
+    else:
+        out_cap = _round_up(
+            int(math.ceil(p_local / k * out_capacity_factor)), 8)
+    return p_cap, out_cap
+
+
+def make_probe_join_step(
+    comm: Communicator,
+    key: str = "key",
+    over_decomposition: int = 1,
+    shuffle_capacity_factor: float = DEFAULT_SHUFFLE_CAPACITY_FACTOR,
+    out_capacity_factor: float = DEFAULT_OUT_CAPACITY_FACTOR,
+    out_rows_per_rank: Optional[int] = None,
+    build_payload: Optional[Sequence[str]] = None,
+    probe_payload: Optional[Sequence[str]] = None,
+    shuffle: str = "padded",
+    compression_bits: Optional[int] = None,
+    kernel_config=None,
+    with_metrics: bool = False,
+    metrics_static: Optional[dict] = None,
+):
+    """The PROBE-ONLY join step against a resident build image
+    (service/resident.py; ROADMAP item 4).
+
+    ``step(resident_local, probe_local) -> JoinResult`` where
+    ``resident_local`` is one rank's shard of a registered build table
+    that ALREADY went through the expensive 2/3 of the pipeline —
+    hash-partitioned to this rank, shuffled, key-sorted into a
+    valid-prefix run (resident.make_resident_prep_step). Only the
+    probe side is partitioned, shuffled, and sorted here; each batch
+    merges against the full resident shard. Hash routing guarantees
+    co-location at ANY over-decomposition k: a key's destination rank
+    is ``h % n`` whether buckets were computed mod ``n``
+    (registration, k=1) or mod ``k*n`` (this step) — ``(h % kn) % n
+    == h % n`` — so matching keys always meet, and each probe row
+    rides exactly one batch.
+
+    The capacity contract mirrors :func:`make_join_step` on the probe
+    side verbatim (same per-bucket arithmetic, same overflow flag →
+    the same ``CapacityLadder`` escalates it); the build side has no
+    capacities to size — its image is fixed at registration. The skew
+    sidecar and 2-D (string) columns are not part of the probe-only
+    program (resident registration refuses them up front); pass those
+    workloads through the full join.
+    """
+    n = comm.n_ranks
+    k = over_decomposition
+    if k < 1:
+        raise ValueError("over_decomposition must be >= 1")
+    if shuffle not in ("padded", "ragged", "ppermute"):
+        raise ValueError(f"unknown shuffle mode {shuffle!r}")
+    if compression_bits is not None and shuffle == "ragged":
+        raise ValueError(
+            "compression applies to the padded/ppermute shuffles; the "
+            "ragged exchange already sends exact rows (combining the "
+            "two is unimplemented)"
+        )
+    nb = k * n
+    keys = [key] if isinstance(key, str) else list(key)
+
+    def step(resident_local: Table, probe_local: Table):
+        tape = telemetry.MetricsTape() if with_metrics else None
+        if tape is not None:
+            for mname, mval in (metrics_static or {}).items():
+                tape.add(mname, int(mval))
+        for t, side in ((resident_local, "resident"),
+                        (probe_local, "probe")):
+            for name, c in t.columns.items():
+                if c.ndim != 1:
+                    raise TypeError(
+                        f"{side} column {name!r} is {c.ndim}-D; the "
+                        "probe-only program covers scalar columns "
+                        "(register 2-D/string workloads through the "
+                        "full join)")
+        for kname in keys:
+            bdt = resident_local.columns[kname].dtype
+            pdt = probe_local.columns[kname].dtype
+            if bdt != pdt:
+                raise TypeError(
+                    f"key {kname!r} dtype mismatch: resident {bdt} "
+                    f"vs probe {pdt}")
+
+        p_cap, out_cap = resolve_probe_capacities(
+            probe_local.capacity, n, k, shuffle_capacity_factor,
+            out_capacity_factor, out_rows_per_rank)
+
+        if tape is not None:
+            tape.add("resident.rows",
+                     jnp.sum(resident_local.valid.astype(jnp.int64)))
+
+        parts = []
+        total = jnp.int64(0)
+        overflow = jnp.bool_(False)
+        if nb == 1:
+            with telemetry.span("join"):
+                res = sort_merge_inner_join(
+                    resident_local, probe_local, keys, out_cap,
+                    build_payload=build_payload,
+                    probe_payload=probe_payload,
+                    kernel_config=kernel_config,
+                )
+            parts.append(res.table)
+            total = total + res.total.astype(jnp.int64)
+            overflow = overflow | res.overflow
+        else:
+            with telemetry.span("partition"):
+                ptp = radix_hash_partition(probe_local, keys, nb)
+            tp = tape.scoped("probe") if tape is not None else None
+            if tape is not None:
+                tp.add("rows_partitioned",
+                       jnp.sum(ptp.counts.astype(jnp.int64)))
+                tp.record_min(
+                    "overflow_margin_min",
+                    jnp.int64(p_cap)
+                    - jnp.max(ptp.counts).astype(jnp.int64))
+            for b in range(k):
+                with telemetry.span("shuffle", batch=b):
+                    recv_probe, ovf_p = _batch_shuffle(
+                        comm, ptp, b, n, p_cap, mode=shuffle,
+                        compression_bits=compression_bits, tape=tp)
+                with telemetry.span("join", batch=b):
+                    res = sort_merge_inner_join(
+                        resident_local, recv_probe, keys, out_cap,
+                        build_payload=build_payload,
+                        probe_payload=probe_payload,
+                        kernel_config=kernel_config,
+                    )
+                parts.append(res.table)
+                total = total + res.total.astype(jnp.int64)
+                overflow = overflow | ovf_p | res.overflow
+        out = Table(
+            {
+                name: jnp.concatenate([t.columns[name] for t in parts])
+                for name in parts[0].column_names
+            },
+            jnp.concatenate([t.valid for t in parts]),
+        )
+        if tape is not None:
+            tape.add("matches", total)
+            metrics = tape.gathered(comm)
+        total = comm.psum(total)
+        overflow = comm.psum(overflow.astype(jnp.int32)) > 0
+        result = JoinResult(out, total=total, overflow=overflow)
+        return (result, metrics) if tape is not None else result
+
+    return step
+
+
 def make_distributed_join(comm: Communicator, with_metrics=None,
                           with_integrity: bool = False, **opts):
     """Compile a distributed inner join over ``comm``'s ranks.

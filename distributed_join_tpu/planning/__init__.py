@@ -37,6 +37,7 @@ from distributed_join_tpu.planning.plan import (
     abstract_tables,
     build_exchange_plan,
     build_plan,
+    build_probe_plan,
     explain_join,
 )
 from distributed_join_tpu.planning.tuner import (
@@ -61,6 +62,7 @@ __all__ = [
     "abstract_tables",
     "build_exchange_plan",
     "build_plan",
+    "build_probe_plan",
     "calibrate_from_history",
     "calibrate_from_stage_profile",
     "explain_join",

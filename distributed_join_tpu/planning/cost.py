@@ -150,6 +150,12 @@ def predict(plan, model: Optional[CostModel] = None) -> dict:
     n = plan.n_ranks
     k = plan.over_decomposition
     ns = 1e-9
+    # Probe-only plans (resident build tables, plan.build_probe_plan):
+    # the build side is an on-device image prepared at registration —
+    # no per-request build partition work or wire bytes — while the
+    # join stage still merges each batch against the full resident
+    # shard (capacities["resident_rows_per_rank"]).
+    probe_only = bool(getattr(plan, "probe_only", False))
 
     b_local = plan.build.rows_local
     p_local = plan.probe.rows_local
@@ -166,6 +172,11 @@ def predict(plan, model: Optional[CostModel] = None) -> dict:
     # per column into the padded/ragged layout.
     if single:
         partition_s = 0.0
+    elif probe_only:
+        partition_s = ns * (
+            p_local * m.sort_ns_per_elem
+            + p_shipped * m.row_gather_ns_per_row * _col_groups(p_cols)
+        )
     else:
         partition_s = ns * (
             (b_local + p_local) * m.sort_ns_per_elem
@@ -195,6 +206,12 @@ def predict(plan, model: Optional[CostModel] = None) -> dict:
         merged = b_local + p_local
         out_total = plan.capacities["out_rows_per_batch"]
         batches = 1
+    elif probe_only:
+        merged = (plan.capacities.get("resident_rows_per_rank",
+                                      b_local)
+                  + n * plan.capacities["shuffle_probe_per_bucket"])
+        out_total = plan.capacities["out_rows_per_batch"]
+        batches = k
     else:
         merged = (n * plan.capacities["shuffle_build_per_bucket"]
                   + n * plan.capacities["shuffle_probe_per_bucket"])

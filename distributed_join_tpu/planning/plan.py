@@ -119,6 +119,11 @@ class JoinPlan:
     memory: dict
     resolved_options: dict
     cost: dict
+    # Probe-only plans (resident build tables, service/resident.py):
+    # the build side is a registered on-device image — zero build
+    # wire bytes, no build partition work — and `pipeline` says so.
+    pipeline: str = "join"
+    probe_only: bool = False
 
     @property
     def n_buckets(self) -> int:
@@ -126,7 +131,8 @@ class JoinPlan:
 
     def as_record(self) -> dict:
         return {
-            "pipeline": "join",
+            "pipeline": self.pipeline,
+            "probe_only": self.probe_only,
             "signature_digest": self.digest,
             "n_ranks": self.n_ranks,
             "over_decomposition": self.over_decomposition,
@@ -588,6 +594,165 @@ def explain_join(build, probe, comm, key="key",
         metrics_static={"retry_attempt_max": 0},
         cost_model=cost_model,
         **ladder.sizing(), **opts)
+
+
+def build_probe_plan(comm, resident, probe, key="key",
+                     digest: Optional[str] = None,
+                     with_metrics=None,
+                     cost_model: Optional[CostModel] = None,
+                     **opts) -> JoinPlan:
+    """The PROBE-ONLY plan variant (resident build tables,
+    ``service/resident.py``): the program that ``make_probe_join_step``
+    compiles against an already-registered build image. Wire bytes and
+    partition work cover the PROBE side only — the build side's 2/3
+    was paid once at registration — and the join stage merges each
+    probe batch against the full resident shard. ``digest`` is the
+    :class:`~..service.resident.ResidentSignature` digest of the
+    corresponding cached program (plan == cache key, the EXPLAIN
+    agreement contract); when omitted a plan-local hash stands in
+    (dry runs without a registry)."""
+    import hashlib
+
+    from distributed_join_tpu import telemetry
+
+    from distributed_join_tpu.parallel.distributed_join import (
+        DEFAULT_OUT_CAPACITY_FACTOR,
+        DEFAULT_SHUFFLE_CAPACITY_FACTOR,
+        resolve_probe_capacities,
+    )
+
+    if with_metrics is None:
+        with_metrics = telemetry.enabled()
+    keys = [key] if isinstance(key, str) else list(key)
+    n = comm.n_ranks
+    k = int(opts.get("over_decomposition") or 1)
+    nb = n * k
+    shuffle = opts.get("shuffle") or "padded"
+    comp_bits = opts.get("compression_bits")
+    if k < 1:
+        raise ValueError("over_decomposition must be >= 1")
+    if shuffle not in ("padded", "ragged", "ppermute"):
+        raise ValueError(f"unknown shuffle mode {shuffle!r}")
+    shuffle_f = float(opts.get("shuffle_capacity_factor")
+                      or DEFAULT_SHUFFLE_CAPACITY_FACTOR)
+    out_f = float(opts.get("out_capacity_factor")
+                  or DEFAULT_OUT_CAPACITY_FACTOR)
+    out_rows = opts.get("out_rows_per_rank")
+
+    r_global = int(next(iter(
+        resident.columns.values())).shape[0])
+    p_global = int(next(iter(probe.columns.values())).shape[0])
+    r_local, p_local = r_global // n, p_global // n
+
+    rcols = _sorted_cols(_schema_cols(resident))
+    pcols = _sorted_cols(_schema_cols(probe))
+    side_b = SidePlan(
+        rows_global=r_global, rows_local=r_local, columns=rcols,
+        varwidth=(), row_bytes=_row_bytes(rcols),
+        row_bytes_fixed=_row_bytes(rcols),
+    )
+    side_p = SidePlan(
+        rows_global=p_global, rows_local=p_local, columns=pcols,
+        varwidth=(), row_bytes=_row_bytes(pcols),
+        row_bytes_fixed=_row_bytes(pcols),
+    )
+
+    p_cap, out_cap = resolve_probe_capacities(
+        p_local, n, k, shuffle_f, out_f, out_rows)
+    capacities = {
+        "shuffle_build_per_bucket": 0,
+        "shuffle_probe_per_bucket": p_cap,
+        "out_rows_per_batch": out_cap,
+        "shuffle_capacity_factor": shuffle_f,
+        "out_capacity_factor": out_f,
+        "out_rows_per_rank": out_rows,
+        "resident_rows_per_rank": r_local,
+    }
+
+    single = nb == 1
+    if single:
+        zero = {"bytes_per_rank": 0, "bytes_total": 0,
+                "rows_estimate": 0}
+        probe_wire = dict(zero)
+        coll = 0
+        exact = True
+    elif shuffle == "ragged":
+        per_rank = p_local * side_p.row_bytes
+        probe_wire = {"bytes_per_rank": int(per_rank),
+                      "bytes_total": int(per_rank) * n,
+                      "rows_estimate": p_local * n}
+        coll = k * (1 + len(pcols))
+        exact = False
+    else:
+        per_rank, raw = _padded_side_bytes(n, k, p_cap, pcols,
+                                           comp_bits)
+        probe_wire = {"bytes_per_rank": int(per_rank),
+                      "bytes_total": int(per_rank) * n,
+                      "rows_estimate": p_local * n}
+        if comp_bits is not None:
+            probe_wire["raw_bytes_per_rank"] = int(raw)
+        coll = k * (1 + (2 if comp_bits is not None else 1)
+                    * len(pcols))
+        exact = True
+    wire = {
+        "exact": exact,
+        "build": {"bytes_per_rank": 0, "bytes_total": 0,
+                  "rows_estimate": 0, "resident": True},
+        "probe": probe_wire,
+        "collectives_per_step": coll,
+    }
+
+    model = cost_model or CostModel()
+    # Resident shards + one batch's probe shuffle blocks + outputs.
+    out_row_bytes = side_b.row_bytes + side_p.row_bytes
+    mem_total = (r_local * side_b.row_bytes
+                 + p_local * side_p.row_bytes
+                 + 2 * n * p_cap * side_p.row_bytes
+                 + k * out_cap * out_row_bytes)
+    memory = {
+        "per_rank_bytes": {
+            "input": int(r_local * side_b.row_bytes
+                         + p_local * side_p.row_bytes),
+            "shuffle_blocks": int(2 * n * p_cap * side_p.row_bytes),
+            "output_blocks": int(k * out_cap * out_row_bytes),
+            "skew_blocks": 0,
+        },
+        "total_per_rank_bytes": int(mem_total),
+        "hbm_capacity_bytes": int(model.hbm_capacity_bytes),
+        "fits_hbm": bool(mem_total < model.hbm_capacity_bytes),
+    }
+
+    if digest is None:
+        digest = hashlib.sha256(json.dumps(
+            {"probe_only": True, "n_ranks": n, "key": keys,
+             "resident": [list(c) for c in rcols],
+             "probe": [list(c) for c in pcols],
+             "capacities": capacities, "shuffle": shuffle},
+            sort_keys=True, default=str).encode()).hexdigest()
+
+    plan = JoinPlan(
+        digest=digest,
+        n_ranks=n,
+        over_decomposition=k,
+        key=tuple(keys),
+        shuffle=shuffle,
+        compression_bits=comp_bits,
+        with_metrics=bool(with_metrics),
+        with_integrity=False,
+        build=side_b,
+        probe=side_p,
+        capacities=capacities,
+        skew=None,
+        wire=wire,
+        memory=memory,
+        resolved_options=_jsonable(
+            {k_: v for k_, v in opts.items()}),
+        cost={},
+        pipeline="probe_join",
+        probe_only=True,
+    )
+    object.__setattr__(plan, "cost", predict(plan, model))
+    return plan
 
 
 def build_exchange_plan(n_ranks: int, buffer_bytes_per_rank: int,

@@ -375,6 +375,42 @@ class JoinTuner:
         return self.recommend(sig, user_opts=opts,
                               side_geometry=geometry)
 
+    def resolve_resident(self, comm, resident_rows_per_rank: int,
+                         probe, *, signature: str,
+                         opts: Optional[dict] = None) -> TunedConfig:
+        """The PROBE-ONLY verdict (resident build tables,
+        ``service/resident.py``): sizing knobs and the rung label
+        only. The build side has no per-request sizing — its image is
+        fixed at registration — and structural fills never apply (the
+        skew sidecar is not part of the probe-only program, and the
+        wire mode was chosen when the probe workload was shaped), so
+        any structural recommendation the trend would make is
+        dropped. ``signature`` is the registry's GENERATION-FREE
+        workload identity, so the sizing history survives delta
+        merges."""
+        opts = dict(opts or {})
+        n = comm.n_ranks
+        k = int(opts.get("over_decomposition") or 1)
+        geometry = {
+            "nb": n * k,
+            "n_ranks": n,
+            # The "build" margin slot maps to the resident image —
+            # its overflow margin never appears in probe-only
+            # indicators, so only the probe clauses can fire.
+            "b_local": int(resident_rows_per_rank),
+            "p_local": _round_up(probe.capacity, n) // n,
+            "row_bytes": {
+                "build": None,
+                "probe": _fixed_row_bytes(probe),
+            },
+        }
+        cfg = self.recommend(signature, user_opts=opts,
+                             side_geometry=geometry)
+        if cfg.structural:
+            cfg.basis["structural_dropped"] = dict(cfg.structural)
+            cfg.structural = {}
+        return cfg
+
     # -- policy helpers ------------------------------------------------
 
     @staticmethod

@@ -16,6 +16,11 @@ SURVEY.md). This package makes the warm path run-only:
 - :mod:`.batching` — micro-batching of K small joins into ONE padded
   SPMD step, the batch id riding as an extra key column so matches
   can never cross requests, unpacked per request at settle;
+- :mod:`.resident` — :class:`~.resident.ResidentTableRegistry`:
+  named build tables registered ONCE (hash-partition + shuffle +
+  key-sort held resident on-device under a monotonic generation
+  stamp), served by probe-only programs and maintained LSM-style
+  from streaming delta appends (ROADMAP item 4);
 - :mod:`.server` — :class:`~.server.JoinService` (admission, watchdog
   deadlines, per-request telemetry spans, the retry ladder routed
   through the cache) and the resident TCP daemon
@@ -38,6 +43,12 @@ from distributed_join_tpu.service.batching import (
     combine,
     split,
 )
+from distributed_join_tpu.service.resident import (
+    ResidentError,
+    ResidentSignature,
+    ResidentTable,
+    ResidentTableRegistry,
+)
 
 # server (JoinService, ServiceConfig, the daemon) is deliberately NOT
 # imported here: it is a `python -m` entry point, and importing it from
@@ -47,6 +58,10 @@ __all__ = [
     "JoinProgramCache",
     "JoinSignature",
     "MicroBatch",
+    "ResidentError",
+    "ResidentSignature",
+    "ResidentTable",
+    "ResidentTableRegistry",
     "SEGMENT_COLUMN",
     "combine",
     "split",

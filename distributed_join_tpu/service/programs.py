@@ -161,8 +161,8 @@ class CachedProgram:
     source: str                  # "trace" | "disk"
     persisted: bool = False
 
-    def __call__(self, build, probe):
-        out = self.raw(build, probe)
+    def __call__(self, *args):
+        out = self.raw(*args)
         if not self.with_aux:
             return out
         res, metrics = out
@@ -204,6 +204,7 @@ class JoinProgramCache:
         self.disk_persists = 0
         self.lru_evictions = 0
         self.integrity_evictions = 0
+        self.generation_evictions = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -226,6 +227,7 @@ class JoinProgramCache:
             "disk_persists": self.disk_persists,
             "lru_evictions": self.lru_evictions,
             "integrity_evictions": self.integrity_evictions,
+            "generation_evictions": self.generation_evictions,
         }
 
     def signature(self, build, probe, with_metrics=None,
@@ -254,6 +256,10 @@ class JoinProgramCache:
         if entry is None:
             entry = self._build(sig, build, probe,
                                 dict(opts, with_metrics=with_metrics))
+        self._admit_entry(sig, entry)
+        return entry, False
+
+    def _admit_entry(self, sig, entry) -> None:
         self._entries[sig] = entry
         if self.max_entries is not None \
                 and len(self._entries) > self.max_entries:
@@ -264,6 +270,47 @@ class JoinProgramCache:
             telemetry.event("program_cache_lru_evict",
                             digest=old_sig.digest()[:12],
                             entries=len(self._entries))
+
+    def get_keyed(self, sig, builder, *, example_args=None,
+                  with_aux: bool = False):
+        """Generic admission for programs that are not a plain
+        (build, probe) join — the resident prep/merge/probe-only
+        programs of :mod:`..service.resident`. ``sig`` is any frozen
+        signature object with ``digest()``/``canonical()`` (and a
+        name-sorted ``options`` tuple when the program carries an aux
+        Metrics output); ``builder()`` returns the dispatchable
+        program on a cold miss. Same memory LRU + disk-AOT tiers and
+        the same hit/miss/trace counters as :meth:`get`;
+        ``example_args`` (the program's real call arguments) arms the
+        persist tier's lower+compile."""
+        entry = self._entries.get(sig)
+        if entry is not None:
+            self.hits += 1
+            self._entries.move_to_end(sig)
+            return entry, True
+        self.misses += 1
+        entry = self._load_persisted(sig)
+        if entry is None:
+            raw = builder()
+            self.traces += 1
+            telemetry.event("program_cache_trace",
+                            digest=sig.digest()[:12],
+                            entries=len(self._entries) + 1)
+            persisted = False
+            if self.persist_dir is not None and hasattr(raw, "lower") \
+                    and example_args is not None:
+                try:
+                    compiled = self._aot_compile(raw, *example_args)
+                    persisted = self._persist(sig, compiled)
+                    raw = compiled
+                except Exception as exc:  # pragma: no cover - backend-dependent
+                    telemetry.event("program_cache_persist_failed",
+                                    digest=sig.digest()[:12],
+                                    error=f"{type(exc).__name__}: "
+                                          f"{exc}")
+            entry = CachedProgram(sig, raw, with_aux, "trace",
+                                  persisted=persisted)
+        self._admit_entry(sig, entry)
         return entry, False
 
     def predict_hit(self, digest: str) -> dict:
@@ -305,6 +352,12 @@ class JoinProgramCache:
                 pass
         if dropped and reason == "integrity":
             self.integrity_evictions += 1
+        elif dropped and reason == "generation":
+            # A resident table's generation bump taints exactly the
+            # probe-only entries compiled against the old build image
+            # (service/resident.py) — counted so operators can see
+            # delta-driven churn next to integrity churn.
+            self.generation_evictions += 1
         return dropped
 
     def clear(self) -> None:
@@ -346,7 +399,7 @@ class JoinProgramCache:
                             sig.digest() + PROGRAM_SUFFIX)
 
     @staticmethod
-    def _aot_compile(raw, build, probe):
+    def _aot_compile(raw, *example_args):
         """Lower+compile for the persistence tier with jax's OWN
         persistent compilation cache bypassed: an executable
         rehydrated from that cache serializes into a blob whose CPU
@@ -369,7 +422,7 @@ class JoinProgramCache:
         try:
             jax.config.update("jax_compilation_cache_dir", None)
             compilation_cache.reset_cache()
-            return raw.lower(build, probe).compile()
+            return raw.lower(*example_args).compile()
         finally:
             jax.config.update("jax_compilation_cache_dir", prev)
             compilation_cache.reset_cache()

@@ -130,6 +130,16 @@ class ServiceConfig:
     tuner_history: Optional[str] = None
     flight_records: int = 256
     flight_recorder_path: Optional[str] = None
+    # Resident build tables (service/resident.py; docs/SERVICE.md
+    # "Resident build tables"): how many named tables may live
+    # on-device, the delta-headroom factor a registration sizes its
+    # shards with, the fixed delta slot (so repeat appends share one
+    # prep + merge program), and how many pending LSM runs accumulate
+    # before an append triggers the maintenance merge on its own.
+    max_resident_tables: int = 8
+    resident_capacity_factor: float = 1.5
+    delta_slot_rows: int = 1024
+    maintain_runs: int = 4
 
 
 class JoinService:
@@ -179,6 +189,21 @@ class JoinService:
                 self.history.path if self.history is not None
                 else None)
             self.tuner = JoinTuner(preload)
+        # Resident build tables (docs/SERVICE.md "Resident build
+        # tables"): named, generation-stamped on-device build images
+        # served by probe-only programs through the SAME program
+        # cache (so resident warm paths share its LRU/disk tiers and
+        # trace accounting).
+        from distributed_join_tpu.service.resident import (
+            ResidentTableRegistry,
+        )
+
+        self.resident = ResidentTableRegistry(
+            comm, self.cache,
+            max_tables=self.config.max_resident_tables,
+            capacity_factor=self.config.resident_capacity_factor,
+            delta_slot_rows=self.config.delta_slot_rows,
+            maintain_runs=self.config.maintain_runs)
         # Per-signature predicted-wall memo (plan construction is
         # cheap host arithmetic, but one join stream hits the same
         # signature thousands of times). Bounded; cleared wholesale.
@@ -425,6 +450,246 @@ class JoinService:
             r["request_id"] = getattr(res, "request_id", None)
         return results
 
+    def resident_join(self, table: str, probe, *, request_id=None,
+                      **opts):
+        """One probe-only join against resident table ``table``
+        (docs/SERVICE.md "Resident build tables"): admission,
+        watchdog deadline, span, and accounting exactly as
+        :meth:`join`, with dispatch routed through
+        :meth:`~.resident.ResidentTableRegistry.join` — pending delta
+        runs are LSM-merged first, and a warm repeat is a zero-trace
+        dict-lookup dispatch. The history entry is stamped
+        ``resident`` (cold full joins carry ``resident: null``)."""
+        from distributed_join_tpu.parallel.watchdog import (
+            HangError,
+            call_with_deadline,
+        )
+        from distributed_join_tpu.service.resident import (
+            ResidentError,
+        )
+
+        op = "resident_join"
+        rid = self._admit(op, request_id)
+        t_start = time.perf_counter()
+        sig = None
+        predicted = plan_digest = None
+        outcome = "failed"
+        res = None
+        err: Optional[BaseException] = None
+        new_traces = cache_hits = 0
+        resident_rec = None
+        try:
+            if self.config.verify_integrity:
+                raise ResidentError(
+                    "probe-only joins do not carry the wire-"
+                    "integrity digest rungs yet; serve verified "
+                    "traffic through the full join (delta "
+                    "conservation is still checked at every "
+                    "append/merge)")
+            sig = self.resident.workload_signature(
+                table, probe, dict(opts))
+            with self._exec_lock:
+                with self._admit_lock:
+                    if self.poisoned is not None:
+                        self.rejected += 1
+                        outcome = "rejected"
+                        telemetry.event("request_rejected",
+                                        reason="poisoned",
+                                        request_id=rid)
+                        raise AdmissionError(
+                            "mesh poisoned by a hung request "
+                            f"({self.poisoned}); restart the server")
+
+                def run_once():
+                    return self.resident.join(
+                        table, probe,
+                        auto_retry=self.config.auto_retry,
+                        tuner=self.tuner, **opts)
+
+                deadline = self.config.request_deadline_s
+                traces0 = self.cache.traces
+                hits0 = self.cache.hits
+                try:
+                    with telemetry.request_scope(rid), \
+                            telemetry.span("request", request_id=rid,
+                                           op=op, signature=sig,
+                                           table=table) as sp:
+                        if deadline is None:
+                            res = run_once()
+                        else:
+                            res = call_with_deadline(
+                                run_once, deadline,
+                                what=f"request {rid}")
+                        if sp is not None:
+                            sp.sync_on(res.total)
+                except Exception as exc:
+                    new_traces = self.cache.traces - traces0
+                    cache_hits = self.cache.hits - hits0
+                    if isinstance(exc, HangError):
+                        outcome = "hang"
+                        with self._admit_lock:
+                            self.poisoned = str(exc)
+                    raise
+                self.served += 1
+                new_traces = self.cache.traces - traces0
+                cache_hits = self.cache.hits - hits0
+                outcome = "served"
+                resident_rec = getattr(res, "resident", None)
+                object.__setattr__(res, "new_traces", new_traces)
+                object.__setattr__(res, "request_id", rid)
+                return res
+        except BaseException as exc:
+            err = exc
+            if outcome != "rejected":
+                if isinstance(exc, Exception):
+                    with self._admit_lock:
+                        self.failed += 1
+                else:
+                    outcome = "aborted"
+            raise
+        finally:
+            self._release()
+            if resident_rec is None:
+                # Failed/refused requests still stamp the handle they
+                # targeted so the history shows WHICH table refused.
+                resident_rec = {"table": table, "generation": None}
+            self._observe(rid, op, sig, outcome, res, err,
+                          time.perf_counter() - t_start,
+                          new_traces, cache_hits, predicted,
+                          plan_digest, resident=resident_rec)
+
+    def _table_op(self, op: str, table: str, fn, request_id=None):
+        """Admission + exec-lock + accounting wrapper for the
+        resident table-management ops (register/append/drop). They
+        dispatch prep/merge programs on the serving mesh, so they
+        carry the SAME request semantics as a join: poisoned re-check
+        under the exec lock (a parked op must not dispatch alongside
+        a hung request's detached worker), the per-request watchdog
+        deadline, and hang-poisoning."""
+        from distributed_join_tpu.parallel.watchdog import (
+            HangError,
+            call_with_deadline,
+        )
+
+        rid = self._admit(op, request_id)
+        t_start = time.perf_counter()
+        outcome = "failed"
+        err: Optional[BaseException] = None
+        out = None
+        new_traces = 0
+        sig = f"res-tbl-{table}"
+        try:
+            with self._exec_lock:
+                with self._admit_lock:
+                    if self.poisoned is not None:
+                        self.rejected += 1
+                        outcome = "rejected"
+                        telemetry.event("request_rejected",
+                                        reason="poisoned",
+                                        request_id=rid)
+                        raise AdmissionError(
+                            "mesh poisoned by a hung request "
+                            f"({self.poisoned}); restart the server")
+                deadline = self.config.request_deadline_s
+                traces0 = self.cache.traces
+                try:
+                    with telemetry.request_scope(rid), \
+                            telemetry.span("request", request_id=rid,
+                                           op=op, table=table):
+                        if deadline is None:
+                            out = fn()
+                        else:
+                            out = call_with_deadline(
+                                fn, deadline, what=f"request {rid}")
+                except Exception as exc:
+                    new_traces = self.cache.traces - traces0
+                    if isinstance(exc, HangError):
+                        outcome = "hang"
+                        with self._admit_lock:
+                            self.poisoned = str(exc)
+                    raise
+                new_traces = self.cache.traces - traces0
+                self.served += 1
+                outcome = "served"
+                return out
+        except BaseException as exc:
+            err = exc
+            if outcome != "rejected":
+                if isinstance(exc, Exception):
+                    with self._admit_lock:
+                        self.failed += 1
+                else:
+                    outcome = "aborted"
+            raise
+        finally:
+            self._release()
+            handle = self.resident.peek(table)
+            gen = handle.generation if handle is not None else None
+            self._observe(rid, op, sig, outcome, None, err,
+                          time.perf_counter() - t_start,
+                          new_traces, 0, None, None,
+                          resident={"table": table,
+                                    "generation": gen})
+
+    def note_refused_resident(self, table: str, request_id,
+                              exc: BaseException) -> str:
+        """Account a resident request refused BEFORE admission (the
+        wire handler's handle lookup: unknown or poisoned table).
+        Every other refusal the service makes lands in the live
+        metrics, the flight ring, and the history store — an operator
+        diagnosing a burst of client errors must see these too."""
+        with self._admit_lock:
+            rid = self._mint_request_id(request_id)
+            self.failed += 1
+        self._observe(rid, "resident_join", f"res-tbl-{table}",
+                      "failed", None, exc, 0.0, 0, 0, None, None,
+                      resident={"table": table, "generation": None})
+        return rid
+
+    def register_table(self, name: str, build, key="key", *,
+                       replace: bool = False, request_id=None,
+                       wire_spec=None) -> dict:
+        """Run the expensive build-side 2/3 ONCE and hold the result
+        resident under ``name`` (the ``register`` wire op)."""
+        def doit():
+            handle = self.resident.register(name, build, key=key,
+                                            replace=replace)
+            if wire_spec is not None:
+                handle.wire_spec = dict(wire_spec)
+                # The base key column powers per-request probe
+                # generation without regenerating the build
+                # (_probe_from_spec); one extra resident column, wire
+                # demo plane only.
+                kname = key if isinstance(key, str) else key[0]
+                handle.wire_build_keys = build.columns[kname]
+            return {"table": name, **handle.stats()}
+
+        return self._table_op("register", name, doit,
+                              request_id=request_id)
+
+    def append_rows(self, name: str, delta, *,
+                    maintain: Optional[bool] = None,
+                    request_id=None) -> dict:
+        """Land a delta as a sorted run on ``name``'s LSM queue (the
+        ``append`` wire op); the maintenance pass merges per the
+        configured ``maintain_runs`` policy (or immediately with
+        ``maintain=True``)."""
+        def doit():
+            handle = self.resident.append(name, delta,
+                                          maintain=maintain)
+            return {"table": name, **handle.stats()}
+
+        return self._table_op("append", name, doit,
+                              request_id=request_id)
+
+    def drop_table(self, name: str, *, request_id=None) -> dict:
+        def doit():
+            self.resident.drop(name)
+            return {"table": name, "dropped": True}
+
+        return self._table_op("drop", name, doit,
+                              request_id=request_id)
+
     def explain(self, build, probe, key="key", **opts) -> dict:
         """ADMISSION-FREE dry run (the ``explain`` wire op): resolve
         the plan + roofline cost prediction for exactly the program a
@@ -534,7 +799,7 @@ class JoinService:
 
     def _observe(self, rid, op, sig, outcome, res, err, elapsed_s,
                  new_traces, cache_hits, predicted_wall_s=None,
-                 plan_digest=None):
+                 plan_digest=None, resident=None):
         """Per-request accounting fan-out: live metrics, the flight-
         recorder ring, the workload-history store, and the poison-time
         flight dump. Observability must never turn a served request
@@ -575,7 +840,7 @@ class JoinService:
                 overflow=overflow, new_traces=new_traces,
                 cache_hits=cache_hits, rung_path=rung_path,
                 tuned=tel_history.tuned_summary(tuned),
-                error=error)
+                resident=resident, error=error)
             if self.history is not None or self.tuner is not None:
                 tel = (getattr(res, "telemetry", None)
                        if res is not None else None)
@@ -587,7 +852,7 @@ class JoinService:
                     metrics=tel.to_dict() if tel is not None else None,
                     predicted_wall_s=predicted_wall_s,
                     tuned=tuned, platform=_backend_platform(),
-                    error=error)
+                    resident=resident, error=error)
                 if self.history is not None:
                     self.history.append(entry)
                 if self.tuner is not None:
@@ -642,6 +907,7 @@ class JoinService:
             "latency_by_op": self.live.latency_by_op(),
             "poisoned": self.poisoned,
             "cache": self.cache.stats(),
+            "resident": self.resident.stats(),
             "tuner": (self.tuner.stats() if self.tuner is not None
                       else None),
         }
@@ -661,6 +927,7 @@ class JoinService:
         ``metrics`` op with ``format: "prometheus"``)."""
         st = self.stats()
         cache = st["cache"]
+        resident = st["resident"]
         return self.live.to_prometheus(gauges={
             "pending": st["pending"],
             "pending_high_water": st["pending_hwm"],
@@ -681,6 +948,18 @@ class JoinService:
             "program_cache_lru_evictions": cache["lru_evictions"],
             "program_cache_integrity_evictions":
                 cache["integrity_evictions"],
+            "program_cache_generation_evictions":
+                cache["generation_evictions"],
+            # Resident build tables (docs/OBSERVABILITY.md "Resident
+            # metrics"): how much build-side work is held on-device
+            # and how often the probe-only warm path serves it.
+            "resident_tables": resident["count"],
+            "resident_bytes": resident["bytes_resident"],
+            "resident_generation_max": resident["generation_max"],
+            "resident_probe_joins_total": resident["probe_joins"],
+            "resident_warm_probe_joins_total":
+                resident["warm_probe_joins"],
+            "resident_refused_total": resident["refused"],
         })
 
 
@@ -717,6 +996,78 @@ def _tables_from_spec(spec: dict):
 def _join_opts_from_spec(spec: dict) -> dict:
     return {k: spec[k] for k in _WIRE_JOIN_OPTS if spec.get(k)
             is not None}
+
+
+def _build_from_spec(spec: dict):
+    """The build-side table a ``register``/``append`` wire request
+    names (demo data plane, like :func:`_tables_from_spec`): a
+    deterministic generator table keyed by the request's seed. A
+    resident deployment embeds :class:`JoinService` and hands
+    ``register_table`` real device tables instead.
+
+    The PRNG key is derived EXACTLY as
+    ``generate_build_probe_tables(seed=...)`` derives its build key
+    (the first split of ``PRNGKey(seed)``), so a resident ``join``
+    whose probe spec reuses the registration seed draws its hit keys
+    from the table that was actually registered — ``selectivity``
+    keeps its hit-fraction meaning on the wire."""
+    import jax
+
+    from distributed_join_tpu.utils.generators import (
+        generate_build_table,
+    )
+
+    rows = int(spec["rows"])
+    kb, _ = jax.random.split(
+        jax.random.PRNGKey(int(spec.get("seed", 42))))
+    return generate_build_table(
+        kb,
+        rows,
+        int(spec.get("rand_max") or rows),
+        unique_keys=bool(spec.get("unique_keys", False)),
+    )
+
+
+def _probe_from_spec(spec: dict, handle):
+    """The probe table of a resident ``join`` request: drawn against
+    the registered table's stashed base KEY column, so
+    ``selectivity`` keeps its hit-fraction meaning without
+    regenerating the whole build per request (per-request wire work
+    scales with the probe, not the resident table — the point of the
+    resident tier). The PRNG key derivation matches
+    ``generate_build_probe_tables`` exactly (the second split), so a
+    request seed equal to the registration seed draws the identical
+    probe the combined generator would."""
+    import jax
+
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+        generate_probe_table,
+    )
+
+    base = handle.wire_spec or {}
+    rows = int(spec["probe_nrows"])
+    seed = int(spec.get("seed", base.get("seed", 42)))
+    rand_max = (int(spec.get("rand_max") or base.get("rand_max") or 0)
+                or int(base.get("rows", rows)))
+    if handle.wire_build_keys is not None:
+        _, kp = jax.random.split(jax.random.PRNGKey(seed))
+        return generate_probe_table(
+            kp, rows, rand_max,
+            float(spec.get("selectivity", 0.3)),
+            handle.wire_build_keys,
+        )
+    # Tables registered in-process (no wire spec): fall back to the
+    # combined generator at the probe's own scale.
+    _, probe = generate_build_probe_tables(
+        seed=seed,
+        build_nrows=int(base.get("rows", rows)),
+        probe_nrows=rows,
+        rand_max=rand_max,
+        selectivity=float(spec.get("selectivity", 0.3)),
+        unique_build_keys=bool(base.get("unique_keys", False)),
+    )
+    return probe
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -775,6 +1126,68 @@ class _Handler(socketserver.StreamRequestHandler):
             out = service.explain(build, probe,
                                   **_join_opts_from_spec(req))
             return {"ok": True, "op": "explain", **out}
+        if op == "register":
+            # Resident build tables (docs/SERVICE.md "Resident build
+            # tables"): run the build-side 2/3 once and hold the
+            # sorted shards on-device under req["name"].
+            build = _build_from_spec(req)
+            rec = service.register_table(
+                str(req["name"]), build,
+                replace=bool(req.get("replace", False)),
+                request_id=req.get("request_id"),
+                wire_spec={k: req[k] for k in
+                           ("rows", "seed", "rand_max", "unique_keys")
+                           if req.get(k) is not None})
+            return {"ok": True, "op": "register", **rec}
+        if op == "append":
+            delta = _build_from_spec(req)
+            rec = service.append_rows(
+                str(req["name"]), delta,
+                maintain=req.get("maintain"),
+                request_id=req.get("request_id"))
+            return {"ok": True, "op": "append", **rec}
+        if op == "drop":
+            rec = service.drop_table(str(req["name"]),
+                                     request_id=req.get("request_id"))
+            return {"ok": True, "op": "drop", **rec}
+        if op == "tables":
+            return {"ok": True, "op": "tables",
+                    **service.resident.stats()}
+        if op == "join" and req.get("table"):
+            # Probe-only serving against a registered table: the wire
+            # ships the PROBE spec only — the build side never rides
+            # the wire again.
+            name = str(req["table"])
+            from distributed_join_tpu.service.resident import (
+                ResidentError,
+            )
+
+            try:
+                handle = service.resident.get(name)
+            except ResidentError as exc:
+                # Refused before admission — still observed (history
+                # line, flight record, live failure counter).
+                service.note_refused_resident(
+                    name, req.get("request_id"), exc)
+                raise
+            probe = _probe_from_spec(req, handle)
+            t0 = time.perf_counter()
+            res = service.resident_join(
+                name, probe, request_id=req.get("request_id"),
+                **_join_opts_from_spec(req))
+            elapsed = time.perf_counter() - t0
+            return {
+                "ok": True,
+                "request_id": getattr(res, "request_id", None),
+                "table": name,
+                "resident": getattr(res, "resident", None),
+                "matches": int(res.total),
+                "overflow": bool(res.overflow),
+                "elapsed_s": elapsed,
+                "new_traces": getattr(res, "new_traces", 0),
+                "retry": res.retry_report.as_record(),
+                "cache": service.cache.stats(),
+            }
         if op == "join":
             build, probe = _tables_from_spec(req)
             t0 = time.perf_counter()
@@ -821,7 +1234,8 @@ class _Handler(socketserver.StreamRequestHandler):
                 "cache": service.cache.stats(),
             }
         raise ValueError(f"unknown op {op!r} (ops: ping, stats, "
-                         "metrics, explain, join, batch, shutdown)")
+                         "metrics, explain, join, batch, register, "
+                         "append, tables, drop, shutdown)")
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -967,6 +1381,19 @@ def parse_args(argv=None):
                         "memory; persisted blobs survive): the wire "
                         "lets every request pick its own table shape, "
                         "and each shape is a compiled program")
+    p.add_argument("--max-resident-tables", type=int, default=8,
+                   help="resident build tables held on-device at "
+                        "once (register refuses beyond this; "
+                        "docs/SERVICE.md 'Resident build tables')")
+    p.add_argument("--resident-capacity-factor", type=float,
+                   default=1.5,
+                   help="delta headroom a registration sizes its "
+                        "resident shards with (appended rows beyond "
+                        "capacity refuse loudly at merge time)")
+    p.add_argument("--maintain-runs", type=int, default=4,
+                   help="pending LSM delta runs that trigger the "
+                        "maintenance merge on append (joins always "
+                        "merge any pending queue first)")
     p.add_argument("--persist-dir", default=None, metavar="DIR",
                    help="persist compiled executables under DIR (the "
                         "AOT serialization tier): a restarted server "
@@ -1015,6 +1442,10 @@ def parse_args(argv=None):
                         "vs-sequential comparison")
     p.add_argument("--smoke-batch", type=int, default=16,
                    help="small joins per smoke micro-batch")
+    p.add_argument("--smoke-resident-joins", type=int, default=3,
+                   help="timed joins per side in the smoke's "
+                        "resident A/B (probe-only vs cold full "
+                        "join; min wall is compared)")
     p.add_argument("--smoke-no-wall-gate", action="store_true",
                    help="report the batched-vs-sequential wall clocks "
                         "but do not FAIL on them (the perfgate lane "
@@ -1057,6 +1488,9 @@ def _service_from_args(args) -> JoinService:
         tuner_history=(args.auto_tune or None),
         flight_records=args.flight_records,
         flight_recorder_path=args.flight_recorder_path,
+        max_resident_tables=args.max_resident_tables,
+        resident_capacity_factor=args.resident_capacity_factor,
+        maintain_runs=args.maintain_runs,
     )
     return JoinService(comm, cfg)
 
@@ -1160,6 +1594,130 @@ def _poison_drill(n_ranks: int, args) -> dict:
         "flightrecorder": path,
         "flight_records": len(drill.recorder),
         "rejected_after_poison": drill.rejected,
+    }
+
+
+def _resident_drill(service: JoinService, args, violations) -> dict:
+    """The smoke's resident A/B (docs/SERVICE.md "Resident build
+    tables"): register a build table once, then N probe-only joins vs
+    N cold full joins of the same query — the warm probe-only joins
+    must add ZERO traces and (unless ``--smoke-no-wall-gate``) beat
+    the warm full joins on the noise-robust minimum wall; after two
+    LSM delta merges the probe-only answer must equal the pandas
+    oracle over the combined build. Runs IN-PROCESS with
+    ``with_metrics=False`` on every join so the telemetry session's
+    final counter block — the ``service_smoke`` baseline gate — stays
+    exactly the batched join's. The returned record carries a
+    deterministic counter signature gated against
+    ``results/baselines/resident_smoke.json`` (the ``resident`` and
+    ``perfgate`` lanes)."""
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+        generate_build_table,
+    )
+    import jax
+
+    n_joins = args.smoke_resident_joins
+    build, probe = generate_build_probe_tables(
+        seed=7, build_nrows=16384, probe_nrows=2048, rand_max=8192,
+        selectivity=0.5)
+    deltas = [generate_build_table(jax.random.PRNGKey(s), 1024, 8192)
+              for s in (8, 9)]
+    opts = dict(with_metrics=False, out_capacity_factor=3.0)
+    name = "smoke_dim"
+
+    reg = service.register_table(name, build)
+
+    def timed(fn):
+        walls, matches, traces = [], [], 0
+        for _ in range(n_joins):
+            t0 = time.perf_counter()
+            res = fn()
+            walls.append(time.perf_counter() - t0)
+            matches.append(int(res.total))
+            traces += getattr(res, "new_traces", 0)
+        return walls, matches, traces
+
+    # Warm both programs outside the timing (compiles happen here).
+    service.join(build, probe, **opts)
+    service.resident_join(name, probe, **opts)
+    cold_walls, cold_matches, cold_traces = timed(
+        lambda: service.join(build, probe, **opts))
+    po_walls, po_matches, po_traces = timed(
+        lambda: service.resident_join(name, probe, **opts))
+    if po_traces or cold_traces:
+        violations.append(
+            f"resident drill: timed warm passes traced programs "
+            f"(probe-only {po_traces}, cold {cold_traces})")
+    if po_matches != cold_matches:
+        violations.append(
+            f"resident drill: probe-only matches {po_matches} != "
+            f"cold full-join matches {cold_matches}")
+    if min(po_walls) >= min(cold_walls) \
+            and not args.smoke_no_wall_gate:
+        violations.append(
+            f"resident drill: warm probe-only ({min(po_walls):.4f}s "
+            "min) did not beat the warm cold full join "
+            f"({min(cold_walls):.4f}s min)")
+
+    # Streaming ingestion: two delta appends, each merged LSM-style;
+    # the probe-only answer must match the pandas oracle over the
+    # combined build, and the repeat at the new generation is warm.
+    for d in deltas:
+        service.append_rows(name, d, maintain=True)
+    handle = service.resident.get(name)
+    res_after = service.resident_join(name, probe, **opts)
+    res_warm = service.resident_join(name, probe, **opts)
+    if res_warm.new_traces:
+        violations.append(
+            "resident drill: post-append warm repeat traced "
+            f"{res_warm.new_traces} program(s)")
+    import pandas as pd
+
+    combined = pd.concat([build.to_pandas()]
+                         + [d.to_pandas() for d in deltas])
+    oracle_after = len(combined.merge(probe.to_pandas(), on="key"))
+    if int(res_after.total) != oracle_after:
+        violations.append(
+            f"resident drill: matches after {len(deltas)} LSM "
+            f"merges = {int(res_after.total)} != pandas oracle "
+            f"{oracle_after}")
+    if handle.generation != 1 + len(deltas):
+        violations.append(
+            f"resident drill: generation {handle.generation} != "
+            f"{1 + len(deltas)} after {len(deltas)} appends")
+
+    stats = service.resident.stats()
+    return {
+        "kind": "resident_drill",
+        "benchmark": "resident_smoke",
+        "n_ranks": service.comm.n_ranks,
+        "table": name,
+        "registered_rows": reg["rows"],
+        "joins_per_side": n_joins,
+        "cold_wall_min_s": min(cold_walls),
+        "probe_only_wall_min_s": min(po_walls),
+        "probe_only_speedup": (min(cold_walls) / min(po_walls)
+                               if min(po_walls) else None),
+        "resident": stats["tables"][name],
+        # The deterministic gate body: integer counters only — walls
+        # are never part of a counter signature.
+        "counter_signature": {
+            "signature_version": 1,
+            "n_ranks": service.comm.n_ranks,
+            "counters": {
+                "base_rows": reg["rows"],
+                "delta_rows_appended": 2048,
+                "generation": handle.generation,
+                "lsm_merges": stats["tables"][name]["merges"],
+                "matches_cold": cold_matches[0],
+                "matches_probe_only": po_matches[0],
+                "matches_after_appends": int(res_after.total),
+                "warm_probe_new_traces": int(po_traces),
+                "resident_bytes": stats["tables"][name][
+                    "bytes_resident"],
+            },
+        },
     }
 
 
@@ -1313,6 +1871,12 @@ def run_smoke(service: JoinService, args) -> dict:
                 f"history store holds {hsum['n_signatures']} "
                 "signature(s); the smoke's traffic spans >= 2")
 
+    # Resident A/B: in-process against the same (still-live) service
+    # object — the TCP loop above is untouched, and every drill join
+    # runs with_metrics=False so the baseline-gated counter block
+    # stays the batched join's.
+    resident_drill = _resident_drill(service, args, violations)
+
     drill = _poison_drill(service.comm.n_ranks, args)
 
     record = {
@@ -1339,6 +1903,7 @@ def run_smoke(service: JoinService, args) -> dict:
         "pending_hwm": stats.get("pending_hwm"),
         "cache": stats["cache"],
         "history": history_info,
+        "resident_drill": resident_drill,
         "poison_drill": drill,
         "violations": violations,
         # the warmup responses keep the smoke honest in the record

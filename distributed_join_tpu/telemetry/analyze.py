@@ -903,6 +903,18 @@ def check_file(path: str) -> list:
                             problems.append(
                                 f"line {i}: history entry missing "
                                 f"{key!r}")
+                    # Resident stamp (service/resident.py): requests
+                    # served against a registered build table carry
+                    # the handle + generation they dispatched under
+                    # (None = a cold full join).
+                    res_stamp = ev.get("resident")
+                    if res_stamp is not None:
+                        if not isinstance(res_stamp, dict) or not \
+                                {"table", "generation"} <= \
+                                set(res_stamp):
+                            problems.append(
+                                f"line {i}: resident stamp missing "
+                                "table/generation keys")
                 elif kind not in ("event", "span"):
                     problems.append(f"line {i}: bad kind {kind!r}")
             # A torn FINAL line is the advertised killed-run artifact
@@ -971,6 +983,24 @@ def check_file(path: str) -> list:
         if isinstance(doc.get("monolithic"), dict) and \
                 "wall_s" not in doc["monolithic"]:
             problems.append("monolithic missing 'wall_s'")
+        return problems
+    elif name.startswith("resident_drill") or \
+            doc.get("kind") == "resident_drill":
+        # The service smoke's resident A/B sub-record (register ->
+        # probe-only vs cold full joins; service/server.py
+        # run_smoke): carries the deterministic counter signature the
+        # perfgate lane gates against results/baselines/
+        # resident_smoke.json.
+        for key in ("kind", "n_ranks", "counter_signature"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        sig = doc.get("counter_signature")
+        if isinstance(sig, dict):
+            if not isinstance(sig.get("counters"), dict):
+                problems.append("counter_signature missing "
+                                "'counters'")
+        elif "counter_signature" in doc:
+            problems.append("counter_signature is not an object")
         return problems
     elif name == "flightrecorder.json" or \
             doc.get("kind") == "flightrecorder":

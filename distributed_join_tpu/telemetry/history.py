@@ -332,6 +332,7 @@ def request_entry(*, request_id: str, op: str, signature: str,
                   tuned: Optional[dict] = None,
                   platform: Optional[str] = None,
                   stage_profile: Optional[dict] = None,
+                  resident: Optional[dict] = None,
                   error: Optional[str] = None) -> dict:
     """One serving request's history line (the JoinService write
     path). ``metrics`` is the request's ``Metrics.to_dict()`` block
@@ -340,7 +341,11 @@ def request_entry(*, request_id: str, op: str, signature: str,
     ``tuned`` the autotuner's ``TunedConfig.as_record()`` when the
     request dispatched pre-sized; ``platform`` the backend the wall
     was measured on (the calibration seam only trusts real-hardware
-    entries)."""
+    entries); ``resident`` stamps a request that ran against a
+    resident build table (``{"table", "generation", ...}`` —
+    service/resident.py) so the store distinguishes probe-only
+    serving from cold full joins (None = cold; ``analyze check``
+    validates the stamp's shape)."""
     from distributed_join_tpu.telemetry import baselines
 
     return {
@@ -363,6 +368,7 @@ def request_entry(*, request_id: str, op: str, signature: str,
         "indicators": quick_indicators(metrics),
         "prediction": prediction_block(wall_s, predicted_wall_s),
         "stages": stages_block(stage_profile),
+        "resident": resident,
         "error": error,
     }
 

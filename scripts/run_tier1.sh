@@ -40,6 +40,17 @@
 #                                   # Metrics counters, and the
 #                                   # stage-sum >= monolithic floor
 #                                   # (noise-robust min walls) gated
+#   scripts/run_tier1.sh resident   # resident build tables: -m
+#                                   # resident suite (probe-only
+#                                   # oracle correctness, LSM delta
+#                                   # merges, conservation chaos
+#                                   # slice) + the daemon smoke's
+#                                   # resident A/B with the strict
+#                                   # wall gate (warm probe-only must
+#                                   # beat the warm cold full join
+#                                   # and add zero traces) + the
+#                                   # resident_smoke counter-
+#                                   # signature gate
 #   scripts/run_tier1.sh tuner      # autotuner: -m tuner suite + a
 #                                   # cold/warm driver A/B (warm run
 #                                   # must start at the escalated
@@ -154,6 +165,21 @@ case "$lane" in
     # no exec: the EXIT trap must still clean $tmp
     python -m distributed_join_tpu.telemetry.analyze compare \
       "$tmp/service_smoke.json" --baseline service_smoke
+    # The resident A/B sub-record of the same smoke gates its own
+    # deterministic counter signature (docs/SERVICE.md "Resident
+    # build tables"): register -> probe-only matches, the pandas-
+    # oracle match count after 2 LSM delta merges, the generation
+    # stamp, and the zero warm-trace count.
+    python - "$tmp" <<'PY'
+import json, sys
+rec = json.load(open(f"{sys.argv[1]}/service_smoke.json"))
+json.dump(rec["resident_drill"],
+          open(f"{sys.argv[1]}/resident_drill.json", "w"), indent=1)
+PY
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/resident_drill.json"
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/resident_drill.json" --baseline resident_smoke
     exit $?
     ;;
   lint)
@@ -372,8 +398,51 @@ assert sig["source"] == "history" and sig["delta"], sig
 print("analyze tune schema: OK,", doc["n_signatures"], "signature(s)")'
     exit $?
     ;;
+  resident)
+    # Resident build tables (docs/SERVICE.md "Resident build
+    # tables"). 1. the -m resident unit suite (probe-only oracle
+    # correctness, LSM merges, generation eviction, conservation-
+    # check chaos slice, wire ops); 2. the daemon smoke WITH the
+    # strict wall gate — the warm probe-only join must beat the warm
+    # cold full join on the min wall and add zero traces; 3. the
+    # resident drill sub-record is schema-checked and its
+    # deterministic counter signature gated against
+    # results/baselines/resident_smoke.json; history entries must
+    # carry validated resident stamps.
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m resident --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    tmp="$(mktemp -d /tmp/djtpu_resident.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.service.server --smoke \
+      --platform cpu --n-ranks 8 \
+      --history-dir "$tmp/history" \
+      --flight-recorder-path "$tmp/flightrecorder.json" \
+      --json-output "$tmp/smoke.json"
+    python - "$tmp" <<'PY'
+import json, sys
+rec = json.load(open(f"{sys.argv[1]}/smoke.json"))
+drill = rec["resident_drill"]
+json.dump(drill, open(f"{sys.argv[1]}/resident_drill.json", "w"),
+          indent=1)
+assert drill["probe_only_speedup"] and drill["probe_only_speedup"] > 1
+assert drill["counter_signature"]["counters"][
+    "warm_probe_new_traces"] == 0
+print(f"resident drill: probe-only x{drill['probe_only_speedup']:.2f}"
+      f" vs cold, generation {drill['resident']['generation']}, "
+      f"{drill['resident']['merges']} LSM merge(s), 0 warm traces")
+PY
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/resident_drill.json" "$tmp/history/history.jsonl"
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/resident_drill.json" --baseline resident_smoke
+    exit $?
+    ;;
   *)
-    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|stageprof|tuner]" >&2
+    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|stageprof|tuner|resident]" >&2
     exit 2
     ;;
 esac

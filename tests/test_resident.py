@@ -1,0 +1,644 @@
+"""Resident build tables (distributed_join_tpu/service/resident.py)
+on the 8-virtual-device CPU mesh.
+
+Four contracts (docs/SERVICE.md "Resident build tables"):
+
+- **Probe-only correctness.** A registered table's probe-only join
+  returns the exact pandas-oracle row multiset of the full join —
+  including across over-decomposition (bucket routing mod ``k*n``
+  co-locates with the registration's mod ``n``) — and the warm repeat
+  is a zero-trace dict-lookup dispatch.
+- **LSM ingestion.** Delta appends land as small sorted runs; the
+  maintenance merge folds them into the resident shards; after >= 2
+  merges the probe-only answer equals a from-scratch join of the
+  combined build. Generation bumps evict exactly the probe-only
+  entries compiled against the old image.
+- **Loud refusal, never wrong rows.** Unknown/duplicate/poisoned
+  handles, schema-mismatched or value-corrupted deltas (the key-sum
+  conservation check), capacity-overflowing merges, and unsupported
+  workload shapes all raise :class:`ResidentError` — the handle is
+  left untouched or explicitly poisoned, never silently wrong.
+- **Service wiring.** The daemon's register/append/tables/drop ops
+  and the ``table``-targeted join work over the wire; stats and
+  Prometheus expose resident count/bytes/generation/hit counters;
+  history entries carry the resident stamp that ``analyze check``
+  validates.
+"""
+
+import json
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+import pandas as pd
+
+from distributed_join_tpu import telemetry
+from distributed_join_tpu.parallel.communicator import TpuCommunicator
+from distributed_join_tpu.parallel.distributed_join import (
+    distributed_inner_join,
+)
+from distributed_join_tpu.service.programs import JoinProgramCache
+from distributed_join_tpu.service.resident import (
+    ResidentError,
+    ResidentTableRegistry,
+)
+from distributed_join_tpu.table import Table
+from distributed_join_tpu.utils.generators import (
+    generate_build_probe_tables,
+    generate_build_table,
+)
+
+pytestmark = pytest.mark.resident
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_session():
+    telemetry.finalize()
+    yield
+    telemetry.finalize()
+
+
+class CountingComm(TpuCommunicator):
+    """Counts built SPMD programs — a warm probe-only dispatch must
+    add zero (the test_service.py lock, extended to residents)."""
+
+    def __init__(self, n_ranks: int = 8):
+        super().__init__(n_ranks=n_ranks)
+        self.programs_built = 0
+
+    def spmd(self, fn, *, sharded_out=None):
+        self.programs_built += 1
+        return super().spmd(fn, sharded_out=sharded_out)
+
+
+class CorruptingComm(TpuCommunicator):
+    """Perturbs int64 payloads through ``all_to_all`` when armed —
+    the corrupting-transport adversary the resident conservation
+    checks exist for (value moves, row counts don't)."""
+
+    def __init__(self, n_ranks: int = 8):
+        super().__init__(n_ranks=n_ranks)
+        self.corrupt = False
+
+    def all_to_all(self, x):
+        out = super().all_to_all(x)
+        if self.corrupt and x.dtype == jnp.int64:
+            out = out.at[0].add(jnp.int64(1))
+        return out
+
+
+def _tables(seed=11, build=512, probe=1024, rand_max=256):
+    return generate_build_probe_tables(
+        seed=seed, build_nrows=build, probe_nrows=probe,
+        rand_max=rand_max, selectivity=0.5)
+
+
+def _delta(seed, rows=256, rand_max=256):
+    return generate_build_table(jax.random.PRNGKey(seed), rows,
+                                rand_max)
+
+
+def _sorted_frame(df):
+    # Canonical multiset form: name-sorted columns (a jitted Table's
+    # pytree dict comes back key-sorted), then row-sorted by all.
+    cols = sorted(df.columns)
+    return df[cols].sort_values(cols).reset_index(drop=True)
+
+
+def _oracle_frame(build_frames, probe):
+    return pd.concat(build_frames).merge(probe.to_pandas(), on="key")
+
+
+# -- probe-only correctness -------------------------------------------
+
+
+def test_probe_only_matches_oracle_and_full_join():
+    """Probe-only rows == pandas oracle == the full join's multiset;
+    the warm repeat builds zero programs and reports warm=True."""
+    b, p = _tables()
+    comm = CountingComm()
+    cache = JoinProgramCache(comm)
+    reg = ResidentTableRegistry(comm, cache)
+    reg.register("dim", b)
+
+    res = reg.join("dim", p, with_metrics=False,
+                   out_capacity_factor=4.0)
+    got = _sorted_frame(res.table.to_pandas())
+    want = _sorted_frame(_oracle_frame([b.to_pandas()], p))
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+    full = distributed_inner_join(b, p, comm, out_capacity_factor=4.0)
+    assert int(full.total) == int(res.total)
+
+    built = comm.programs_built
+    traces = cache.traces
+    res2 = reg.join("dim", p, with_metrics=False,
+                    out_capacity_factor=4.0)
+    assert comm.programs_built == built and cache.traces == traces
+    assert int(res2.total) == int(res.total)
+    assert res2.resident["warm"] is True
+    assert reg.stats()["warm_probe_joins"] == 1
+
+
+def test_probe_only_over_decomposition_routes_correctly():
+    """Registration buckets mod n; a k=2 probe-only join buckets mod
+    2n — matching keys still co-locate ((h % kn) % n == h % n) and
+    the answer stays oracle-exact."""
+    b, p = _tables(seed=13)
+    comm = TpuCommunicator(n_ranks=8)
+    reg = ResidentTableRegistry(comm, JoinProgramCache(comm))
+    reg.register("dim", b)
+    res = reg.join("dim", p, with_metrics=False, over_decomposition=2,
+                   out_capacity_factor=4.0)
+    assert int(res.total) == len(_oracle_frame([b.to_pandas()], p))
+
+
+def test_probe_ladder_escalates_on_overflow():
+    """An undersized probe-side out capacity overflows; the ladder
+    escalates (probe-side knobs only) and the final answer is
+    oracle-exact with the trail in retry_report."""
+    b, p = _tables(seed=17)
+    comm = TpuCommunicator(n_ranks=8)
+    reg = ResidentTableRegistry(comm, JoinProgramCache(comm))
+    reg.register("dim", b)
+    res = reg.join("dim", p, with_metrics=False, auto_retry=4,
+                   out_capacity_factor=0.05)
+    assert res.retry_report.n_attempts > 1
+    assert int(res.total) == len(_oracle_frame([b.to_pandas()], p))
+
+
+# -- LSM ingestion ----------------------------------------------------
+
+
+def test_lsm_appends_merge_to_oracle():
+    """Two appends + maintenance merges: oracle-exact rows after each
+    merge, generation bumped per append, old-generation cache entries
+    evicted, and the post-merge repeat is warm."""
+    b, p = _tables()
+    comm = CountingComm()
+    cache = JoinProgramCache(comm)
+    # capacity_factor sized for the deltas this test appends (an
+    # UNDER-sized factor is test_overflowing_merge_poisons_handle).
+    reg = ResidentTableRegistry(comm, cache, capacity_factor=3.0)
+    reg.register("dim", b)
+    reg.join("dim", p, with_metrics=False, out_capacity_factor=4.0)
+
+    d1, d2 = _delta(21), _delta(22)
+    reg.append("dim", d1, maintain=True)
+    assert cache.generation_evictions >= 1
+    reg.append("dim", d2, maintain=True)
+    h = reg.get("dim")
+    assert h.generation == 3 and h.merges == 2
+
+    res = reg.join("dim", p, with_metrics=False,
+                   out_capacity_factor=4.0)
+    frames = [b.to_pandas(), d1.to_pandas(), d2.to_pandas()]
+    got = _sorted_frame(res.table.to_pandas())
+    want = _sorted_frame(_oracle_frame(frames, p))
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+    built = comm.programs_built
+    reg.join("dim", p, with_metrics=False, out_capacity_factor=4.0)
+    assert comm.programs_built == built
+
+
+def test_pending_runs_merge_on_read():
+    """maintain=False queues the delta; the next join merges the
+    pending queue first (merge-on-read), so appended rows are always
+    visible."""
+    b, p = _tables(seed=23)
+    comm = TpuCommunicator(n_ranks=8)
+    reg = ResidentTableRegistry(comm, JoinProgramCache(comm),
+                                maintain_runs=16)
+    reg.register("dim", b)
+    d = _delta(24)
+    reg.append("dim", d, maintain=False)
+    assert reg.get("dim").pending_runs
+    res = reg.join("dim", p, with_metrics=False,
+                   out_capacity_factor=4.0)
+    assert not reg.get("dim").pending_runs
+    assert int(res.total) == len(
+        _oracle_frame([b.to_pandas(), d.to_pandas()], p))
+
+
+# -- loud refusal -----------------------------------------------------
+
+
+def test_refusals_never_wrong_rows():
+    b, p = _tables(seed=25)
+    comm = TpuCommunicator(n_ranks=8)
+    reg = ResidentTableRegistry(comm, JoinProgramCache(comm))
+
+    with pytest.raises(ResidentError, match="no resident table"):
+        reg.join("ghost", p)
+    reg.register("dim", b)
+    with pytest.raises(ResidentError, match="already exists"):
+        reg.register("dim", b)
+
+    # schema-mismatched delta refused, handle untouched
+    bad = Table.from_dense({
+        "key": jnp.arange(64, dtype=jnp.int64),
+        "other_payload": jnp.zeros(64, dtype=jnp.int64)})
+    gen = reg.get("dim").generation
+    with pytest.raises(ResidentError, match="schema"):
+        reg.append("dim", bad)
+    assert reg.get("dim").generation == gen
+
+    # 2-D columns and float keys go through the full join
+    strings = Table.from_dense({
+        "key": jnp.arange(64, dtype=jnp.int64),
+        "s": jnp.zeros((64, 8), dtype=jnp.uint8),
+        "s#len": jnp.full((64,), 8, dtype=jnp.int32)})
+    with pytest.raises(ResidentError, match="scalar"):
+        reg.register("str", strings)
+    floaty = Table.from_dense({
+        "key": jnp.arange(64, dtype=jnp.float32),
+        "v": jnp.zeros(64, dtype=jnp.int64)})
+    with pytest.raises(ResidentError, match="integer"):
+        reg.register("float", floaty)
+
+    # the skew sidecar is not a probe-only knob
+    with pytest.raises(ResidentError, match="skew"):
+        reg.join("dim", p, skew_threshold=0.001)
+
+    reg.drop("dim")
+    with pytest.raises(ResidentError, match="no resident table"):
+        reg.join("dim", p)
+    assert reg.stats()["refused"] >= 5
+
+
+def test_corrupt_delta_refuses_loudly():
+    """Chaos slice: a value-corrupting transport fails the key-sum
+    conservation check — the append refuses, the handle keeps its
+    old generation/rows, and later joins still serve the CLEAN
+    image (graded against the oracle)."""
+    comm = CorruptingComm()
+    reg = ResidentTableRegistry(comm, JoinProgramCache(comm))
+    b, p = _tables(seed=27)
+    reg.register("dim", b)
+    before = reg.get("dim")
+    gen, rows = before.generation, before.rows
+
+    comm.corrupt = True
+    with pytest.raises(ResidentError, match="conservation"):
+        reg.append("dim", _delta(28))
+    comm.corrupt = False
+
+    h = reg.get("dim")
+    assert (h.generation, h.rows) == (gen, rows)
+    assert not h.pending_runs
+    res = reg.join("dim", p, with_metrics=False,
+                   out_capacity_factor=4.0)
+    assert int(res.total) == len(_oracle_frame([b.to_pandas()], p))
+
+
+def test_poisoned_registration_refuses_loudly():
+    """A corrupting transport at REGISTRATION time must refuse the
+    registration outright — no handle is ever created from a failed
+    conservation check."""
+    comm = CorruptingComm()
+    reg = ResidentTableRegistry(comm, JoinProgramCache(comm))
+    b, _ = _tables(seed=29)
+    comm.corrupt = True
+    with pytest.raises(ResidentError, match="conservation"):
+        reg.register("dim", b)
+    assert "dim" not in reg
+    comm.corrupt = False
+    reg.register("dim", b)   # clean transport: registers fine
+
+
+def test_overflowing_merge_poisons_handle():
+    """Appends past the resident capacity overflow the maintenance
+    merge: the handle poisons, joins refuse, drop + re-register
+    recovers."""
+    comm = TpuCommunicator(n_ranks=8)
+    reg = ResidentTableRegistry(comm, JoinProgramCache(comm),
+                                capacity_factor=1.0,
+                                delta_slot_rows=512)
+    b, p = _tables(seed=31)
+    reg.register("dim", b)
+    cap_global = reg.get("dim").capacity_per_rank * 8
+    appended = 0
+    with pytest.raises(ResidentError, match="overflow|capacity"):
+        while True:
+            reg.append("dim", _delta(100 + appended, rows=512),
+                       maintain=True)
+            appended += 1
+            assert appended < 64, (
+                f"never overflowed {cap_global} global capacity")
+    with pytest.raises(ResidentError, match="poisoned"):
+        reg.join("dim", p)
+    reg.drop("dim")
+    reg.register("dim", b)
+    assert int(reg.join("dim", p, with_metrics=False,
+                        out_capacity_factor=4.0).total) == \
+        len(_oracle_frame([b.to_pandas()], p))
+
+
+# -- plan / signature agreement ---------------------------------------
+
+
+def test_probe_only_plan_agrees_with_cache_key():
+    """explain=True attaches the probe-only JoinPlan: its digest IS
+    the ResidentSignature cache key of the dispatched program, the
+    build side ships zero wire bytes, and the cost stages price the
+    probe side only."""
+    b, p = _tables(seed=33)
+    comm = TpuCommunicator(n_ranks=8)
+    cache = JoinProgramCache(comm)
+    reg = ResidentTableRegistry(comm, cache)
+    reg.register("dim", b)
+    res = reg.join("dim", p, with_metrics=False,
+                   out_capacity_factor=4.0, explain=True)
+    plan = res.plan
+    assert plan.probe_only and plan.pipeline == "probe_join"
+    assert plan.wire["build"]["bytes_total"] == 0
+    assert plan.wire["build"].get("resident") is True
+    assert plan.wire["probe"]["bytes_total"] > 0
+    assert plan.cost["stages"]["skew"] == 0.0
+    assert plan.cost["total_s"] > 0
+    # digest == the resident program-cache key of the dispatched entry
+    handle = reg.get("dim")
+    digests = {sig.digest() for sig in handle.cached_sigs}
+    assert plan.digest in digests
+    rec = plan.as_record()
+    assert rec["pipeline"] == "probe_join" and rec["probe_only"]
+    assert rec["capacities"]["shuffle_build_per_bucket"] == 0
+
+
+# -- tuner sizes the probe side ---------------------------------------
+
+
+def test_tuner_presizes_probe_only_repeat(tmp_path):
+    """Service-level: a cold probe-only request escalates the probe
+    ladder; the tuned repeat dispatches pre-sized at the escalated
+    rung with zero new traces and zero escalations."""
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+
+    comm = CountingComm()
+    svc = JoinService(comm, ServiceConfig(
+        auto_retry=6, auto_tune=True,
+        history_dir=str(tmp_path / "hist")))
+    b, p = _tables(seed=35)
+    svc.register_table("dim", b)
+    r1 = svc.resident_join("dim", p, with_metrics=False,
+                           out_capacity_factor=0.05)
+    assert r1.retry_report.n_attempts > 1, \
+        "cold request never escalated: the A/B tests nothing"
+    assert not bool(r1.overflow), \
+        "cold request never settled: the warm gate would test nothing"
+    built = comm.programs_built
+    r2 = svc.resident_join("dim", p, with_metrics=False,
+                           out_capacity_factor=0.05)
+    assert r2.new_traces == 0 and comm.programs_built == built
+    assert r2.retry_report.n_attempts == 1
+    assert r2.tuned["source"] == "history" and r2.tuned["rung"] >= 1
+    assert int(r1.total) == int(r2.total)
+
+
+# -- service wiring ---------------------------------------------------
+
+
+def test_service_wire_ops_and_observability(tmp_path):
+    """register/append/tables/drop + the table-targeted join over the
+    real TCP loop; stats/metrics/Prometheus expose the resident
+    block; history entries stamp resident/cold and pass
+    ``analyze check``."""
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceClient,
+        ServiceConfig,
+        start_daemon,
+    )
+    from distributed_join_tpu.telemetry.analyze import check_file
+
+    comm = TpuCommunicator(n_ranks=8)
+    svc = JoinService(comm, ServiceConfig(
+        history_dir=str(tmp_path / "hist")))
+    server, port = start_daemon(svc, "127.0.0.1", 0)
+    client = ServiceClient("127.0.0.1", port)
+    try:
+        reg = client.send({"op": "register", "name": "dim",
+                           "rows": 512, "seed": 11, "rand_max": 256})
+        assert reg["ok"] and reg["generation"] == 1
+        assert reg["rows"] == 512
+
+        cold = client.send({"op": "join", "table": "dim",
+                            "probe_nrows": 1024, "selectivity": 0.5,
+                            "out_capacity_factor": 4.0})
+        assert cold["ok"] and cold["matches"] > 0
+        assert cold["resident"]["table"] == "dim"
+        warm = client.send({"op": "join", "table": "dim",
+                            "probe_nrows": 1024, "selectivity": 0.5,
+                            "out_capacity_factor": 4.0})
+        assert warm["ok"] and warm["new_traces"] == 0
+        assert warm["matches"] == cold["matches"]
+
+        app = client.send({"op": "append", "name": "dim",
+                           "rows": 256, "seed": 12, "rand_max": 256,
+                           "maintain": True})
+        assert app["ok"] and app["generation"] == 2
+
+        tabs = client.send({"op": "tables"})
+        assert tabs["ok"] and tabs["count"] == 1
+        assert "dim" in tabs["tables"]
+
+        stats = client.send({"op": "stats"})
+        res_stats = stats["resident"]
+        assert res_stats["count"] == 1
+        assert res_stats["bytes_resident"] > 0
+        assert res_stats["probe_joins"] == 2
+        prom = client.send({"op": "metrics",
+                            "format": "prometheus"})["prometheus"]
+        for gauge in ("djtpu_resident_tables 1",
+                      "djtpu_resident_probe_joins_total 2",
+                      "djtpu_resident_generation_max 2",
+                      "djtpu_resident_bytes"):
+            assert gauge in prom, gauge
+
+        # Wire seed agreement: the registered build and the probe's
+        # hit-key pool must be the SAME table (register derives its
+        # PRNG key exactly as the probe generator does). A sparse
+        # key domain makes any drift visible: selectivity 1.0 must
+        # hit every probe row, not chance collisions (~0 at 2^40).
+        client.send({"op": "register", "name": "sparse", "rows": 512,
+                     "seed": 31, "rand_max": 1 << 40})
+        hit = client.send({"op": "join", "table": "sparse",
+                           "probe_nrows": 512, "selectivity": 1.0,
+                           "out_capacity_factor": 4.0})
+        assert hit["ok"] and hit["matches"] == 512, hit
+
+        missing = client.send({"op": "join", "table": "ghost",
+                               "probe_nrows": 64})
+        assert not missing["ok"]
+        assert "no resident table" in missing["message"]
+        # a pre-admission refusal is still OBSERVED: live failure
+        # counter + flight record + (checked below) a history line
+        assert svc.failed >= 1
+        assert any(r.get("outcome") == "failed"
+                   and (r.get("signature") or "").endswith("ghost")
+                   for r in svc.recorder.snapshot()["records"])
+
+        drop = client.send({"op": "drop", "name": "dim"})
+        assert drop["ok"] and drop["dropped"]
+        client.send({"op": "drop", "name": "sparse"})
+        assert client.send({"op": "tables"})["count"] == 0
+        client.send({"op": "shutdown"})
+    finally:
+        client.close()
+        server.server_close()
+
+    hist_path = svc.history.path
+    assert check_file(hist_path) == []
+    entries = [json.loads(ln) for ln in open(hist_path)]
+    stamps: dict = {}   # first entry per op
+    for e in entries:
+        stamps.setdefault(e["op"], e.get("resident"))
+    assert stamps["register"]["table"] == "dim"
+    assert stamps["resident_join"]["table"] == "dim"
+    assert stamps["resident_join"]["generation"] == 1
+    assert stamps["append"]["generation"] == 2
+
+    # a corrupted stamp must be a check_file problem
+    bad = dict(entries[0])
+    bad["resident"] = {"nope": 1}
+    bad_path = tmp_path / "hist" / "history.jsonl"
+    with open(bad_path, "a") as f:
+        f.write(json.dumps(bad) + "\n")
+    assert any("resident stamp" in p for p in check_file(str(bad_path)))
+
+
+def test_resident_drill_record_schema(tmp_path):
+    """The smoke's resident A/B sub-record is a recognized artifact:
+    ``analyze check`` validates it by kind, and the baseline layer
+    extracts its deterministic counter signature."""
+    from distributed_join_tpu.telemetry.analyze import check_file
+    from distributed_join_tpu.telemetry.baselines import (
+        counter_signature,
+    )
+
+    rec = {
+        "kind": "resident_drill",
+        "benchmark": "resident_smoke",
+        "n_ranks": 8,
+        "counter_signature": {
+            "signature_version": 1, "n_ranks": 8,
+            "counters": {"base_rows": 16384, "generation": 3},
+        },
+    }
+    path = tmp_path / "resident_drill.json"
+    path.write_text(json.dumps(rec))
+    assert check_file(str(path)) == []
+    sig = counter_signature(rec)
+    assert sig["counters"]["generation"] == 3
+
+    bad = dict(rec)
+    del bad["counter_signature"]
+    path.write_text(json.dumps(bad))
+    assert check_file(str(path))
+
+
+def test_hanging_register_poisons_service(tmp_path):
+    """Table-management ops carry the join's hang semantics: a
+    register whose prep program blows the request deadline poisons
+    the service (refusing later requests) and dumps the flight
+    recorder, instead of wedging the daemon on the exec lock."""
+    import threading
+
+    from distributed_join_tpu.parallel.faults import (
+        FaultInjectingCommunicator,
+        FaultPlan,
+    )
+    from distributed_join_tpu.parallel.watchdog import HangError
+    from distributed_join_tpu.service.server import (
+        AdmissionError,
+        JoinService,
+        ServiceConfig,
+    )
+
+    comm = FaultInjectingCommunicator(
+        TpuCommunicator(n_ranks=8),
+        FaultPlan(dispatch_delay_s=3.0))
+    svc = JoinService(comm, ServiceConfig(
+        request_deadline_s=0.5,
+        flight_recorder_path=str(tmp_path / "fr.json")))
+    b, _ = _tables(seed=39)
+    try:
+        with pytest.raises(HangError):
+            svc.register_table("dim", b)
+        assert svc.poisoned
+        assert svc.flight_recorder_dumped
+        with pytest.raises(AdmissionError, match="poisoned"):
+            svc.register_table("dim2", b)
+        assert svc.rejected == 1
+    finally:
+        # Drain the detached watchdog worker before the next test
+        # (it is still dispatching the delayed prep program).
+        for t in threading.enumerate():
+            if t.name.startswith("watchdog-request"):
+                t.join(timeout=120.0)
+
+
+def test_verify_integrity_service_refuses_resident():
+    """A verify-integrity service refuses probe-only joins loudly
+    (the digest rungs are not in the probe-only program yet) instead
+    of silently skipping verification."""
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+
+    comm = TpuCommunicator(n_ranks=8)
+    svc = JoinService(comm, ServiceConfig(verify_integrity=True))
+    b, p = _tables(seed=37)
+    svc.register_table("dim", b)
+    with pytest.raises(ResidentError, match="integrity"):
+        svc.resident_join("dim", p)
+    assert svc.failed == 1
+
+
+# -- driver A/B -------------------------------------------------------
+
+
+def test_driver_resident_ab(tmp_path):
+    """``--resident-ab N`` emits both numbers in one record: equal
+    matches, zero warm probe-only traces, and a registration story."""
+    from distributed_join_tpu.benchmarks.distributed_join import main
+
+    out = tmp_path / "rec.json"
+    rc = main([
+        "--platform", "cpu", "--n-ranks", "8",
+        "--build-table-nrows", "4096", "--probe-table-nrows", "1024",
+        "--iterations", "1", "--out-capacity-factor", "3.0",
+        "--resident-ab", "2", "--json-output", str(out),
+    ])
+    assert rc == 0
+    rec = json.loads(out.read_text())
+    ab = rec["resident_ab"]
+    assert ab["matches_equal"] is True
+    assert ab["warm_probe_new_traces"] == 0
+    assert ab["n_joins"] == 2
+    assert ab["resident"]["rows"] == 4096
+    assert ab["cold_wall_min_s"] > 0 and ab["probe_only_wall_min_s"] > 0
+
+
+def test_driver_resident_ab_skips_string_payloads(tmp_path):
+    """Workload shapes the resident subsystem refuses (string
+    payloads) skip the A/B with a reason instead of dying."""
+    from distributed_join_tpu.benchmarks.distributed_join import main
+
+    out = tmp_path / "rec.json"
+    rc = main([
+        "--platform", "cpu", "--n-ranks", "8",
+        "--build-table-nrows", "1024", "--probe-table-nrows", "1024",
+        "--iterations", "1", "--out-capacity-factor", "3.0",
+        "--string-payload-bytes", "8",
+        "--resident-ab", "1", "--json-output", str(out),
+    ])
+    assert rc == 0
+    ab = json.loads(out.read_text())["resident_ab"]
+    assert "skipped" in ab
