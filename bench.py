@@ -125,6 +125,21 @@ def main(argv=None) -> int:
     from distributed_join_tpu.benchmarks import add_robustness_args
 
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--shuffle",
+                   choices=["padded", "ragged", "ppermute",
+                            "hierarchical"],
+                   default="padded",
+                   help="shuffle mode of the measured join "
+                        "(hierarchical = two-level ICI/DCN over "
+                        "--slices; docs/HIERARCHY.md)")
+    p.add_argument("--slices", type=int, default=None,
+                   help="hierarchical-mesh slice count (must divide "
+                        "the device count; needs --shuffle "
+                        "hierarchical)")
+    p.add_argument("--dcn-codec", choices=["off", "auto", "on"],
+                   default="auto",
+                   help="cross-slice FoR+bitpack codec knob of "
+                        "--shuffle hierarchical")
     add_telemetry_args(p)
     add_robustness_args(p)
     args = p.parse_args(argv)
@@ -296,7 +311,23 @@ def _run(args=None) -> dict:
     # only start once the backend is up (the line above).
     telemetry.refresh_rank()
     telemetry.maybe_start_xla_trace()
-    comm = LocalCommunicator() if n_dev == 1 else TpuCommunicator(n_ranks=n_dev)
+    shuffle_mode = getattr(args, "shuffle", "padded") or "padded"
+    slices = getattr(args, "slices", None)
+    if (slices or 1) > 1 and shuffle_mode != "hierarchical":
+        raise SystemExit(
+            f"--slices {slices} needs --shuffle hierarchical (a "
+            "global collective over a multi-slice mesh drags "
+            "intra-slice traffic across DCN)")
+    if (slices or 1) > 1:
+        from distributed_join_tpu.parallel.communicator import (
+            HierarchicalTpuCommunicator,
+        )
+
+        comm = HierarchicalTpuCommunicator(n_slices=slices,
+                                           n_ranks=n_dev)
+    else:
+        comm = (LocalCommunicator() if n_dev == 1
+                else TpuCommunicator(n_ranks=n_dev))
     if args is not None:
         comm = maybe_chaos_communicator(comm, args)
 
@@ -320,13 +351,18 @@ def _run(args=None) -> dict:
     # documents the driver-path contract). The workload identity keys
     # ride the record so the end-of-run --history entry files under
     # the same signature the lookup used.
-    workload = {
+    workload = {k: v for k, v in {
         "benchmark": "bench",
         "n_ranks": n_dev,
         "build_table_nrows": BUILD_NROWS,
         "probe_table_nrows": PROBE_NROWS,
         "selectivity": SELECTIVITY,
-    }
+        "shuffle": (shuffle_mode if shuffle_mode != "padded"
+                    else None),
+        "slices": slices if (slices or 1) > 1 else None,
+        "dcn_codec": ((getattr(args, "dcn_codec", "auto") or "auto")
+                      if shuffle_mode == "hierarchical" else None),
+    }.items() if v is not None}
     tuned_sizing, tuned_rung, tuned_rec = {}, 0, None
     if args is not None:
         from distributed_join_tpu.benchmarks import (
@@ -338,6 +374,23 @@ def _run(args=None) -> dict:
         if tuner is not None:
             tuned_sizing, tuned_rung, tuned_rec = tuned_driver_record(
                 tuner, workload)
+
+    # Hierarchical mode arms the DCN codec bits on the ladder (the
+    # cross-slice tier is a requested codec; a residual overflow must
+    # widen bits, not double capacities) — the driver's discipline.
+    dcn_bits = None
+    if shuffle_mode == "hierarchical":
+        from distributed_join_tpu.planning.cost import (
+            resolve_dcn_bits,
+        )
+
+        dcn_bits = resolve_dcn_bits(
+            getattr(args, "dcn_codec", "auto") or "auto",
+            None, n_slices=slices or 1)
+    join_base = dict(key="key", over_decomposition=1,
+                     shuffle=shuffle_mode,
+                     dcn_codec=getattr(args, "dcn_codec", "auto")
+                     or "auto")
 
     def measure(out_rows_per_rank=None):
         # Overflow escalates instead of crashing (faults.CapacityLadder
@@ -354,14 +407,14 @@ def _run(args=None) -> dict:
             out_rows_per_rank=(
                 out_rows_per_rank if out_rows_per_rank is not None
                 else tuned_sizing.get("out_rows_per_rank")),
+            compression_bits=tuned_sizing.get("compression_bits",
+                                              dcn_bits),
             base_rung=tuned_rung,
         )
         for attempt in range(_AUTO_RETRY + 1):
             sizing = {k: v for k, v in ladder.sizing().items()
                       if v is not None}
-            step = make_join_step(
-                comm, key="key", over_decomposition=1, **sizing
-            )
+            step = make_join_step(comm, **join_base, **sizing)
             per_join, total, overflow = timed_join_throughput(
                 comm, step, build, probe, ITERS
             )
@@ -407,8 +460,7 @@ def _run(args=None) -> dict:
 
         integ = collect_integrity(
             comm, build, probe,
-            dict(key="key", over_decomposition=1,
-                 out_capacity_factor=3.0),
+            dict(join_base, out_capacity_factor=3.0),
         )
 
     # --explain: the headline protocol's resolved plan + roofline
@@ -425,8 +477,8 @@ def _run(args=None) -> dict:
         )
 
         doc = planning.build_plan(
-            comm, build, probe, key="key", with_metrics=False,
-            over_decomposition=1, **sizing_match,
+            comm, build, probe, with_metrics=False,
+            **join_base, **sizing_match,
         ).explain_record()
         write_explain(args, doc)
         explain_rec = explain_summary(doc)
@@ -440,7 +492,7 @@ def _run(args=None) -> dict:
 
         stage_rec = maybe_stage_profile(
             args, comm, build, probe,
-            dict(key="key", over_decomposition=1, **sizing_match))
+            dict(join_base, **sizing_match))
     from distributed_join_tpu.benchmarks import stamp_record
 
     record = stamp_record({

@@ -496,6 +496,7 @@ def add_robustness_args(parser) -> None:
 # PR 5's --verify-integrity/--chaos-seed/--guard-deadline-s used to be
 # silently dropped by tpu-launch; one table now defines what forwards.
 FORWARDED_CHILD_FLAGS = (
+    ("--slices", "slices", True),
     ("--telemetry", "telemetry", True),
     ("--trace", "trace", False),
     ("--diagnose", "diagnose", False),

@@ -71,10 +71,28 @@ def parse_args(argv=None):
     p.add_argument("--duplicate-build-keys", action="store_true",
                    help="draw build keys with replacement (default: unique)")
     p.add_argument("--over-decomposition-factor", type=int, default=1)
-    p.add_argument("--shuffle", choices=["padded", "ragged", "ppermute"],
+    p.add_argument("--shuffle",
+                   choices=["padded", "ragged", "ppermute",
+                            "hierarchical"],
                    default="padded",
                    help="ragged = exact-size lax.ragged_all_to_all "
-                        "exchange (no pad bytes on the wire)")
+                        "exchange (no pad bytes on the wire); "
+                        "hierarchical = the two-level ICI/DCN shuffle "
+                        "over a multi-slice mesh (--slices; "
+                        "docs/HIERARCHY.md)")
+    p.add_argument("--slices", type=int, default=None,
+                   help="slow-tier (DCN) slice count of the "
+                        "hierarchical mesh; must divide --n-ranks. "
+                        "Real multi-slice topology is read from the "
+                        "devices; the CPU mesh fakes it with nested "
+                        "axes (e.g. 8 devices as --slices 2 = 2x4)")
+    p.add_argument("--dcn-codec", choices=["off", "auto", "on"],
+                   default="auto",
+                   help="FoR+bitpack codec on the CROSS-SLICE tier of "
+                        "--shuffle hierarchical (auto = on exactly "
+                        "when the configured DCN bandwidth sits below "
+                        "the codec's ~5-7 GB/s break-even; "
+                        "docs/HIERARCHY.md)")
     p.add_argument("--communicator", default="tpu",
                    help="tpu | local (NCCL/UCX are the reference's GPU "
                         "backends and are rejected with guidance)")
@@ -166,6 +184,12 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def _dcn_codec_on(knob: str) -> bool:
+    from distributed_join_tpu.planning.cost import resolve_dcn_codec
+
+    return resolve_dcn_codec(knob)
+
+
 def _string_wire_accounting(build, shuffle_mode):
     """Exact vs fixed-width wire bytes for EVERY byte-exact-eligible
     string column on the build side (the plane exchange runs in ragged
@@ -228,8 +252,15 @@ def run(args) -> dict:
               "compression_for_bitpack.json) — above that, raw is "
               "faster", file=sys.stderr)
 
+    if (args.slices or 1) > 1 and args.shuffle != "hierarchical":
+        raise SystemExit(
+            f"--slices {args.slices} builds a multi-slice mesh, and "
+            f"--shuffle {args.shuffle} would route one GLOBAL "
+            "collective across its DCN tier — pass --shuffle "
+            "hierarchical (or drop --slices)")
     comm = maybe_chaos_communicator(
-        make_communicator(args.communicator, n_ranks=args.n_ranks),
+        make_communicator(args.communicator, n_ranks=args.n_ranks,
+                          n_slices=args.slices),
         args,
     )
     n = comm.n_ranks
@@ -385,6 +416,11 @@ def run(args) -> dict:
             "payload_type": args.payload_type,
             "key_columns": args.key_columns,
             "over_decomposition_factor": args.over_decomposition_factor,
+            "slices": (args.slices
+                       if (args.slices or 1) > 1 else None),
+            "dcn_codec": (args.dcn_codec
+                          if args.shuffle == "hierarchical"
+                          else None),
             "zipf_alpha": args.zipf_alpha,
             "skew_threshold": skew_threshold,
             "string_payload_bytes": args.string_payload_bytes,
@@ -415,10 +451,19 @@ def run(args) -> dict:
         # Tuned bits only WIDEN an explicitly-requested codec — the
         # driver workload identity doesn't bind --compression, so
         # history must never switch the codec on for a run that
-        # didn't ask.
+        # didn't ask. Hierarchical mode arms the bits whenever its
+        # DCN codec resolves on (the cross-slice tier IS a requested
+        # codec; the ladder must widen it on a residual overflow) —
+        # topology-gated like resolve_join_ladder: one slice has no
+        # cross-slice payload, and armed bits would burn the first
+        # retry rung widening a knob the degenerate raw path ignores.
         compression_bits=(
             _tuned("compression_bits", args.compression_bits)
-            if args.compression else None
+            if (args.compression
+                or (args.shuffle == "hierarchical"
+                    and (args.slices or 1) > 1
+                    and _dcn_codec_on(args.dcn_codec)))
+            else None
         ),
         skew=skew_on,
         hh_build_capacity=(
@@ -442,6 +487,7 @@ def run(args) -> dict:
     fixed_opts = dict(
         key=join_key,
         shuffle=args.shuffle,
+        dcn_codec=args.dcn_codec,
         kernel_config=_kernel_config_from_args(args),
         over_decomposition=args.over_decomposition_factor,
         skew_threshold=skew_threshold,
@@ -536,6 +582,13 @@ def run(args) -> dict:
         "selectivity": args.selectivity,
         "over_decomposition_factor": args.over_decomposition_factor,
         "shuffle": args.shuffle,
+        # Normalized exactly like the --auto-tune lookup's workload
+        # dict (>1 else None): slices/dcn_codec are WORKLOAD_KEYS, so
+        # the end-of-run history entry must hash the values the
+        # lookup hashed or the tuner never warms from this store.
+        "slices": comm.n_slices if comm.n_slices > 1 else None,
+        "dcn_codec": (args.dcn_codec
+                      if args.shuffle == "hierarchical" else None),
         "compression_bits": (
             args.compression_bits if args.compression else None
         ),
@@ -588,6 +641,10 @@ def _resident_ab(comm, build, probe, join_key, n_joins, join_opts):
 
     if not isinstance(join_key, str):
         return {"skipped": "composite keys not yet resident"}
+    if join_opts.get("shuffle") == "hierarchical":
+        return {"skipped": "the probe-only program does not route "
+                           "hierarchically yet — run --resident-ab "
+                           "on a flat mesh"}
     try:
         cache = JoinProgramCache(comm)
         registry = ResidentTableRegistry(comm, cache)

@@ -52,6 +52,12 @@ def parse_args(argv=None):
     p.add_argument("--cpu-devices-per-process", type=int, default=None,
                    help="emulate this many virtual CPU devices per "
                         "process (no-TPU validation path, gloo transport)")
+    p.add_argument("--slices", type=int, default=None,
+                   help="hierarchical-mesh slice count, forwarded to "
+                        "every spawned driver (--shuffle hierarchical "
+                        "route; docs/HIERARCHY.md) — typically the "
+                        "process/host count, so the chip axis spans "
+                        "ICI and the slice axis spans DCN")
     # Telemetry (--telemetry/--trace/--diagnose) and robustness
     # (--verify-integrity/--chaos-seed/--guard-deadline-s) flags at
     # the launcher are FORWARDED to every spawned driver process (one

@@ -557,6 +557,161 @@ def run_tuner_trial(harness_seed: int, trial: int,
     return record
 
 
+def run_hier_trial(harness_seed: int, trial: int, n_ranks: int = 8,
+                   deadline_s: Optional[float] = 300.0) -> dict:
+    """One hierarchical-shuffle chaos trial: a two-level
+    ``--shuffle hierarchical`` join over a faked multi-slice mesh,
+    with the fault schedule injected at the communicator seams —
+    including the new cross-slice (DCN) exchange
+    (``FaultInjectingCommunicator.all_to_all_slice``) — graded
+    against the pandas oracle with wire digests on. Deterministic in
+    ``(harness_seed, trial)`` like every other trial."""
+    from distributed_join_tpu.parallel.watchdog import (
+        HangError,
+        call_with_deadline,
+    )
+
+    rng = _trial_rng(harness_seed, 10_000 + trial)
+    slices = rng.choice([s for s in (2, 4) if n_ranks % s == 0]
+                        or [1])
+    config = {
+        "mode": "hierarchical",
+        "n_slices": slices,
+        "dcn_codec": rng.choice(("auto", "on", "off")),
+        "build_rows": rng.choice(_BUILD_ROWS),
+        "probe_rows": rng.choice(_PROBE_ROWS),
+        "rand_max": rng.choice(_RAND_MAX),
+        "selectivity": rng.choice(_SELECTIVITY),
+        "table_seed": rng.randrange(1 << 16),
+        "auto_retry": 3,
+    }
+    plan = random_fault_plan(rng, corruption=True)
+    record = {
+        "trial": trial,
+        "config": config,
+        "fault": fault_label(plan),
+        "fault_plan": _plan_record(plan),
+    }
+    t0 = time.perf_counter()
+    try:
+        body = lambda: _run_hier_trial_body(config, plan, n_ranks)  # noqa: E731
+        out = (call_with_deadline(body, deadline_s,
+                                  what=f"hier chaos trial {trial}")
+               if deadline_s is not None else body())
+    except HangError as exc:
+        out = TrialOutcome("FAILED:hang", error=str(exc))
+    except Exception as exc:  # noqa: BLE001 — grading seam
+        out = TrialOutcome(
+            "FAILED:crash", error=f"{type(exc).__name__}: {exc}")
+    record.update(dataclasses.asdict(out))
+    record["verdict"] = out.verdict
+    record["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    return record
+
+
+def _run_hier_trial_body(config, plan: FaultPlan, n_ranks: int
+                         ) -> TrialOutcome:
+    import distributed_join_tpu as dj
+    from distributed_join_tpu.parallel import integrity
+    from distributed_join_tpu.parallel.communicator import (
+        HierarchicalTpuCommunicator,
+    )
+    from distributed_join_tpu.utils.generators import (
+        generate_build_probe_tables,
+    )
+
+    build, probe = generate_build_probe_tables(
+        seed=config["table_seed"],
+        build_nrows=config["build_rows"],
+        probe_nrows=config["probe_rows"],
+        rand_max=config["rand_max"],
+        selectivity=config["selectivity"],
+    )
+    oracle = _oracle_frame(build, probe)
+    oracle_total = len(oracle)
+    oracle_cols = _frame_columns(
+        oracle, ["key", "build_payload", "probe_payload"])
+    corrupting = plan.corrupt_mode is not None
+    injected = fault_label(plan) != "none"
+
+    comm = FaultInjectingCommunicator(
+        HierarchicalTpuCommunicator(n_slices=config["n_slices"],
+                                    n_ranks=n_ranks), plan)
+
+    def loud(kind: str, detail: Optional[str] = None) -> TrialOutcome:
+        return TrialOutcome(
+            "detected" if injected else f"FAILED:{kind}",
+            error=detail or kind, expected_total=oracle_total)
+
+    join_opts = dict(
+        out_capacity_factor=3.0,
+        shuffle_capacity_factor=3.0,
+        shuffle="hierarchical",
+        dcn_codec=config["dcn_codec"],
+    )
+    try:
+        def attempt():
+            return dj.distributed_inner_join(
+                build, probe, comm,
+                auto_retry=config["auto_retry"],
+                verify_integrity=True, **join_opts,
+            )
+
+        res, _ = retry_with_backoff(
+            attempt, max_attempts=3, backoff_s=0.01,
+            retry_on=(FaultInjectedError,),
+        )
+        retries = res.retry_report.n_attempts - 1
+        if bool(res.overflow):
+            return loud("overflow_after_ladder")
+        return _grade_result(
+            _result_columns(res.table), int(res.total),
+            oracle_cols, oracle_total, corrupting, retries,
+        )
+    except integrity.IntegrityError as exc:
+        return loud("false_integrity_alarm", f"IntegrityError: {exc}")
+    except (PlanValidationError, FaultInjectedError) as exc:
+        return loud("structured_error",
+                    f"{type(exc).__name__}: {exc}")
+
+
+def hier_slice(seed: int, trials: int, n_ranks: int = 8,
+               deadline_s: Optional[float] = 300.0,
+               repro_out: Optional[str] = None) -> dict:
+    """The --hier-slice soak: N hierarchical-shuffle trials; exit
+    contract mirrors the main soak (0 failures = pass)."""
+    records, failures = [], []
+    for k in range(trials):
+        rec = run_hier_trial(seed, k, n_ranks=n_ranks,
+                             deadline_s=deadline_s)
+        records.append(rec)
+        print(f"hier trial {k:3d} [{rec['config']['n_slices']}x"
+              f"{n_ranks // rec['config']['n_slices']} "
+              f"codec={rec['config']['dcn_codec']:4s}] "
+              f"fault={rec['fault']:17s} -> {rec['verdict']} "
+              f"({rec['elapsed_s']}s)", flush=True)
+        if rec["verdict"].startswith("FAILED"):
+            failures.append(rec)
+            if repro_out:
+                path = f"{repro_out}_hier_{seed}_{k}.json"
+                with open(path, "w") as f:
+                    json.dump({**rec, "harness_seed": seed}, f,
+                              indent=2)
+                print(f"  repro written: {path}", flush=True)
+    verdicts: dict = {}
+    for rec in records:
+        verdicts[rec["verdict"]] = verdicts.get(rec["verdict"], 0) + 1
+    return {
+        "harness_seed": seed,
+        "slice": "hierarchical_shuffle",
+        "n_ranks": n_ranks,
+        "trials": len(records),
+        "verdicts": verdicts,
+        "failures": len(failures),
+        "records": records,
+    }
+
+
 def tuner_slice(seed: int, trials: int, n_ranks: int = 8,
                 deadline_s: Optional[float] = 300.0,
                 repro_out: Optional[str] = None) -> dict:
@@ -656,6 +811,13 @@ def parse_args(argv=None):
     p.add_argument("--no-corruption", action="store_true",
                    help="restrict schedules to recoverable faults "
                         "(squeezes/transients) — the control arm")
+    p.add_argument("--hier-slice", type=int, default=None,
+                   metavar="N",
+                   help="instead of the main soak: N hierarchical-"
+                        "shuffle trials (--shuffle hierarchical over "
+                        "a faked multi-slice mesh, fault schedules "
+                        "including the cross-slice DCN exchange seam, "
+                        "pandas-oracle graded with wire digests on)")
     p.add_argument("--tuner-slice", type=int, default=None,
                    metavar="N",
                    help="instead of the main soak: N poisoned-history "
@@ -693,7 +855,13 @@ def main(argv=None) -> int:
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       0.5)
 
-    if args.tuner_slice:
+    if args.hier_slice:
+        summary = hier_slice(args.seed, args.hier_slice,
+                             n_ranks=args.n_ranks,
+                             deadline_s=(args.trial_deadline_s
+                                         or None),
+                             repro_out=args.repro_out)
+    elif args.tuner_slice:
         summary = tuner_slice(args.seed, args.tuner_slice,
                               n_ranks=args.n_ranks,
                               deadline_s=(args.trial_deadline_s

@@ -42,7 +42,10 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_join_tpu import compat
-from distributed_join_tpu.parallel.mesh import make_mesh
+from distributed_join_tpu.parallel.mesh import (
+    make_hierarchical_mesh,
+    make_mesh,
+)
 
 
 class Communicator(abc.ABC):
@@ -107,6 +110,38 @@ class Communicator(abc.ABC):
     def pvary(self, x):
         """Mark ``x`` as varying over the rank axis for shard_map's
         vma checker (identity on single-rank backends)."""
+        return x
+
+    # -- hierarchical seams (two-level ICI/DCN shuffle) ---------------
+    #
+    # A flat communicator is the degenerate one-slice hierarchy: the
+    # whole mesh is one ICI domain, so the slice-local exchange IS the
+    # global all_to_all and the cross-slice exchange is the identity.
+    # Multi-slice backends (HierarchicalTpuCommunicator) override all
+    # three; docs/HIERARCHY.md has the routing algebra.
+
+    @property
+    def n_slices(self) -> int:
+        """Slow-tier (DCN) groups in the mesh; 1 = no slow tier."""
+        return 1
+
+    @property
+    def chips_per_slice(self) -> int:
+        """Fast-tier (ICI) ranks per slice."""
+        return self.n_ranks
+
+    def all_to_all_chip(self, x: jax.Array) -> jax.Array:
+        """:meth:`all_to_all` restricted to THIS rank's slice (the
+        fast ICI tier): x has ``chips_per_slice`` leading blocks;
+        block j goes to the j-th chip of this slice. Flat backends:
+        the slice is the whole mesh."""
+        return self.all_to_all(x)
+
+    def all_to_all_slice(self, x: jax.Array) -> jax.Array:
+        """:meth:`all_to_all` across slices at a FIXED chip index
+        (the slow DCN tier): x has ``n_slices`` leading blocks; block
+        t goes to this chip's peer on slice t. Flat backends: one
+        slice, identity."""
         return x
 
     # Emulation of ragged_all_to_all for backends/platforms without the
@@ -288,6 +323,80 @@ class TpuCommunicator(Communicator):
         return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
 
 
+class HierarchicalTpuCommunicator(TpuCommunicator):
+    """XLA-collective backend over a 2-D ``(slice, chip)`` mesh — the
+    8->64-chip scale-out topology (ROADMAP item 5, docs/HIERARCHY.md).
+
+    The flat rank space is slice-major: rank ``r`` lives at
+    ``(r // chips_per_slice, r % chips_per_slice)``, so a row-sharded
+    table shards identically to the 1-D mesh over the same device
+    order. Global collectives run over BOTH axes (multi-axis
+    ``lax.all_to_all``/``all_gather`` concatenate slice-major — flat
+    rank order, so every existing call site is semantics-identical);
+    the hierarchical seams expose the per-tier collectives the
+    two-level shuffle routes through: :meth:`all_to_all_chip` inside
+    a slice over ICI, :meth:`all_to_all_slice` across slices over
+    DCN.
+    """
+
+    name = "tpu-hier"
+
+    def __init__(self, mesh: Mesh | None = None,
+                 n_slices: int | None = None,
+                 n_ranks: int | None = None):
+        if mesh is None:
+            mesh = make_hierarchical_mesh(n_slices or 1, n_ranks)
+        if len(mesh.axis_names) != 2:
+            raise ValueError(
+                "HierarchicalTpuCommunicator needs a 2-D (slice, "
+                f"chip) mesh, got axes {mesh.axis_names}")
+        self.mesh = mesh
+        self.slice_axis, self.chip_axis = mesh.axis_names
+        # Both axes, slice-major — every inherited collective
+        # (all_to_all/all_gather/psum/axis_index) runs globally over
+        # the tuple and sees the flat rank space.
+        self.axis_name = (self.slice_axis, self.chip_axis)
+
+    @property
+    def n_ranks(self) -> int:
+        return self.n_slices * self.chips_per_slice
+
+    @property
+    def n_slices(self) -> int:
+        return self.mesh.shape[self.slice_axis]
+
+    @property
+    def chips_per_slice(self) -> int:
+        return self.mesh.shape[self.chip_axis]
+
+    def all_to_all_chip(self, x: jax.Array) -> jax.Array:
+        return lax.all_to_all(
+            x, self.chip_axis, split_axis=0, concat_axis=0, tiled=True
+        )
+
+    def all_to_all_slice(self, x: jax.Array) -> jax.Array:
+        return lax.all_to_all(
+            x, self.slice_axis, split_axis=0, concat_axis=0,
+            tiled=True
+        )
+
+    def axis_index(self):
+        return (lax.axis_index(self.slice_axis)
+                * jnp.int32(self.chips_per_slice)
+                + lax.axis_index(self.chip_axis))
+
+    def pvary(self, x):
+        return compat.pvary(
+            compat.pvary(x, self.slice_axis), self.chip_axis)
+
+    def ppermute_all_to_all(self, x: jax.Array) -> jax.Array:
+        """The 1-D ppermute chain is a flat-mesh lowering; on the
+        hierarchical mesh the async-schedulable story is the
+        two-level shuffle itself, so this degrades to the grouped
+        global all_to_all (always semantically correct)."""
+        return self.all_to_all(x)
+
+
 class LocalCommunicator(Communicator):
     """Single-rank backend: collectives are identities. This is the
     reference's 1-rank path (BASELINE config 1)."""
@@ -311,17 +420,30 @@ class LocalCommunicator(Communicator):
         return jax.tree.map(jax.device_put, tree)
 
 
-def make_communicator(name: str, n_ranks: int | None = None) -> Communicator:
+def make_communicator(name: str, n_ranks: int | None = None,
+                      n_slices: int | None = None) -> Communicator:
     """Factory keyed by the reference driver's ``--communicator`` flag.
 
     The reference accepts {NCCL, UCX}; this framework adds ``tpu`` (the
     north-star flag) and ``local``. NCCL/UCX are recognized but rejected
     with an explanatory error — there is no NCCL/UCX on TPU hardware.
+
+    ``n_slices`` (the drivers' ``--slices K``) > 1 builds the 2-D
+    hierarchical mesh (docs/HIERARCHY.md); 1/None keeps the flat 1-D
+    mesh — deliberately NOT a one-row hierarchical mesh, so the
+    degenerate hierarchy lowers byte-identically to the seed programs.
     """
     lname = name.lower()
     if lname == "tpu":
+        if n_slices is not None and n_slices > 1:
+            return HierarchicalTpuCommunicator(n_slices=n_slices,
+                                               n_ranks=n_ranks)
         return TpuCommunicator(n_ranks=n_ranks)
     if lname == "local":
+        if n_slices is not None and n_slices > 1:
+            raise ValueError(
+                "the local (1-rank) communicator has no multi-slice "
+                "topology; --slices needs --communicator=tpu")
         return LocalCommunicator()
     if lname in ("nccl", "ucx"):
         raise ValueError(

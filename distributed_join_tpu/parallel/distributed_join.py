@@ -41,6 +41,7 @@ from distributed_join_tpu.ops.join import JoinResult, sort_merge_inner_join
 from distributed_join_tpu.ops.partition import radix_hash_partition
 from distributed_join_tpu.parallel.communicator import Communicator
 from distributed_join_tpu.parallel.shuffle import (
+    shuffle_hierarchical,
     shuffle_padded,
     shuffle_padded_compressed,
     shuffle_ragged,
@@ -52,6 +53,11 @@ DEFAULT_SHUFFLE_CAPACITY_FACTOR = 1.6
 DEFAULT_OUT_CAPACITY_FACTOR = 1.2
 DEFAULT_HH_SLOTS = 64
 HH_BUILD_SLOTS_PER_HH = 32  # default hh_build_capacity = slots * this
+SHUFFLE_MODES = ("padded", "ragged", "ppermute", "hierarchical")
+# Residual width the hierarchical DCN codec starts at when the caller
+# set dcn_codec on/auto but no compression_bits — the flat driver's
+# own --compression-bits default; the ladder widens it on overflow.
+DEFAULT_DCN_CODEC_BITS = 16
 
 # The one sharded_out spec for a JoinResult: table row-sharded, the
 # psummed total/overflow replicated.
@@ -83,7 +89,27 @@ def _varwidth_cols(table: Table) -> list:
 def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
                    mode: str = "padded",
                    compression_bits: Optional[int] = None,
-                   varwidth=None, tape=None, digest_tape=None):
+                   varwidth=None, tape=None, digest_tape=None,
+                   dcn_codec_on: bool = False):
+    if mode == "hierarchical":
+        padded, counts, overflow, _ = pt.to_padded(
+            capacity, bucket_start=batch * n_ranks, n_buckets=n_ranks
+        )
+        if comm.n_slices == 1:
+            # Degenerate hierarchy: one slice = one ICI domain = the
+            # flat padded path, byte-identically (no phase-2 identity
+            # hop, no codec — there is no cross-slice payload to
+            # compress). Lowering-locked in tests/test_hierarchy.py.
+            table, _ = shuffle_padded(comm, padded, counts, capacity,
+                                      tape=tape,
+                                      digest_tape=digest_tape)
+            return table, overflow
+        dcn_bits = ((compression_bits or DEFAULT_DCN_CODEC_BITS)
+                    if dcn_codec_on else None)
+        table, _, c_ovf = shuffle_hierarchical(
+            comm, padded, counts, capacity, dcn_bits=dcn_bits,
+            tape=tape, digest_tape=digest_tape)
+        return table, overflow | c_ovf
     if mode == "ragged":
         # Exact-size exchange: receive buffer = the same total rows the
         # padded layout would flatten to, but wire bytes = actual rows.
@@ -125,6 +151,7 @@ def make_join_step(
     hh_out_capacity: Optional[int] = None,
     shuffle: str = "padded",
     compression_bits: Optional[int] = None,
+    dcn_codec: str = "auto",
     kernel_config=None,
     with_metrics: bool = False,
     with_integrity: bool = False,
@@ -134,9 +161,24 @@ def make_join_step(
 
     ``shuffle``: "padded" (capacity-padded all_to_all, the default),
     "ragged" (exact-size ``lax.ragged_all_to_all`` — wire bytes equal
-    actual rows), or "ppermute" (padded blocks over a
-    collective-permute chain whose lowering the scheduler can overlap
-    with compute; docs/OVERLAP.md).
+    actual rows), "ppermute" (padded blocks over a collective-permute
+    chain whose lowering the scheduler can overlap with compute;
+    docs/OVERLAP.md), or "hierarchical" (the two-level ICI/DCN
+    shuffle over a multi-slice mesh: slice-local buckets ride one
+    intra-slice all-to-all, remote buckets cross slices — with the
+    FoR+bitpack codec on exactly that slow tier when ``dcn_codec``
+    resolves on; docs/HIERARCHY.md). On a one-slice communicator the
+    hierarchical mode lowers byte-identically to "padded" (the
+    degenerate hierarchy, lowering-locked).
+
+    ``dcn_codec`` ("off"/"auto"/"on", hierarchical mode only): the
+    cross-slice codec knob. "auto" (default) resolves statically
+    against the cost model — on exactly when the configured DCN
+    bandwidth sits below the codec's measured ~5-7 GB/s break-even
+    (planning.cost.resolve_dcn_codec). The residual width is
+    ``compression_bits`` (default 16 when unset); a cross-slice
+    residual overflow raises the overflow flag and the ladder widens
+    bits, exactly like the flat compressed shuffle.
 
     ``compression_bits``: when set, integer columns ride the padded/
     ppermute shuffle FoR+bitpacked at this width (the reference's
@@ -218,7 +260,7 @@ def make_join_step(
     k = over_decomposition
     if k < 1:
         raise ValueError("over_decomposition must be >= 1")
-    if shuffle not in ("padded", "ragged", "ppermute"):
+    if shuffle not in SHUFFLE_MODES:
         # Validate for EVERY config — the single-rank path never
         # reaches the shuffle, and a typo'd mode must not silently
         # report success.
@@ -229,6 +271,28 @@ def make_join_step(
             "ragged exchange already sends exact rows (combining the "
             "two is unimplemented)"
         )
+    from distributed_join_tpu.planning.cost import resolve_dcn_codec
+
+    if shuffle == "hierarchical":
+        if compression_bits is not None and dcn_codec == "off":
+            raise ValueError(
+                "dcn_codec='off' contradicts compression_bits="
+                f"{compression_bits}: the hierarchical mode's codec "
+                "rides ONLY the cross-slice tier (the flat-tier codec "
+                "is a measured NO-GO on ICI) — drop the bits or the "
+                "knob")
+        dcn_on = resolve_dcn_codec(dcn_codec)
+    else:
+        # The knob is hierarchical-only; still validate the VALUE so
+        # a typo'd config fails loudly everywhere.
+        resolve_dcn_codec(dcn_codec)
+        dcn_on = False
+        if n > 1 and getattr(comm, "n_slices", 1) > 1:
+            raise ValueError(
+                f"shuffle {shuffle!r} routes one GLOBAL collective "
+                "over a multi-slice mesh, dragging intra-slice "
+                "traffic across DCN — use shuffle='hierarchical' "
+                "(or a flat 1-D communicator)")
     nb = k * n
 
     keys = [key] if isinstance(key, str) else list(key)
@@ -401,11 +465,13 @@ def make_join_step(
                     recv_build, ovf_b = _batch_shuffle(
                         comm, ptb, b, n, b_cap, mode=shuffle,
                         compression_bits=compression_bits, varwidth=vb,
-                        tape=tb, digest_tape=dtb)
+                        tape=tb, digest_tape=dtb,
+                        dcn_codec_on=dcn_on)
                     recv_probe, ovf_p = _batch_shuffle(
                         comm, ptp, b, n, p_cap, mode=shuffle,
                         compression_bits=compression_bits, varwidth=vp,
-                        tape=tp, digest_tape=dtp)
+                        tape=tp, digest_tape=dtp,
+                        dcn_codec_on=dcn_on)
                 with telemetry.span("join", batch=b):
                     res = sort_merge_inner_join(
                         recv_build, recv_probe, keys_eff, out_cap,
@@ -478,10 +544,20 @@ def make_probe_join_step(
     compression_bits: Optional[int] = None,
     kernel_config=None,
     with_metrics: bool = False,
+    with_integrity: bool = False,
     metrics_static: Optional[dict] = None,
 ):
     """The PROBE-ONLY join step against a resident build image
     (service/resident.py; ROADMAP item 4).
+
+    ``with_integrity=True`` weaves the wire-integrity digests
+    (parallel/integrity.py) into the probe-side shuffle exactly as
+    the full join does — per-(src, dst) payload digests riding the
+    aux Metrics block (the step then returns ``(JoinResult,
+    Metrics)``), verified host-side with
+    ``integrity.verify_join_result``. The build side has no wire to
+    digest: its image moved at registration, where the conservation
+    check (service/resident.py) already guards it.
 
     ``step(resident_local, probe_local) -> JoinResult`` where
     ``resident_local`` is one rank's shard of a registered build table
@@ -516,11 +592,27 @@ def make_probe_join_step(
             "ragged exchange already sends exact rows (combining the "
             "two is unimplemented)"
         )
+    if n > 1 and getattr(comm, "n_slices", 1) > 1:
+        # The same guard make_join_step applies to its flat modes:
+        # the probe-only program routes one GLOBAL collective, which
+        # on a multi-slice mesh drags intra-slice traffic across DCN.
+        # Hierarchical probe-only serving is a named ROADMAP leftover
+        # — refuse loudly instead of silently mis-routing.
+        raise ValueError(
+            "probe-only joins route one GLOBAL collective over the "
+            "mesh; a multi-slice topology would drag intra-slice "
+            "traffic across DCN, and hierarchical probe-only serving "
+            "is not implemented yet — register resident tables on a "
+            "flat 1-D communicator")
     nb = k * n
     keys = [key] if isinstance(key, str) else list(key)
 
     def step(resident_local: Table, probe_local: Table):
-        tape = telemetry.MetricsTape() if with_metrics else None
+        # The integrity digests ride the same Metrics slot, so either
+        # switch materializes the tape (and the aux output) — the
+        # full join's contract, verbatim.
+        tape = telemetry.MetricsTape() if (with_metrics
+                                           or with_integrity) else None
         if tape is not None:
             for mname, mval in (metrics_static or {}).items():
                 tape.add(mname, int(mval))
@@ -567,6 +659,8 @@ def make_probe_join_step(
             with telemetry.span("partition"):
                 ptp = radix_hash_partition(probe_local, keys, nb)
             tp = tape.scoped("probe") if tape is not None else None
+            dtp = tape.scoped("probe.integrity") if with_integrity \
+                else None
             if tape is not None:
                 tp.add("rows_partitioned",
                        jnp.sum(ptp.counts.astype(jnp.int64)))
@@ -578,7 +672,8 @@ def make_probe_join_step(
                 with telemetry.span("shuffle", batch=b):
                     recv_probe, ovf_p = _batch_shuffle(
                         comm, ptp, b, n, p_cap, mode=shuffle,
-                        compression_bits=compression_bits, tape=tp)
+                        compression_bits=compression_bits, tape=tp,
+                        digest_tape=dtp)
                 with telemetry.span("join", batch=b):
                     res = sort_merge_inner_join(
                         resident_local, recv_probe, keys, out_cap,
@@ -645,7 +740,8 @@ def make_distributed_join(comm: Communicator, with_metrics=None,
     return fn
 
 
-def resolve_join_ladder(build, probe, n_ranks: int, opts: dict):
+def resolve_join_ladder(build, probe, n_ranks: int, opts: dict,
+                        n_slices: int = 1):
     """THE one resolution of ``distributed_inner_join``'s capacity
     contract: pop the sizing knobs from ``opts`` (mutated — what
     remains goes to ``make_join_step`` verbatim), resolve the skew
@@ -676,6 +772,21 @@ def resolve_join_ladder(build, probe, n_ranks: int, opts: dict):
             probe.capacity // (4 * n_ranks), 1024)
     out_rows = opts.pop("out_rows_per_rank", None)
     comp_bits = opts.pop("compression_bits", None)
+    if opts.get("shuffle") == "hierarchical" and comp_bits is None:
+        # The hierarchical DCN codec defaults its residual width when
+        # the caller set only the knob; resolving it HERE (not just
+        # inside the step) hands the bits to the ladder, so a
+        # cross-slice residual overflow escalates by widening bits —
+        # the cheap axis — instead of uselessly doubling capacities.
+        # Topology-gated: one slice has no cross-slice payload (the
+        # degenerate path routes flat raw padded), so arming bits
+        # there would burn the first rung widening a no-op knob.
+        from distributed_join_tpu.planning.cost import (
+            resolve_dcn_bits,
+        )
+
+        comp_bits = resolve_dcn_bits(
+            opts.get("dcn_codec", "auto"), None, n_slices=n_slices)
     # The escalation policy — compression bits widen first (the cheap
     # axis), then every capacity doubles with the skew capacities
     # jumping straight to full local probe coverage — lives in
@@ -794,7 +905,8 @@ def distributed_inner_join(
     if hasattr(comm, "device_put_sharded"):
         build, probe = comm.device_put_sharded((build, probe))
 
-    ladder = resolve_join_ladder(build, probe, n, opts)
+    ladder = resolve_join_ladder(build, probe, n, opts,
+                                 n_slices=getattr(comm, "n_slices", 1))
     if tuned is not None:
         ladder.seed_rung(tuned.rung)
     last_sig = None

@@ -179,6 +179,39 @@ class FaultInjectingCommunicator(Communicator):
         return self._corrupt_exchanged(
             self._inner.ppermute_all_to_all(x))
 
+    # -- hierarchical tier seams (two-level shuffle) ------------------
+    # Explicit wrappers, not __getattr__ delegation: delegation would
+    # hand back the INNER communicator's bound methods and the
+    # corruption seams would silently never see hierarchical traffic.
+
+    @property
+    def n_slices(self) -> int:
+        return self._inner.n_slices
+
+    @property
+    def chips_per_slice(self) -> int:
+        return self._inner.chips_per_slice
+
+    def all_to_all_chip(self, x):
+        """The intra-slice (ICI) hop — delivered CLEAN by design: the
+        chaos model targets the new cross-slice transport (the DCN
+        tier is the long, lossy haul the wire-integrity digests exist
+        for); ICI corruption is already exercised through the flat
+        all_to_all seam every non-hierarchical config routes."""
+        return self._inner.all_to_all_chip(x)
+
+    def all_to_all_slice(self, x):
+        """The cross-slice (DCN) exchange seam: the same corruption
+        modes as the flat data plane (bit_flip / misroute roll /
+        count slip on a 1-D int32 vector), injected on what a
+        corrupting DCN transport would deliver. The received block's
+        leading axis is the source-slice axis, so a misroute roll
+        mis-attributes whole slices — rows that hash elsewhere enter
+        the local join, exactly the adversary the end-to-end pair
+        digests catch."""
+        return self._corrupt_exchanged(
+            self._inner.all_to_all_slice(x))
+
     def axis_index(self):
         return self._inner.axis_index()
 
@@ -234,15 +267,21 @@ class FaultInjectingCommunicator(Communicator):
         if mode is None:
             return y
         n = self.n_ranks
-        if (mode in ("row_truncate", "row_duplicate") and y.ndim == 1
-                and y.dtype == jnp.int32 and y.shape[0] == n
+        # The count exchange: the padded shuffle's (n,) vector, or the
+        # hierarchical route's (slices, chips)-nested view of the same
+        # n counts (shuffle._hier_route — an int32 block of exactly n
+        # entries on the tier seams).
+        is_counts = (y.dtype == jnp.int32 and y.size == n
+                     and y.ndim in (1, 2))
+        if (mode in ("row_truncate", "row_duplicate") and is_counts
                 and self._corrupt_budget()):
             j = (self.plan.seed // n) % n
             delta = jnp.int32(-1 if mode == "row_truncate" else 1)
             active = (self.axis_index()
                       == jnp.int32(self._corrupt_rank()))
-            y = y.at[j].add(delta * active.astype(jnp.int32))
-            return jnp.maximum(y, 0)
+            flat = y.reshape(-1)
+            flat = flat.at[j].add(delta * active.astype(jnp.int32))
+            return jnp.maximum(flat, 0).reshape(y.shape)
         if mode == "bit_flip" and y.ndim >= 2 \
                 and self._corrupt_budget():
             active = (self.axis_index()

@@ -5,6 +5,16 @@ SURVEY.md §3.1). On TPU the analogous object is a 1-D
 ``jax.sharding.Mesh`` over the chips: the mesh axis *is* the rank space,
 and rows sharded along it are "owned" by a rank exactly as the
 reference's per-rank table shards are.
+
+Multi-slice scale-out (8 -> 64 chips, ROADMAP item 5) adds the 2-D
+``(slice, chip)`` variant: :func:`make_hierarchical_mesh` groups the
+devices by their real slice/process structure when the backend exposes
+one (``slice_index`` on multi-slice TPU, ``process_index`` on
+multi-host), so the fast mesh axis spans ICI and the slow axis spans
+DCN — the topology the hierarchical shuffle
+(:func:`..shuffle.shuffle_hierarchical`) routes over. CPU-mesh tests
+fake the hierarchy with nested axes: the same 8 virtual devices
+reshape to 2x4 and the routing algebra is identical.
 """
 
 from __future__ import annotations
@@ -16,6 +26,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 RANK_AXIS = "ranks"
+SLICE_AXIS = "slices"
 
 
 def make_mesh(
@@ -30,3 +41,68 @@ def make_mesh(
             raise ValueError(f"asked for {n_ranks} ranks, have {len(devs)} devices")
         devs = devs[:n_ranks]
     return Mesh(np.array(devs), (axis_name,))
+
+
+def device_slice_id(dev) -> int:
+    """The slow-tier group a device belongs to: the TPU runtime's
+    ``slice_index`` where it exists (multi-slice ICI domains), else
+    the owning ``process_index`` (multi-host DCN domains), else 0
+    (single-host CPU/TPU — no real slow tier; tests fake one with
+    nested axes)."""
+    v = getattr(dev, "slice_index", None)
+    if v is not None:
+        return int(v)
+    return int(getattr(dev, "process_index", 0) or 0)
+
+
+def make_hierarchical_mesh(
+    n_slices: int,
+    n_ranks: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    slice_axis: str = SLICE_AXIS,
+    chip_axis: str = RANK_AXIS,
+) -> Mesh:
+    """2-D ``(slice, chip)`` mesh over the first ``n_ranks`` devices.
+
+    Row ``t`` of the mesh is one slice's chips: the flat rank of
+    device ``(t, j)`` is ``t * chips_per_slice + j`` (slice-major),
+    which is exactly the rank order the 1-D :func:`make_mesh` would
+    assign when the device list arrives slice-grouped — so a table
+    row-sharded over the 2-D mesh shards identically to the flat one.
+
+    Device grouping: when the devices expose a REAL slice/process
+    structure (``device_slice_id``) with exactly ``n_slices`` equal
+    groups, devices are ordered slice-major by it, so the chip axis
+    spans ICI and the slice axis spans DCN. Otherwise (the CPU fake
+    backend, or a deliberate re-split) the given device order is
+    nested as-is — e.g. 8 virtual devices as 2x4 — which keeps the
+    routing algebra testable without hardware.
+
+    A slice count that does not divide the rank count is a loud
+    structured refusal (a lopsided hierarchy would silently route
+    rows to ranks that do not exist).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_ranks is not None:
+        if n_ranks > len(devs):
+            raise ValueError(
+                f"asked for {n_ranks} ranks, have {len(devs)} devices")
+        devs = devs[:n_ranks]
+    n = len(devs)
+    if n_slices < 1:
+        raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+    if n % n_slices:
+        raise ValueError(
+            f"n_slices={n_slices} does not divide the rank count {n}; "
+            "a hierarchical mesh needs equal-size slices — pick a "
+            "divisor (or drop --slices for the flat 1-D mesh)")
+    chips = n // n_slices
+    groups: dict = {}
+    for d in devs:
+        groups.setdefault(device_slice_id(d), []).append(d)
+    if (len(groups) == n_slices
+            and all(len(g) == chips for g in groups.values())):
+        # Real topology: slice-major device order, ICI inside a row.
+        devs = [d for sid in sorted(groups) for d in groups[sid]]
+    return Mesh(np.array(devs).reshape(n_slices, chips),
+                (slice_axis, chip_axis))

@@ -33,7 +33,7 @@ bit-identical emulation — see ``Communicator.ragged_all_to_all``.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -126,7 +126,6 @@ def shuffle_padded_compressed(
         for_bitpack_decode,
         for_bitpack_encode,
     )
-    from distributed_join_tpu.utils.strings import _WORD_PREFIX
 
     a2a = (
         comm.ppermute_all_to_all if via == "ppermute" else comm.all_to_all
@@ -144,16 +143,7 @@ def shuffle_padded_compressed(
     raw_bytes = 0
     sent_bytes = 0
     for name, col in padded_columns.items():
-        compressible = (
-            col.ndim == 2
-            and jnp.issubdtype(col.dtype, jnp.integer)
-            and col.dtype.itemsize >= 4
-            # The packed string-key word columns are big-endian byte
-            # packs: per-block spans ~2^40+, wider than any packable
-            # width — they would overflow at every bits, so they ride
-            # raw by construction.
-            and not name.startswith(_WORD_PREFIX)
-        )
+        compressible = _codec_eligible(name, col)
         raw_bytes += col.size * col.dtype.itemsize
         if not compressible:
             # uint8 string payload planes etc. ride raw.
@@ -208,6 +198,178 @@ def shuffle_padded_compressed(
         tape.add("wire_bytes", sent_bytes)
         tape.add("wire_bytes_saved", raw_bytes - sent_bytes)
     return unpad(recv_cols, recv_counts, capacity), recv_counts, c_ovf
+
+
+def _hier_route(comm: Communicator, x: jax.Array) -> jax.Array:
+    """Two-level routing of an ``(n_ranks, ...)`` destination-major
+    block: intra-slice all-to-all over ICI, then cross-slice exchange
+    over DCN. Returns the ``(n_ranks, ...)`` block received, leading
+    axis in SENDER-rank order — the same contract as one global
+    ``all_to_all`` of the block, in two tier-local hops.
+
+    Algebra (s slices x c chips, rank r = (r//c, r%c), docs/
+    HIERARCHY.md): phase 1 regroups the n = s*c destination blocks by
+    destination CHIP — block j of the intra-slice exchange carries
+    ``[dest (t, j) for every slice t]`` — so after the ICI hop, chip j
+    holds (from each of its c slice-mates) everything destined to chip
+    j of ANY slice, indexed ``(src_chip, dest_slice)``. Phase 2
+    transposes to destination-slice-major and exchanges over the slice
+    axis; the received ``(src_slice, src_chip)`` nesting IS flat
+    sender-rank order (slice-major), so one reshape finishes."""
+    s, c = comm.n_slices, comm.chips_per_slice
+    z = comm.all_to_all_slice(_hier_phase1(comm, x))
+    return z.reshape((s * c,) + x.shape[1:])
+
+
+def _hier_phase1(comm: Communicator, x: jax.Array) -> jax.Array:
+    """Phase 1 of :func:`_hier_route` alone: the intra-slice ICI hop,
+    returning the ``(dest_slice, src_chip, ...)`` block phase 2
+    exchanges — split out so the DCN codec can encode exactly the
+    cross-slice payload and nothing else."""
+    s, c = comm.n_slices, comm.chips_per_slice
+    tail = x.shape[1:]
+    y = x.reshape((s, c) + tail).swapaxes(0, 1)
+    y = comm.all_to_all_chip(y)
+    return y.swapaxes(0, 1)
+
+
+def shuffle_hierarchical(
+    comm: Communicator, padded_columns, counts: jax.Array,
+    capacity: int, dcn_bits: Optional[int] = None, block: int = 256,
+    tape=None, digest_tape=None,
+) -> Tuple[Table, jax.Array, jax.Array]:
+    """Two-level shuffle of a pre-padded ``(n_ranks, capacity)`` block
+    over a hierarchical ``(slice, chip)`` mesh: slice-local buckets
+    ride one intra-slice all-to-all over ICI (fast, always raw), the
+    remote buckets then cross slices over DCN — with the FoR+bitpack
+    codec from :func:`shuffle_padded_compressed` applied ONLY to that
+    cross-slice payload when ``dcn_bits`` is set (the codec's measured
+    ~5-7 GB/s break-even sits ABOVE DCN and BELOW ICI, so compression
+    flips from NO-GO to win exactly at the tier that needs it —
+    docs/ROOFLINE.md, docs/HIERARCHY.md).
+
+    Returns ``(received table, received counts, codec_overflow)`` —
+    the received block is bit-identical to :func:`shuffle_padded` of
+    the same input (sender-rank order), and ``codec_overflow`` fires
+    when a cross-slice residual exceeds ``dcn_bits`` (the caller's
+    ladder widens bits, exactly like the compressed flat shuffle;
+    always False with the codec off).
+
+    ``tape`` gains the per-tier wire accounting next to the usual
+    totals: ``wire_bytes_ici`` (phase 1 — the full static block, pad
+    included, exactly what rides ICI), ``wire_bytes_dcn`` (phase 2 —
+    codec planes when on, the full block otherwise) and
+    ``wire_bytes_saved`` (the codec's saving vs shipping phase 2
+    raw); ``wire_bytes`` stays the two-tier sum so every existing
+    efficiency indicator keeps reading. ``digest_tape`` records the
+    same per-(src, dst) pair digests as the flat padded shuffle —
+    end-to-end over both hops, so a corrupted EITHER tier (including
+    a misrouted cross-slice exchange) fails verification.
+    """
+    s, c = comm.n_slices, comm.chips_per_slice
+    n = s * c
+    if counts.shape[0] != n:
+        raise ValueError(
+            f"hierarchical shuffle needs {n} destination buckets, "
+            f"got {counts.shape[0]}")
+    recv_counts = _hier_route(comm, counts)
+    lane = jnp.arange(capacity, dtype=jnp.int32)
+    row_valid = lane[None, :] < counts[:, None]
+    c_ovf = jnp.bool_(False)
+    recv_cols = {}
+    ici_bytes = 0
+    dcn_raw = 0
+    dcn_sent = 0
+    for name, col in padded_columns.items():
+        col_bytes = col.size * col.dtype.itemsize
+        ici_bytes += col_bytes
+        dcn_raw += col_bytes
+        compressible = dcn_bits is not None and _codec_eligible(name,
+                                                                col)
+        if not compressible:
+            dcn_sent += col_bytes
+            recv_cols[name] = _hier_route(comm, col)
+            continue
+        # Same pad-fill trick as shuffle_padded_compressed: padding
+        # slots hold clipped-gather garbage whose span would blow the
+        # residual width; fill each bucket's pad with its last valid
+        # row BEFORE routing (phase 1 moves rows verbatim, so the
+        # fill arrives intact at the codec seam).
+        fill = col[jnp.arange(n), jnp.maximum(counts - 1, 0)]
+        col = jnp.where(row_valid, col, fill[:, None])
+        staged = _hier_phase1(comm, col)      # (s, c, capacity)
+        from distributed_join_tpu.ops.compression import (
+            Packed,
+            for_bitpack_decode,
+            for_bitpack_encode,
+        )
+
+        # One frame stream PER DESTINATION SLICE (rows flattened
+        # chip-major), so the slice exchange never splits a codec
+        # block across destinations — the flat compressed shuffle's
+        # per-destination discipline, one tier up.
+        flat = staged.reshape(s, c * capacity)
+
+        def _enc(row):
+            p = for_bitpack_encode(row, dcn_bits, block)
+            return p.words, p.frames, p.overflow
+
+        words, frames, ovf = jax.vmap(_enc)(flat)
+        c_ovf = c_ovf | jnp.any(ovf)
+        dcn_sent += (words.size * words.dtype.itemsize
+                     + frames.size * frames.dtype.itemsize)
+        rwords = comm.all_to_all_slice(words)
+        rframes = comm.all_to_all_slice(frames)
+
+        def _dec(w, f, dt=col.dtype):
+            return for_bitpack_decode(
+                Packed(w, f, None, None, n=c * capacity,
+                       bits=dcn_bits, block=block),
+                dtype=dt,
+            )
+
+        decoded = jax.vmap(_dec)(rwords, rframes)
+        recv_cols[name] = decoded.reshape(n, capacity)
+    if digest_tape is not None:
+        # End-to-end pair digests across both hops (sender commitment
+        # on the pre-routing block, receiver belief on the assembled
+        # sender-order block) — the same verify_digests contract as
+        # the flat shuffles, so a corruption on EITHER tier mismatches.
+        from distributed_join_tpu.parallel import integrity
+
+        integrity.record_pair_digests(
+            digest_tape,
+            integrity.padded_block_digests(padded_columns, counts),
+            integrity.padded_block_digests(recv_cols, recv_counts),
+        )
+    if tape is not None:
+        tape.add("rows_shuffled", jnp.sum(counts.astype(jnp.int64)))
+        tape.add("rows_received",
+                 jnp.sum(recv_counts.astype(jnp.int64)))
+        tape.add("wire_bytes", ici_bytes + dcn_sent)
+        tape.add("wire_bytes_ici", ici_bytes)
+        tape.add("wire_bytes_dcn", dcn_sent)
+        if dcn_bits is not None:
+            tape.add("wire_bytes_saved", dcn_raw - dcn_sent)
+    return (unpad(recv_cols, recv_counts, capacity), recv_counts,
+            c_ovf)
+
+
+def _codec_eligible(name: str, col) -> bool:
+    """The FoR+bitpack wire's column eligibility — one rule shared by
+    the flat compressed shuffle and the hierarchical DCN tier: 2-D
+    integer columns of >= 4-byte lanes, excluding the packed
+    string-key word columns (big-endian byte packs whose per-block
+    spans exceed any packable width — they would overflow at every
+    bits, so they ride raw by construction)."""
+    from distributed_join_tpu.utils.strings import _WORD_PREFIX
+
+    return (
+        col.ndim == 2
+        and jnp.issubdtype(col.dtype, jnp.integer)
+        and col.dtype.itemsize >= 4
+        and not name.startswith(_WORD_PREFIX)
+    )
 
 
 def ragged_plan(comm: Communicator, counts: jax.Array, out_capacity: int,

@@ -79,6 +79,16 @@ class CostModel:
     # here (one-chip environment; BASELINE.md row 17) — recalibrate
     # from the first real `tpu-all-to-all` session (ROADMAP item 1).
     ici_bytes_per_s: float = 4.5e10
+    # SPEC-DERIVED: per-chip cross-slice (DCN) egress of a multi-slice
+    # v5e pod — ~25 GB/s NIC per 8-chip host, ~3 GB/s per chip. Never
+    # measured here (no multi-slice allocation yet); flagged
+    # uncalibrated until the first multi-slice relay session refits it
+    # through the same calibrate_from_stage_profile seam as ICI
+    # (scripts/relay_session_r6.py, ROADMAP item 5). Sits BELOW the
+    # codec's ~5-7 GB/s break-even — the whole reason the FoR+bitpack
+    # wire flips from NO-GO to win on the cross-slice tier
+    # (docs/HIERARCHY.md).
+    dcn_bytes_per_s: float = 3.0e9
     # per-collective dispatch/sync overhead (spec-derived order).
     collective_latency_s: float = 2.0e-5
     # v5e HBM per chip, for the footprint verdict (16 GiB).
@@ -104,8 +114,8 @@ class CostModel:
                 "codec_bytes_per_s",
             ],
             "spec_derived": [
-                "ici_bytes_per_s", "collective_latency_s",
-                "hbm_capacity_bytes",
+                "ici_bytes_per_s", "dcn_bytes_per_s",
+                "collective_latency_s", "hbm_capacity_bytes",
             ],
             "source": "docs/ROOFLINE.md §1/§6; BASELINE.md"
                       + ("" if self.calibrated_scale is None else
@@ -126,6 +136,58 @@ class CostModel:
 
 
 DEFAULT_COST_MODEL = CostModel()
+
+# The FoR+bitpack codec's measured break-even wire bandwidth
+# (results/compression_for_bitpack.json, docs/ROOFLINE.md: ~5-7 GB/s;
+# the conservative upper edge): below it, encode+decode time is
+# cheaper than the bytes it removes. ICI (~45 GB/s) sits far above —
+# codec NO-GO; DCN (~3 GB/s) sits below — codec win. This one number
+# is what the hierarchical shuffle's `dcn_codec="auto"` resolves
+# against.
+CODEC_BREAK_EVEN_BYTES_PER_S = 7.0e9
+
+DCN_CODEC_KNOBS = ("off", "auto", "on")
+
+
+def resolve_dcn_codec(knob: str,
+                      model: Optional[CostModel] = None) -> bool:
+    """Resolve the hierarchical shuffle's ``dcn_codec`` knob to a
+    static on/off: ``"auto"`` turns the codec on exactly when the
+    configured cross-slice bandwidth sits below the codec's measured
+    break-even. Deterministic host arithmetic — the verdict is baked
+    into the compiled program (and its signature carries the knob, so
+    a changed model constant cannot silently alias two programs)."""
+    if knob not in DCN_CODEC_KNOBS:
+        raise ValueError(
+            f"unknown dcn_codec {knob!r}; pick one of "
+            f"{DCN_CODEC_KNOBS}")
+    if knob == "auto":
+        m = model or DEFAULT_COST_MODEL
+        return m.dcn_bytes_per_s < CODEC_BREAK_EVEN_BYTES_PER_S
+    return knob == "on"
+
+
+def resolve_dcn_bits(knob: str, compression_bits: Optional[int] = None,
+                     *, n_slices: int,
+                     model: Optional[CostModel] = None) -> Optional[int]:
+    """THE one resolution of the hierarchical shuffle's cross-slice
+    residual width, shared by the capacity ladder, the drivers, and
+    the stage profiler: the caller's bits (defaulting to
+    ``DEFAULT_DCN_CODEC_BITS``) exactly when the codec resolves on
+    AND the mesh has a cross-slice tier to ride. One slice has no DCN
+    payload — the degenerate hierarchy routes the flat raw padded
+    path — so bits resolve to ``None`` there (armed bits would burn
+    the first retry rung widening a knob the program ignores). The
+    knob VALUE is validated regardless, so a typo fails loudly on
+    every topology."""
+    on = resolve_dcn_codec(knob, model)
+    if n_slices <= 1 or not on:
+        return None
+    from distributed_join_tpu.parallel.distributed_join import (
+        DEFAULT_DCN_CODEC_BITS,
+    )
+
+    return compression_bits or DEFAULT_DCN_CODEC_BITS
 
 
 def _round_s(x: float) -> float:
@@ -185,8 +247,37 @@ def predict(plan, model: Optional[CostModel] = None) -> dict:
         )
 
     # -- shuffle: off-chip bytes at ICI bandwidth + dispatch latency.
+    shuffle_tiers = None
     if single:
         shuffle_s = 0.0
+    elif (plan.shuffle == "hierarchical"
+          and getattr(plan, "n_slices", 1) > 1):
+        # Two sequential tiers (phase 2 consumes phase 1's output, so
+        # the honest price is the SUM; both appear separately in the
+        # record so `analyze stages`/the tuner can see which tier
+        # dominates). Off-chip fractions: (c-1)/c of the ICI block
+        # leaves the chip within a slice, (s-1)/s of the DCN block
+        # crosses slices.
+        s_ = getattr(plan, "n_slices", 1)
+        c_ = max(n // s_, 1)
+        ici_rank = sum(plan.wire[side].get("ici_bytes_per_rank", 0)
+                       for side in ("build", "probe"))
+        dcn_rank = sum(plan.wire[side].get("dcn_bytes_per_rank", 0)
+                       for side in ("build", "probe"))
+        ici_s = (ici_rank * (c_ - 1) / c_) / m.ici_bytes_per_s
+        dcn_s = (dcn_rank * (s_ - 1) / s_) / m.dcn_bytes_per_s
+        codec_s = 0.0
+        raw = sum(plan.wire[side].get("dcn_raw_bytes_per_rank", 0)
+                  for side in ("build", "probe"))
+        if raw:
+            # encode + decode of the raw cross-slice block bytes.
+            codec_s = 2.0 * raw / m.codec_bytes_per_s
+        shuffle_s = (ici_s + dcn_s + codec_s
+                     + plan.wire["collectives_per_step"]
+                     * m.collective_latency_s)
+        shuffle_tiers = {"ici_s": _round_s(ici_s),
+                         "dcn_s": _round_s(dcn_s),
+                         "codec_s": _round_s(codec_s)}
     else:
         wire_rank = (plan.wire["build"]["bytes_per_rank"]
                      + plan.wire["probe"]["bytes_per_rank"])
@@ -240,7 +331,7 @@ def predict(plan, model: Optional[CostModel] = None) -> dict:
 
     total = partition_s + shuffle_s + join_s + skew_s
     rows = plan.build.rows_global + plan.probe.rows_global
-    return {
+    out = {
         "model": m.as_record(),
         "platform": "tpu-v5e-roofline",
         "stages": {
@@ -254,6 +345,13 @@ def predict(plan, model: Optional[CostModel] = None) -> dict:
         "predicted_m_rows_per_sec_per_rank": (
             _round_s(rows / total / 1e6 / n) if total else None),
     }
+    if shuffle_tiers is not None:
+        # Sibling of "stages" (never inside it — the stage set is 1:1
+        # with STAGE_CONSTANTS/stageprof.STAGE_KEYS by contract): the
+        # per-tier split of the shuffle price, so `analyze explain`
+        # and the tuner can see which tier dominates.
+        out["shuffle_tiers"] = shuffle_tiers
+    return out
 
 
 def _col_groups(n_cols: int) -> float:
@@ -272,7 +370,7 @@ _TIME_CONSTANTS = (
     "collective_latency_s",
 )
 _BANDWIDTH_CONSTANTS = ("hbm_bytes_per_s", "codec_bytes_per_s",
-                        "ici_bytes_per_s")
+                        "ici_bytes_per_s", "dcn_bytes_per_s")
 
 
 def calibrate_from_history(entries, model: Optional[CostModel] = None,
@@ -357,7 +455,8 @@ STAGE_CONSTANTS = {
     },
     "shuffle": {
         "time": ("collective_latency_s",),
-        "bandwidth": ("ici_bytes_per_s", "codec_bytes_per_s"),
+        "bandwidth": ("ici_bytes_per_s", "dcn_bytes_per_s",
+                      "codec_bytes_per_s"),
     },
     "join": {
         "time": ("sort_lane_ns_per_elem", "scan_ns_per_elem",
@@ -398,6 +497,7 @@ def calibrate_from_stage_profile(profiles,
     if isinstance(profiles, dict):
         profiles = [profiles]
     ratios: dict = {}
+    dcn_ratios: list = []
     eligible = 0
     for p in profiles or []:
         if not isinstance(p, dict) or p.get("kind") != "stageprofile":
@@ -414,8 +514,22 @@ def calibrate_from_stage_profile(profiles,
                 continue
             pred, wall = info.get("predicted_s"), info.get("wall_s")
             if pred and wall:
-                ratios.setdefault(stage, []).append(
-                    float(wall) / float(pred))
+                r = float(wall) / float(pred)
+                # Per-tier attribution cuts BOTH ways: a flat
+                # profile's shuffle ratio carries zero DCN evidence
+                # (scaling the spec constant from it could silently
+                # cross the codec break-even), and a DCN-carrying
+                # profile's shuffle wall is dominated by the slow
+                # tier — folding its ratio into ici/codec would
+                # corrupt the fast-tier constants with DCN error.
+                # Each shuffle ratio refits exactly one tier.
+                if stage == "shuffle" and any(
+                        (info.get("counters") or {}).get(
+                            f"{s}.wire_bytes_dcn")
+                        for s in ("build", "probe")):
+                    dcn_ratios.append(r)
+                else:
+                    ratios.setdefault(stage, []).append(r)
                 counted = True
         if counted:
             eligible += 1
@@ -441,9 +555,24 @@ def calibrate_from_stage_profile(profiles,
         owned = STAGE_CONSTANTS[stage]
         for k in owned["time"]:
             fields[k] = getattr(base, k) * scale
-        for k in owned["bandwidth"]:
+        fit_bw = list(owned["bandwidth"])
+        if stage == "shuffle":
+            # dcn_bytes_per_s refits ONLY from profiles whose shuffle
+            # stage actually moved cross-slice bytes — a flat
+            # profile's ratio carries zero DCN evidence, and scaling
+            # the uncalibrated spec constant from it could silently
+            # cross the codec break-even. Hierarchical profiles get
+            # their own median below.
+            fit_bw.remove("dcn_bytes_per_s")
+        for k in fit_bw:
             fields[k] = getattr(base, k) / scale
-        refit[stage] = list(owned["time"]) + list(owned["bandwidth"])
+        refit[stage] = list(owned["time"]) + fit_bw
+    dcn_scale = None
+    if dcn_ratios:
+        dcn_ratios.sort()
+        dcn_scale = round(dcn_ratios[len(dcn_ratios) // 2], 6)
+        fields["dcn_bytes_per_s"] = base.dcn_bytes_per_s / dcn_scale
+        refit.setdefault("shuffle", []).append("dcn_bytes_per_s")
     calibrated = dataclasses.replace(
         base,
         calibrated_stage_scales=tuple(sorted(scales.items())),
@@ -451,6 +580,7 @@ def calibrate_from_stage_profile(profiles,
     report.update(
         calibrated=True,
         stage_scales=scales,
+        dcn_scale=dcn_scale,
         refit=refit,
         # the stage the shipped model mispredicts hardest (log scale:
         # x4 optimistic and x0.25 pessimistic are equally wrong)

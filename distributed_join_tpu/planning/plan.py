@@ -124,6 +124,11 @@ class JoinPlan:
     # wire bytes, no build partition work — and `pipeline` says so.
     pipeline: str = "join"
     probe_only: bool = False
+    # Slow-tier topology (hierarchical shuffle, docs/HIERARCHY.md):
+    # slices of the communicator's mesh; 1 = flat. Mirrored from the
+    # signature so plan digest == cache key holds for hierarchical
+    # programs too.
+    n_slices: int = 1
 
     @property
     def n_buckets(self) -> int:
@@ -135,6 +140,7 @@ class JoinPlan:
             "probe_only": self.probe_only,
             "signature_digest": self.digest,
             "n_ranks": self.n_ranks,
+            "n_slices": self.n_slices,
             "over_decomposition": self.over_decomposition,
             "n_buckets": self.n_buckets,
             "key": list(self.key),
@@ -280,8 +286,6 @@ def _padded_side_bytes(n: int, k: int, cap: int, columns,
     bills (``shuffle_padded``/``shuffle_padded_compressed``): the full
     static (n, cap) block per column, pad included. Returns
     (sent_bytes_per_rank, raw_bytes_per_rank)."""
-    from distributed_join_tpu.utils.strings import _WORD_PREFIX
-
     raw = sent = 0
     for name, dtype, trailing in columns:
         isz = _itemsize(dtype)
@@ -290,12 +294,7 @@ def _padded_side_bytes(n: int, k: int, cap: int, columns,
         if compression_bits is None:
             sent += col_bytes
             continue
-        compressible = (
-            not trailing
-            and dtype in ("int32", "uint32", "int64", "uint64")
-            and not name.startswith(_WORD_PREFIX)
-        )
-        if not compressible:
+        if not _codec_eligible_col(name, dtype, trailing):
             sent += col_bytes
             continue
         # for_bitpack_encode on each destination's cap-length row:
@@ -308,20 +307,76 @@ def _padded_side_bytes(n: int, k: int, cap: int, columns,
     return k * sent, k * raw
 
 
+def _hier_side_bytes(n: int, n_slices: int, k: int, cap: int, columns,
+                     dcn_bits: Optional[int]):
+    """Per-rank per-tier wire bytes for one side of the hierarchical
+    shuffle across all k batches — EXACTLY what
+    ``shuffle_hierarchical``'s tape bills: phase 1 (ICI) moves the
+    full static ``n x cap`` block per column; phase 2 (DCN) moves the
+    same block raw, or the codec's word+frame planes (one frame
+    stream per destination SLICE, rows flattened chip-major — so the
+    packing unit is ``chips_per_slice * cap`` rows padded to the
+    codec block). Returns ``(ici, dcn_sent, dcn_raw)`` per rank."""
+    chips = n // n_slices
+    ici = dcn_raw = dcn_sent = 0
+    for name, dtype, trailing in columns:
+        isz = _itemsize(dtype)
+        col_bytes = n * cap * isz * math.prod(trailing or (1,))
+        ici += col_bytes
+        dcn_raw += col_bytes
+        compressible = (dcn_bits is not None
+                        and _codec_eligible_col(name, dtype, trailing))
+        if not compressible:
+            dcn_sent += col_bytes
+            continue
+        n_pad = _round_up(max(chips * cap, 1), _COMPRESSION_BLOCK)
+        per_slice = (n_pad * dcn_bits // 8
+                     + (n_pad // _COMPRESSION_BLOCK) * 8)
+        dcn_sent += n_slices * per_slice
+    return k * ici, k * dcn_sent, k * dcn_raw
+
+
 def _predict_wire(n: int, k: int, shuffle: str,
                   compression_bits: Optional[int],
                   build: SidePlan, probe: SidePlan,
-                  b_cap: int, p_cap: int) -> dict:
+                  b_cap: int, p_cap: int, n_slices: int = 1,
+                  dcn_codec_on: bool = False) -> dict:
     single = n * k == 1
     if single:
         zero = {"bytes_per_rank": 0, "bytes_total": 0,
                 "rows_estimate": 0}
         return {"exact": True, "build": dict(zero),
                 "probe": dict(zero), "collectives_per_step": 0}
+    hier = shuffle == "hierarchical" and n_slices > 1
+    if shuffle == "hierarchical" and not hier:
+        # Degenerate one-slice hierarchy: the runtime routes the flat
+        # RAW padded path (_batch_shuffle ignores any armed/explicit
+        # bits — there is no cross-slice payload to compress), so the
+        # exact-contract wire prediction must bill raw padded too.
+        compression_bits = None
     sides = {}
-    exact = shuffle in ("padded", "ppermute")
+    exact = shuffle in ("padded", "ppermute", "hierarchical")
     for side, cap in (("build", b_cap), ("probe", p_cap)):
         sp = build if side == "build" else probe
+        if hier:
+            from distributed_join_tpu.parallel.distributed_join import (
+                DEFAULT_DCN_CODEC_BITS,
+            )
+
+            dcn_bits = ((compression_bits or DEFAULT_DCN_CODEC_BITS)
+                        if dcn_codec_on else None)
+            ici, dcn, dcn_raw = _hier_side_bytes(
+                n, n_slices, k, cap, sp.columns, dcn_bits)
+            sides[side] = {
+                "bytes_per_rank": int(ici + dcn),
+                "bytes_total": int(ici + dcn) * n,
+                "rows_estimate": sp.rows_local * n,
+                "ici_bytes_per_rank": int(ici),
+                "dcn_bytes_per_rank": int(dcn),
+            }
+            if dcn_bits is not None:
+                sides[side]["dcn_raw_bytes_per_rank"] = int(dcn_raw)
+            continue
         if shuffle == "ragged":
             # Exact-size exchange: fixed-width bytes for actual rows
             # (assume every row valid — an upper bound on a masked
@@ -343,13 +398,36 @@ def _predict_wire(n: int, k: int, shuffle: str,
             sides[side]["raw_bytes_per_rank"] = int(raw)
     # Data-plane collectives per compiled step: per batch per side one
     # count exchange + one collective per column (compressed integer
-    # columns ride as two planes).
+    # columns ride as two planes). Hierarchical: the count exchange
+    # and every raw column ride TWO hops (chip + slice); a
+    # codec-eligible column rides chip raw then two codec planes over
+    # the slice axis (three collectives).
     coll = 0
-    for sp in (build, probe):
+    for sp, side in ((build, "build"), (probe, "probe")):
+        if hier:
+            per_side = 2
+            for name, dtype, trailing in sp.columns:
+                eligible = (dcn_codec_on and
+                            _codec_eligible_col(name, dtype, trailing))
+                per_side += 3 if eligible else 2
+            coll += k * per_side
+            continue
         per_col = 2 if compression_bits is not None else 1
         coll += k * (1 + per_col * len(sp.columns))
     return {"exact": exact, "build": sides["build"],
             "probe": sides["probe"], "collectives_per_step": coll}
+
+
+def _codec_eligible_col(name: str, dtype, trailing) -> bool:
+    """THE shape-level mirror of ``shuffle._codec_eligible`` (one
+    rule, three wire-accounting call sites): the FoR+bitpack codec
+    admits scalar integer columns that are not string word planes
+    (those carry their own prefix framing)."""
+    from distributed_join_tpu.utils.strings import _WORD_PREFIX
+
+    return (not trailing
+            and dtype in ("int32", "uint32", "int64", "uint64")
+            and not name.startswith(_WORD_PREFIX))
 
 
 # -- the builder ------------------------------------------------------
@@ -379,7 +457,13 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
                            with_metrics=with_metrics, **opts)
     resolved = dict(sig.options)
 
+    from distributed_join_tpu.parallel.distributed_join import (
+        SHUFFLE_MODES,
+    )
+    from distributed_join_tpu.planning.cost import resolve_dcn_codec
+
     n = sig.n_ranks
+    n_slices = sig.n_slices
     k = int(resolved.get("over_decomposition") or 1)
     nb = n * k
     shuffle = resolved.get("shuffle") or "padded"
@@ -390,7 +474,7 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
     # looking plan for nothing.
     if k < 1:
         raise ValueError("over_decomposition must be >= 1")
-    if shuffle not in ("padded", "ragged", "ppermute"):
+    if shuffle not in SHUFFLE_MODES:
         raise ValueError(f"unknown shuffle mode {shuffle!r}")
     if comp_bits is not None and shuffle == "ragged":
         raise ValueError(
@@ -398,6 +482,23 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
             "ragged exchange already sends exact rows (combining the "
             "two is unimplemented)"
         )
+    dcn_knob = resolved.get("dcn_codec") or "auto"
+    if shuffle == "hierarchical":
+        if comp_bits is not None and dcn_knob == "off":
+            raise ValueError(
+                "dcn_codec='off' contradicts compression_bits="
+                f"{comp_bits} (hierarchical mode compresses only the "
+                "cross-slice tier)")
+        dcn_on = resolve_dcn_codec(dcn_knob)
+    else:
+        resolve_dcn_codec(dcn_knob)
+        dcn_on = False
+        if n > 1 and n_slices > 1:
+            raise ValueError(
+                f"shuffle {shuffle!r} routes one GLOBAL collective "
+                "over a multi-slice mesh, dragging intra-slice "
+                "traffic across DCN — use shuffle='hierarchical' "
+                "(or a flat 1-D communicator)")
     shuffle_f = float(resolved["shuffle_capacity_factor"])
     out_f = float(resolved["out_capacity_factor"])
     out_rows = resolved.get("out_rows_per_rank")
@@ -455,7 +556,8 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
                 "hh_slots": hh_slots}
 
     wire = _predict_wire(n, k, shuffle, comp_bits, side_b, side_p,
-                         b_cap, p_cap)
+                         b_cap, p_cap, n_slices=n_slices,
+                         dcn_codec_on=dcn_on)
 
     model = cost_model or CostModel()
     memory = _predict_memory(n, k, side_b, side_p, b_cap, p_cap,
@@ -478,6 +580,7 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
         memory=memory,
         resolved_options=_jsonable(resolved),
         cost={},
+        n_slices=n_slices,
     )
     # cost needs the assembled plan; frozen dataclass -> rebuild field.
     object.__setattr__(plan, "cost", predict(plan, model))
@@ -587,7 +690,8 @@ def explain_join(build, probe, comm, key="key",
 
     build, probe = padded(build), padded(probe)
     opts = dict(opts)
-    ladder = resolve_join_ladder(build, probe, n, opts)
+    ladder = resolve_join_ladder(build, probe, n, opts,
+                                 n_slices=getattr(comm, "n_slices", 1))
     return build_plan(
         comm, build, probe, key=key,
         with_integrity=verify_integrity,

@@ -66,7 +66,12 @@ SIZING_KNOBS = (
     "hh_build_capacity", "hh_probe_capacity", "hh_out_capacity",
 )
 # Program-shape knobs: filled only when the caller left them unset.
-STRUCTURAL_KNOBS = ("shuffle", "skew_threshold")
+STRUCTURAL_KNOBS = ("shuffle", "skew_threshold", "dcn_codec")
+
+# The DCN tier dominates a hierarchical run's wire when the measured
+# cross-slice share of the bytes crosses this fraction — the evidence
+# bar for recommending the cross-slice codec (docs/HIERARCHY.md).
+DCN_SHARE_WARN = 0.4
 
 # The measured sweep default the skew recommendation names
 # (telemetry/analyze.recommend's skew_enable_prpd flag).
@@ -329,7 +334,11 @@ class JoinTuner:
                                      "gini": gini[1],
                                      "warn": self.skew_gini_warn}
 
-        # 5. wire: padding-dominated bytes -> ragged exact-size wire.
+        # 5. wire: padding-dominated bytes -> the exact-size ragged
+        # wire on a flat mesh; on a multi-slice mesh ragged would
+        # route ONE global exchange across DCN, so the evidence-backed
+        # answer there is the two-level hierarchical shuffle instead
+        # (docs/HIERARCHY.md — the slice-local/global split choice).
         if ("shuffle" not in user_opts
                 and user_opts.get("compression_bits") is None
                 and "compression_bits" not in cfg.sizing
@@ -337,11 +346,30 @@ class JoinTuner:
             eff = self._wire_efficiency(trend.counters_last,
                                         side_geometry)
             if eff is not None and eff[1] < self.wire_efficiency_warn:
-                cfg.structural["shuffle"] = "ragged"
+                multi_slice = (side_geometry.get("n_slices") or 1) > 1
+                cfg.structural["shuffle"] = (
+                    "hierarchical" if multi_slice else "ragged")
                 cfg.source = "history"
                 cfg.basis["wire"] = {"side": eff[0],
                                      "efficiency": eff[1],
                                      "warn": self.wire_efficiency_warn}
+
+        # 6. DCN codec: a hierarchical workload whose measured
+        # cross-slice bytes dominate the wire — and whose codec was
+        # off — flips the FoR+bitpack wire on for exactly that tier
+        # when the caller didn't choose (the break-even argument,
+        # docs/HIERARCHY.md; the codec-ON case needs no clause — its
+        # bits adopt through the rung sizing like every other knob).
+        if "dcn_codec" not in user_opts:
+            share = self._dcn_share(trend.counters_last)
+            if share is not None and share[0] > DCN_SHARE_WARN \
+                    and not share[1]:
+                cfg.structural["dcn_codec"] = "on"
+                cfg.source = "history"
+                cfg.basis["dcn_codec"] = {
+                    "dcn_share": share[0],
+                    "warn": DCN_SHARE_WARN,
+                    "codec_was_on": share[1]}
         if cfg.source == "history":
             self.history_hits += 1
         return cfg
@@ -365,6 +393,7 @@ class JoinTuner:
         geometry = {
             "nb": n * k,
             "n_ranks": n,
+            "n_slices": int(getattr(comm, "n_slices", 1)),
             "b_local": _round_up(build.capacity, n) // n,
             "p_local": _round_up(probe.capacity, n) // n,
             "row_bytes": {
@@ -459,6 +488,31 @@ class JoinTuner:
         new_factor = round(factor * HEADROOM_BUMP, 6)
         tight["factor"] = {"from": factor, "to": new_factor}
         return new_factor, tight
+
+    @staticmethod
+    def _dcn_share(counters):
+        """(dcn share of wire bytes, codec_was_on) from the last
+        recorded per-tier counters — present only for hierarchical
+        runs (``wire_bytes_ici``/``wire_bytes_dcn``,
+        shuffle.shuffle_hierarchical). None when the workload never
+        ran hierarchically."""
+        if not counters:
+            return None
+        dcn = sum(counters.get(f"{s}.wire_bytes_dcn") or 0
+                  for s in ("build", "probe"))
+        total = sum(counters.get(f"{s}.wire_bytes") or 0
+                    for s in ("build", "probe"))
+        if not dcn or not total:
+            return None
+        # codec_was_on is inferred from savings, not from the knob
+        # (counters don't carry it): a codec-on run whose columns are
+        # ALL codec-ineligible saves 0 bytes and reads as "off" — the
+        # resulting 'on' recommendation is a no-op there (structural
+        # knobs fill only UNSET ones and nothing is compressible), so
+        # the misread costs accounting noise, never wrong routing.
+        saved = sum(counters.get(f"{s}.wire_bytes_saved") or 0
+                    for s in ("build", "probe"))
+        return round(dcn / total, 4), saved > 0
 
     def _wire_efficiency(self, counters, geometry: dict):
         """(side, efficiency) of the worst side from the last
@@ -560,6 +614,7 @@ def _static_defaults() -> dict:
         "hh_out_capacity": None,
         "shuffle": "padded",
         "skew_threshold": None,
+        "dcn_codec": "auto",
     }
 
 

@@ -107,6 +107,11 @@ class JoinSignature:
     probe_schema: tuple
     probe_capacity: int
     options: tuple
+    # Slow-tier topology (hierarchical shuffle, docs/HIERARCHY.md):
+    # two slice-splits of the same rank count compile DIFFERENT
+    # routing programs, so the split is part of the program identity.
+    # 1 = flat mesh.
+    n_slices: int = 1
 
     @classmethod
     def of(cls, comm: Communicator, build, probe,
@@ -129,6 +134,7 @@ class JoinSignature:
             options=tuple(sorted(
                 (name, _canon(v)) for name, v in merged.items()
             )),
+            n_slices=int(getattr(comm, "n_slices", 1)),
         )
 
     def canonical(self) -> dict:

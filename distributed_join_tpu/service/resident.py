@@ -560,6 +560,19 @@ class ResidentTableRegistry:
         """Run the build-side 2/3 once and hold the result resident
         under ``name``. Refuses an existing name unless ``replace``."""
         keys = (key,) if isinstance(key, str) else tuple(key)
+        if self.comm.n_ranks > 1 \
+                and getattr(self.comm, "n_slices", 1) > 1:
+            # The prep step (and every probe-only join after it)
+            # routes flat GLOBAL collectives — on a multi-slice mesh
+            # that drags intra-slice traffic across DCN. Hierarchical
+            # resident serving is a named ROADMAP leftover; refuse at
+            # the registration chokepoint, never mis-route.
+            self.refused += 1
+            raise ResidentError(
+                "resident tables are served by flat global "
+                "collectives; hierarchical (multi-slice) probe-only "
+                "serving is not implemented yet — register on a flat "
+                "1-D communicator")
         if name in self._tables and not replace:
             self.refused += 1
             raise ResidentError(
@@ -760,7 +773,7 @@ class ResidentTableRegistry:
 
     def join(self, name: str, probe: Table, *, auto_retry: int = 2,
              tuner=None, with_metrics=None, explain: bool = False,
-             **opts):
+             verify_integrity: bool = False, **opts):
         """One probe-only join against resident table ``name``: merge
         any pending delta runs first (so every join sees every
         append), then partition/shuffle/sort the probe side only and
@@ -768,7 +781,19 @@ class ResidentTableRegistry:
         the warm repeat is a dict lookup + dispatch with zero traces.
         Returns the :class:`~..ops.join.JoinResult` with the usual
         host-side ``retry_report`` (probe-side capacity ladder) and a
-        ``resident`` record attribute."""
+        ``resident`` record attribute.
+
+        ``verify_integrity``: in-graph wire digests over the
+        probe-side shuffle (``make_probe_join_step(with_integrity=)``)
+        verified host-side before returning — the full join's
+        integrity contract on the probe-only dispatch. A mismatch is
+        the RETRYABLE ``retry_integrity`` rung: the tainted probe-only
+        program is evicted and the SAME sizing re-traced (transport
+        corruption is transient, capacities are innocent) up to the
+        ``auto_retry`` budget, then
+        :class:`~..parallel.integrity.IntegrityError` instead of
+        corrupt rows. A verified clean result carries
+        ``res.integrity_report``."""
         handle = self.get(name)
         # The workload signature is hashed FIRST — on the unpadded
         # probe and unmutated opts, the exact basis JoinService keys
@@ -800,30 +825,36 @@ class ResidentTableRegistry:
                 self.comm, handle.capacity_per_rank, probe,
                 signature=wsig, opts=opts)
             opts = tuned.apply(opts)
-        ladder = resolve_join_ladder(handle.table, probe, n, opts)
+        ladder = resolve_join_ladder(
+            handle.table, probe, n, opts,
+            n_slices=getattr(self.comm, "n_slices", 1))
         if tuned is not None:
             ladder.seed_rung(tuned.rung)
         key_opt = list(handle.keys) if len(handle.keys) > 1 \
             else handle.keys[0]
+        from distributed_join_tpu.parallel import integrity
+
+        with_aux = bool(with_metrics or verify_integrity)
         for attempt in range(auto_retry + 1):
             rung = ladder.base_rung + attempt
             sizing = {k: v for k, v in ladder.sizing().items()
                       if k in _PROBE_SIZING_KEYS}
             step_opts = dict(opts, key=key_opt,
                              with_metrics=with_metrics,
+                             with_integrity=verify_integrity,
                              metrics_static={"retry_attempt_max": rung},
                              **sizing)
             sig = self.probe_signature(handle, probe, step_opts)
 
             def build(step_opts=step_opts):
                 step = make_probe_join_step(self.comm, **step_opts)
-                sharded = (JOIN_METRICS_SHARDED_OUT if with_metrics
+                sharded = (JOIN_METRICS_SHARDED_OUT if with_aux
                            else JOIN_SHARDED_OUT)
                 return self.comm.spmd(step, sharded_out=sharded)
 
             fn, hit = self._program(
                 sig, build, example_args=(handle.table, probe),
-                with_aux=bool(with_metrics))
+                with_aux=with_aux)
             handle.cached_sigs.add(sig)
             with telemetry.span("resident_join", table=name,
                                 generation=handle.generation) as sp:
@@ -831,13 +862,34 @@ class ResidentTableRegistry:
                 if sp is not None:
                     sp.sync_on(res.total)
             overflow = bool(res.overflow)
-            ladder.note(overflow)
-            if attempt == auto_retry or not overflow:
+            report = None
+            if verify_integrity and not overflow:
+                # Overflow attempts skip verification (clamped rows
+                # mismatch by design; the overflow rung handles it) —
+                # the full join's discipline, verbatim.
+                report = integrity.verify_join_result(res)
+            ladder.note(overflow,
+                        integrity_ok=None if report is None
+                        else report.ok)
+            failed = overflow or (report is not None
+                                  and not report.ok)
+            if attempt == auto_retry or not failed:
+                if report is not None and not report.ok:
+                    # Budget exhausted on a wire mismatch: the
+                    # resident program is as tainted as a retried one
+                    # — evict it, then refuse loudly rather than
+                    # handing corrupt rows to a probe-only caller.
+                    self._evict_program(sig)
+                    handle.cached_sigs.discard(sig)
+                    raise integrity.IntegrityError(report)
                 handle.joins_served += 1
                 if hit:
                     handle.warm_joins += 1
                 object.__setattr__(res, "retry_report",
                                    ladder.report())
+                if report is not None:
+                    object.__setattr__(res, "integrity_report",
+                                       report)
                 object.__setattr__(res, "resident", {
                     "table": name,
                     "generation": handle.generation,
@@ -859,5 +911,16 @@ class ResidentTableRegistry:
                 telemetry.emit_metrics(getattr(res, "telemetry",
                                                None))
                 return res
-            ladder.escalate()
+            if overflow:
+                ladder.escalate()
+            else:
+                # Integrity mismatch: rerun the SAME sizing — the
+                # rows were wrong, not too many. The tainted entry is
+                # evicted first so the rerun re-traces (injected
+                # corruption is woven at trace time; a resident
+                # executable that delivered corrupt rows must not
+                # keep serving).
+                self._evict_program(sig)
+                handle.cached_sigs.discard(sig)
+                ladder.hold("retry_integrity")
         raise AssertionError("unreachable")

@@ -464,10 +464,6 @@ class JoinService:
             HangError,
             call_with_deadline,
         )
-        from distributed_join_tpu.service.resident import (
-            ResidentError,
-        )
-
         op = "resident_join"
         rid = self._admit(op, request_id)
         t_start = time.perf_counter()
@@ -479,13 +475,6 @@ class JoinService:
         new_traces = cache_hits = 0
         resident_rec = None
         try:
-            if self.config.verify_integrity:
-                raise ResidentError(
-                    "probe-only joins do not carry the wire-"
-                    "integrity digest rungs yet; serve verified "
-                    "traffic through the full join (delta "
-                    "conservation is still checked at every "
-                    "append/merge)")
             sig = self.resident.workload_signature(
                 table, probe, dict(opts))
             with self._exec_lock:
@@ -501,9 +490,15 @@ class JoinService:
                             f"({self.poisoned}); restart the server")
 
                 def run_once():
+                    # --verify-integrity rides the probe-only
+                    # program's digest rungs (PR 12;
+                    # make_probe_join_step(with_integrity=)) — the
+                    # full join's contract on the resident path.
                     return self.resident.join(
                         table, probe,
                         auto_retry=self.config.auto_retry,
+                        verify_integrity=self.config
+                        .verify_integrity,
                         tuner=self.tuner, **opts)
 
                 deadline = self.config.request_deadline_s
@@ -970,6 +965,7 @@ class JoinService:
 _WIRE_JOIN_OPTS = (
     "shuffle", "over_decomposition", "shuffle_capacity_factor",
     "out_capacity_factor", "compression_bits", "skew_threshold",
+    "dcn_codec",
 )
 
 
@@ -1369,6 +1365,10 @@ def parse_args(argv=None):
     p.add_argument("--n-ranks", type=int, default=None,
                    help="mesh size; default all visible devices")
     p.add_argument("--communicator", default="tpu")
+    p.add_argument("--slices", type=int, default=None,
+                   help="serve on a 2-D (slice, chip) hierarchical "
+                        "mesh (docs/HIERARCHY.md); wire joins may "
+                        "then request shuffle='hierarchical'")
     p.add_argument("--auto-retry", type=int, default=2,
                    help="capacity-ladder budget applied to every "
                         "request (rungs reuse cached executables)")
@@ -1469,7 +1469,8 @@ def _service_from_args(args) -> JoinService:
 
     apply_platform(args.platform, args.n_ranks)
     comm = maybe_chaos_communicator(
-        make_communicator(args.communicator, n_ranks=args.n_ranks),
+        make_communicator(args.communicator, n_ranks=args.n_ranks,
+                          n_slices=getattr(args, "slices", None)),
         args)
     cfg = ServiceConfig(
         auto_retry=args.auto_retry,

@@ -684,6 +684,7 @@ def grade_explain(explain: dict, metrics: Optional[dict],
         "predicted_stages": cost.get("stages"),
     }
     exact = bool(wire.get("exact"))
+    n_ranks = int(plan.get("n_ranks") or 0)
     for side in ("build", "probe"):
         pred = (wire.get(side) or {}).get("bytes_total")
         meas = red.get(f"{side}.wire_bytes")
@@ -694,8 +695,30 @@ def grade_explain(explain: dict, metrics: Optional[dict],
                 "error_ratio": (round(meas / pred, 6) if pred
                                 else None),
             }
+            # Hierarchical plans carry per-tier predictions
+            # (ici/dcn_bytes_per_rank) next to per-tier counters
+            # (wire_bytes_ici/_dcn) — each tier is gated exactly on
+            # its own, and a tier mismatch fails the side's verdict
+            # (the --gate-wire-bytes CI gate reads only "match").
+            tiers = {}
+            for tier in ("ici", "dcn"):
+                pred_rank = (wire.get(side) or {}).get(
+                    f"{tier}_bytes_per_rank")
+                meas_t = red.get(f"{side}.wire_bytes_{tier}")
+                if pred_rank is None or meas_t is None:
+                    continue
+                pred_t = int(pred_rank) * n_ranks
+                tiers[tier] = {
+                    "predicted_bytes": pred_t,
+                    "measured_bytes": int(meas_t),
+                    "match": pred_t == int(meas_t),
+                }
+            if tiers:
+                entry["tiers"] = tiers
             if exact:
-                entry["match"] = int(pred) == int(meas)
+                entry["match"] = (int(pred) == int(meas)
+                                  and all(t["match"]
+                                          for t in tiers.values()))
             else:
                 # Estimate-only plans (ragged) are graded, not
                 # pass/failed: an exact-equality verdict on an upper
@@ -736,6 +759,11 @@ def format_explain_grade(grade: dict) -> str:
         lines.append(
             f"  wire {side}: predicted {d['predicted_bytes']} B, "
             f"measured {d['measured_bytes']} B -> {verdict}")
+        for tier, t in sorted((d.get("tiers") or {}).items()):
+            lines.append(
+                f"    {tier}: predicted {t['predicted_bytes']} B, "
+                f"measured {t['measured_bytes']} B -> "
+                + ("MATCH" if t["match"] else "MISMATCH"))
     for side, d in sorted(grade["rows"].items()):
         lines.append(
             f"  rows {side}: predicted {d['predicted_rows']}, "

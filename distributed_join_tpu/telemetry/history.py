@@ -72,7 +72,7 @@ WORKLOAD_KEYS = (
     "selectivity", "shuffle", "key_type", "payload_type",
     "key_columns", "over_decomposition_factor", "zipf_alpha",
     "skew_threshold", "string_payload_bytes", "string_key_bytes",
-    "scale_factor", "nbytes",
+    "scale_factor", "nbytes", "slices", "dcn_codec",
 )
 
 

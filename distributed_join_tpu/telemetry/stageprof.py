@@ -280,6 +280,7 @@ def profile_join_stages(comm, build, probe, key="key", repeats: int = 3,
         resolve_join_ladder,
     )
     from distributed_join_tpu.parallel.shuffle import (
+        shuffle_hierarchical,
         shuffle_padded,
         shuffle_padded_compressed,
         shuffle_ragged,
@@ -323,7 +324,8 @@ def profile_join_stages(comm, build, probe, key="key", repeats: int = 3,
     # plan's capacity arithmetic is make_join_step's verbatim; segment
     # shapes below read b_cap/p_cap/out_cap FROM the plan, so they
     # cannot drift from what the monolithic program compiles.
-    ladder = resolve_join_ladder(build, probe, n, opts)
+    ladder = resolve_join_ladder(build, probe, n, opts,
+                                 n_slices=getattr(comm, "n_slices", 1))
     sizing = ladder.sizing()
     plan = planning.build_plan(comm, build, probe, key=key,
                                with_metrics=False,
@@ -345,6 +347,22 @@ def profile_join_stages(comm, build, probe, key="key", repeats: int = 3,
             "shuffle='padded' or drop the string columns")
     via = "ppermute" if mode == "ppermute" else "all_to_all"
     single = nb == 1
+    # Hierarchical mode: the shuffle segment routes the two tiers
+    # exactly as the monolithic step — shuffle_hierarchical with the
+    # plan's resolved dcn codec (the per-tier wire counters then gate
+    # exactly, like the flat padded bytes). One-slice degenerates to
+    # the flat padded segment, mirroring _batch_shuffle.
+    hier = (mode == "hierarchical"
+            and getattr(comm, "n_slices", 1) > 1)
+    dcn_bits = None
+    if mode == "hierarchical":
+        from distributed_join_tpu.planning.cost import (
+            resolve_dcn_bits,
+        )
+
+        dcn_bits = resolve_dcn_bits(
+            plan.resolved_options.get("dcn_codec") or "auto",
+            comp_bits, n_slices=getattr(comm, "n_slices", 1))
 
     # -- segment programs ---------------------------------------------
 
@@ -421,7 +439,12 @@ def profile_join_stages(comm, build, probe, key="key", repeats: int = 3,
                           for cname, c in payload.items()
                           if cname.startswith(prefix)}
                 counts = payload[f"{side}.b{b}.counts"]
-                if comp_bits is not None:
+                if hier:
+                    recv, _, c_ovf = shuffle_hierarchical(
+                        comm, padded, counts, cap,
+                        dcn_bits=dcn_bits, tape=t)
+                    overflow = overflow | c_ovf
+                elif comp_bits is not None and mode != "hierarchical":
                     recv, _, c_ovf = shuffle_padded_compressed(
                         comm, padded, counts, cap, bits=comp_bits,
                         via=via, tape=t)

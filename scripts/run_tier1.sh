@@ -51,6 +51,18 @@
 #                                   # and add zero traces) + the
 #                                   # resident_smoke counter-
 #                                   # signature gate
+#   scripts/run_tier1.sh hier       # hierarchical ICI/DCN shuffle:
+#                                   # -m hier suite + a deterministic
+#                                   # nested-mesh (2x4) driver smoke —
+#                                   # per-tier wire bytes gated
+#                                   # EXACTLY vs the device counters
+#                                   # (analyze explain
+#                                   # --gate-wire-bytes), the codec-on
+#                                   # cross-slice bytes strictly below
+#                                   # the flat wire, and the counter
+#                                   # signature (matches included)
+#                                   # gated vs results/baselines/
+#                                   # hier_smoke.json
 #   scripts/run_tier1.sh tuner      # autotuner: -m tuner suite + a
 #                                   # cold/warm driver A/B (warm run
 #                                   # must start at the escalated
@@ -180,6 +192,21 @@ PY
       "$tmp/resident_drill.json"
     python -m distributed_join_tpu.telemetry.analyze compare \
       "$tmp/resident_drill.json" --baseline resident_smoke
+    # The hierarchical shuffle's counter signature is part of the
+    # same gate (docs/HIERARCHY.md): the deterministic 2x4 nested-
+    # mesh join's per-tier wire bytes (ici/dcn, codec savings) and
+    # match count — a changed router, codec, or tier split moves
+    # them. The per-tier EXACT gate itself lives in the hier lane.
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.benchmarks.distributed_join \
+      --platform cpu --n-ranks 8 --slices 2 --shuffle hierarchical \
+      --build-table-nrows 8000 --probe-table-nrows 8000 \
+      --iterations 1 --out-capacity-factor 3.0 \
+      --telemetry "$tmp/hier_tel" \
+      --json-output "$tmp/hier_record.json"
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/hier_record.json" --baseline hier_smoke
     exit $?
     ;;
   lint)
@@ -300,6 +327,45 @@ print("stageprof gate: per-stage wire bytes exact, stage set matches "
       f"({prof['overlap']['fraction']})")
 PY
     exit $?
+    ;;
+  hier)
+    # Hierarchical two-level ICI/DCN shuffle (docs/HIERARCHY.md).
+    # 1. the -m hier unit suite (oracle exactness incl. skew/string
+    #    keys, per-tier wire exactness, degenerate-hierarchy lowering
+    #    locks, DCN-seam chaos, probe-only integrity rungs);
+    # 2. a deterministic nested-mesh (2x4) driver smoke: the per-tier
+    #    wire-byte split must EXACTLY match the device counters
+    #    (analyze explain --gate-wire-bytes now gates each tier), and
+    #    the counter signature — matches included, i.e. the join's
+    #    answer — is gated against results/baselines/hier_smoke.json;
+    # 3. a 6-trial fixed-seed hierarchical chaos slice (cross-slice
+    #    corruption seam included) must survive clean.
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m hier --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    tmp="$(mktemp -d /tmp/djtpu_hier.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.benchmarks.distributed_join \
+      --platform cpu --n-ranks 8 --slices 2 --shuffle hierarchical \
+      --build-table-nrows 8000 --probe-table-nrows 8000 \
+      --iterations 1 --out-capacity-factor 3.0 \
+      --telemetry "$tmp/tel" --explain \
+      --json-output "$tmp/record.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/tel/explain.json"
+    python -m distributed_join_tpu.telemetry.analyze explain \
+      "$tmp/tel/explain.json" --record "$tmp/record.json" \
+      --gate-wire-bytes
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/record.json" --baseline hier_smoke
+    # no exec: the EXIT trap must still clean $tmp
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      python -m distributed_join_tpu.parallel.chaos \
+      --hier-slice 6 --seed 42 \
+      --repro-out /tmp/djtpu_hier_chaos_repro
     ;;
   tuner)
     # History-driven autotuner (docs/OBSERVABILITY.md "Autotuner").
@@ -442,7 +508,7 @@ PY
     exit $?
     ;;
   *)
-    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|stageprof|tuner|resident]" >&2
+    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|stageprof|tuner|resident|hier]" >&2
     exit 2
     ;;
 esac

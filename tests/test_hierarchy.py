@@ -1,0 +1,617 @@
+"""Hierarchical two-level ICI/DCN shuffle (docs/HIERARCHY.md).
+
+The 8 virtual devices fake a multi-slice topology with nested mesh
+axes (2x4, 4x2, 8x1); the routing algebra, the per-tier wire
+accounting, and the cross-slice codec are identical to the real
+multi-slice case — only the transports differ. Acceptance bars
+(ISSUE 12): pandas-oracle exactness across over-decomposition / skew /
+string-key configs, per-tier padded wire bytes EXACT vs the device
+counters, cross-slice bytes with the codec on strictly below the flat
+global shuffle's wire bytes, and the one-slice degenerate hierarchy
+lowering byte-identically to the flat padded path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_join_tpu import planning
+from distributed_join_tpu.parallel.communicator import (
+    HierarchicalTpuCommunicator,
+    TpuCommunicator,
+    make_communicator,
+)
+from distributed_join_tpu.parallel.distributed_join import (
+    JOIN_METRICS_SHARDED_OUT,
+    distributed_inner_join,
+    make_join_step,
+)
+from distributed_join_tpu.parallel.faults import (
+    FaultInjectingCommunicator,
+    FaultPlan,
+)
+from distributed_join_tpu.parallel.mesh import make_hierarchical_mesh
+from distributed_join_tpu.utils.generators import (
+    generate_build_probe_tables,
+)
+
+pytestmark = pytest.mark.hier
+
+
+@pytest.fixture(scope="module")
+def hcomm():
+    assert len(jax.devices()) >= 8
+    return HierarchicalTpuCommunicator(n_slices=2, n_ranks=8)
+
+
+@pytest.fixture(scope="module")
+def fcomm():
+    return TpuCommunicator(n_ranks=8)
+
+
+def _normalize(df):
+    cols = sorted(df.columns)
+    return (df[cols].sort_values(cols).reset_index(drop=True)
+            .astype("int64"))
+
+
+def _check_oracle(build, probe, comm, **opts):
+    res = distributed_inner_join(build, probe, comm, **opts)
+    assert not bool(res.overflow), "capacity overflow in test config"
+    got = _normalize(res.table.to_pandas())
+    want = _normalize(
+        build.to_pandas().merge(probe.to_pandas(), on="key"))
+    assert int(res.total) == len(want)
+    pd.testing.assert_frame_equal(got, want)
+    return res
+
+
+# -- topology ---------------------------------------------------------
+
+
+def test_mesh_refuses_non_divisor_slice_count():
+    with pytest.raises(ValueError, match="does not divide"):
+        make_hierarchical_mesh(3, 8)
+    with pytest.raises(ValueError, match="n_slices"):
+        make_hierarchical_mesh(0, 8)
+
+
+def test_factory_builds_hierarchical_comm():
+    comm = make_communicator("tpu", n_ranks=8, n_slices=2)
+    assert comm.name == "tpu-hier"
+    assert (comm.n_slices, comm.chips_per_slice) == (2, 4)
+    # n_slices=1 stays the FLAT 1-D mesh (the degenerate hierarchy
+    # must lower byte-identically to the seed programs).
+    flat = make_communicator("tpu", n_ranks=8, n_slices=1)
+    assert flat.name == "tpu" and flat.n_slices == 1
+    with pytest.raises(ValueError, match="slices"):
+        make_communicator("local", n_slices=2)
+
+
+def test_flat_mode_on_multislice_mesh_refused(hcomm):
+    for mode in ("padded", "ragged", "ppermute"):
+        with pytest.raises(ValueError, match="hierarchical"):
+            make_join_step(hcomm, shuffle=mode)
+    with pytest.raises(ValueError, match="dcn_codec"):
+        make_join_step(hcomm, shuffle="hierarchical",
+                       dcn_codec="sometimes")
+    with pytest.raises(ValueError, match="contradicts"):
+        make_join_step(hcomm, shuffle="hierarchical",
+                       dcn_codec="off", compression_bits=16)
+
+
+# -- oracle exactness -------------------------------------------------
+
+
+@pytest.mark.parametrize("k,codec", [(1, "auto"), (3, "auto"),
+                                     (1, "off"), (2, "on")])
+def test_hier_join_matches_oracle(hcomm, k, codec):
+    build, probe = generate_build_probe_tables(
+        seed=21, build_nrows=4096, probe_nrows=8192, rand_max=2048,
+        selectivity=0.5)
+    _check_oracle(build, probe, hcomm, shuffle="hierarchical",
+                  dcn_codec=codec, over_decomposition=k,
+                  out_capacity_factor=3.0)
+
+
+def test_hier_join_skew_config_oracle(hcomm):
+    # Heavy key duplication + the PRPD sidecar over the hierarchical
+    # route: the sidecar broadcasts over the (multi-axis) all_gather
+    # while the shuffled remainder rides the two-level route.
+    build, probe = generate_build_probe_tables(
+        seed=22, build_nrows=2048, probe_nrows=4096, rand_max=64,
+        selectivity=0.9, unique_build_keys=False)
+    _check_oracle(build, probe, hcomm, shuffle="hierarchical",
+                  skew_threshold=0.05, out_capacity_factor=0.0,
+                  out_rows_per_rank=200_000,
+                  shuffle_capacity_factor=8.0,
+                  hh_out_capacity=200_000)
+
+
+def test_hier_join_string_key_oracle(hcomm):
+    from distributed_join_tpu.table import Table
+    from distributed_join_tpu.utils.strings import add_string_column
+
+    rng = np.random.default_rng(9)
+    nb, npr = 2048, 4096
+    bids = rng.integers(0, 300, nb)
+    pids = rng.integers(0, 300, npr)
+    bcols = add_string_column(
+        {"bv": jnp.asarray(rng.integers(0, 1000, nb))},
+        "name", [f"n{i:05d}" for i in bids], 10)
+    pcols = add_string_column(
+        {"pv": jnp.asarray(rng.integers(0, 1000, npr))},
+        "name", [f"n{i:05d}" for i in pids], 10)
+    b = Table(bcols, jnp.ones(nb, bool))
+    p = Table(pcols, jnp.ones(npr, bool))
+    res = distributed_inner_join(
+        b, p, hcomm, key="name", shuffle="hierarchical",
+        out_capacity_factor=10.0, shuffle_capacity_factor=6.0)
+    want = pd.DataFrame(
+        {"name": [f"n{i:05d}" for i in bids]}).merge(
+        pd.DataFrame({"name": [f"n{i:05d}" for i in pids]}),
+        on="name")
+    assert int(res.total) == len(want)
+    assert not bool(res.overflow)
+
+
+# -- degenerate hierarchies -------------------------------------------
+
+
+def test_single_slice_hierarchical_lowers_byte_identical(fcomm):
+    """n_slices == 1: the hierarchical mode must compile the EXACT
+    flat padded program (lowering-locked, not just result-equal)."""
+    build, probe = generate_build_probe_tables(
+        seed=23, build_nrows=2048, probe_nrows=2048, rand_max=1024,
+        selectivity=0.5)
+    build, probe = fcomm.device_put_sharded((build, probe))
+
+    def lowered(mode):
+        step = make_join_step(fcomm, shuffle=mode,
+                              out_capacity_factor=3.0)
+        from distributed_join_tpu.parallel.distributed_join import (
+            JOIN_SHARDED_OUT,
+        )
+
+        return fcomm.spmd(step, sharded_out=JOIN_SHARDED_OUT).lower(
+            build, probe).as_text()
+
+    assert lowered("hierarchical") == lowered("padded")
+
+
+def test_single_slice_codec_knob_plan_exact(fcomm):
+    """dcn_codec='on' over ONE slice: no cross-slice tier exists, so
+    the ladder must not arm codec bits (the first retry rung would
+    widen a knob the degenerate raw-padded path ignores) and the
+    exact-contract wire prediction must bill the raw padded bytes the
+    runtime actually ships — plan == device counters."""
+    build, probe = generate_build_probe_tables(
+        seed=27, build_nrows=2048, probe_nrows=4096, rand_max=1024,
+        selectivity=0.5)
+    build, probe = fcomm.device_put_sharded((build, probe))
+    opts = dict(shuffle="hierarchical", dcn_codec="on",
+                out_capacity_factor=3.0)
+    plan = planning.explain_join(build, probe, fcomm, **opts)
+    assert plan.resolved_options.get("compression_bits") is None
+    assert plan.wire["exact"] is True
+    step = make_join_step(fcomm, with_metrics=True, **opts)
+    _, m = fcomm.spmd(step, sharded_out=JOIN_METRICS_SHARDED_OUT)(
+        build, probe)
+    red = m.to_dict()["reduced"]
+    for side in ("build", "probe"):
+        assert red[f"{side}.wire_bytes"] \
+            == plan.wire[side]["bytes_total"]
+
+
+def test_pure_dcn_hierarchy_oracle():
+    """n_slices == n_ranks (one chip per slice): phase 1 degenerates
+    to an identity exchange and ALL routed traffic crosses slices."""
+    comm = HierarchicalTpuCommunicator(n_slices=8, n_ranks=8)
+    assert comm.chips_per_slice == 1
+    build, probe = generate_build_probe_tables(
+        seed=24, build_nrows=2048, probe_nrows=4096, rand_max=1024,
+        selectivity=0.5)
+    res = _check_oracle(build, probe, comm, shuffle="hierarchical",
+                        out_capacity_factor=3.0)
+    # every wire byte is cross-slice: the dcn counter carries the
+    # whole (compressed) payload
+    m = getattr(res, "telemetry", None)
+    if m is not None:
+        red = m.to_dict()["reduced"]
+        assert red["build.wire_bytes_dcn"] > 0
+
+
+# -- per-tier wire accounting (the CI-gated exactness bar) ------------
+
+
+@pytest.mark.parametrize("codec", ["off", "on"])
+def test_per_tier_wire_bytes_exact_vs_plan(hcomm, codec):
+    build, probe = generate_build_probe_tables(
+        seed=25, build_nrows=4096, probe_nrows=8192, rand_max=2048,
+        selectivity=0.5)
+    build, probe = hcomm.device_put_sharded((build, probe))
+    opts = dict(shuffle="hierarchical", dcn_codec=codec,
+                out_capacity_factor=3.0, over_decomposition=2,
+                compression_bits=16 if codec == "on" else None)
+    step = make_join_step(hcomm, with_metrics=True, **opts)
+    _, m = hcomm.spmd(step, sharded_out=JOIN_METRICS_SHARDED_OUT)(
+        build, probe)
+    red = m.to_dict()["reduced"]
+    plan = planning.build_plan(hcomm, build, probe,
+                               with_metrics=True, **opts)
+    assert plan.n_slices == 2
+    assert plan.wire["exact"] is True
+    n = hcomm.n_ranks
+    for side in ("build", "probe"):
+        w = plan.wire[side]
+        assert red[f"{side}.wire_bytes"] == w["bytes_total"]
+        assert (red[f"{side}.wire_bytes_ici"]
+                == w["ici_bytes_per_rank"] * n)
+        assert (red[f"{side}.wire_bytes_dcn"]
+                == w["dcn_bytes_per_rank"] * n)
+    tiers = plan.cost.get("shuffle_tiers")
+    assert tiers is not None and tiers["ici_s"] > 0 \
+        and tiers["dcn_s"] > 0
+
+
+def test_codec_on_dcn_bytes_strictly_below_flat_wire(hcomm, fcomm):
+    """THE break-even claim, measured: cross-slice bytes with the
+    codec on must be strictly less than what the flat global padded
+    shuffle moves for the same workload."""
+    build, probe = generate_build_probe_tables(
+        seed=26, build_nrows=4096, probe_nrows=4096, rand_max=2048,
+        selectivity=0.5)
+
+    def counters(comm, **opts):
+        b, p = comm.device_put_sharded((build, probe))
+        step = make_join_step(comm, with_metrics=True,
+                              out_capacity_factor=3.0, **opts)
+        _, m = comm.spmd(step, sharded_out=JOIN_METRICS_SHARDED_OUT)(
+            b, p)
+        return m.to_dict()["reduced"]
+
+    hier = counters(hcomm, shuffle="hierarchical", dcn_codec="on",
+                    compression_bits=16)
+    flat = counters(fcomm, shuffle="padded")
+    for side in ("build", "probe"):
+        dcn = hier[f"{side}.wire_bytes_dcn"]
+        assert 0 < dcn < flat[f"{side}.wire_bytes"], (side, dcn, flat)
+        # and the codec actually saved bytes on that tier
+        assert hier[f"{side}.wire_bytes_saved"] > 0
+
+
+# -- program identity -------------------------------------------------
+
+
+def test_signature_distinguishes_slice_splits():
+    from distributed_join_tpu.service.programs import JoinSignature
+
+    build, probe = generate_build_probe_tables(
+        seed=27, build_nrows=1024, probe_nrows=1024, rand_max=512,
+        selectivity=0.5)
+    c2 = HierarchicalTpuCommunicator(n_slices=2, n_ranks=8)
+    c4 = HierarchicalTpuCommunicator(n_slices=4, n_ranks=8)
+    s2 = JoinSignature.of(c2, build, probe, shuffle="hierarchical")
+    s4 = JoinSignature.of(c4, build, probe, shuffle="hierarchical")
+    assert s2.n_slices == 2 and s4.n_slices == 4
+    assert s2.digest() != s4.digest()
+
+
+def test_hier_plan_digest_equals_cache_key(hcomm):
+    from distributed_join_tpu.service.programs import JoinProgramCache
+
+    build, probe = generate_build_probe_tables(
+        seed=28, build_nrows=2048, probe_nrows=2048, rand_max=1024,
+        selectivity=0.5)
+    cache = JoinProgramCache(hcomm)
+    res = distributed_inner_join(
+        build, probe, hcomm, shuffle="hierarchical",
+        out_capacity_factor=3.0, program_cache=cache, explain=True)
+    assert not bool(res.overflow)
+    sigs = list(cache._entries)
+    assert len(sigs) == 1
+    assert res.plan.digest == sigs[0].digest()
+    # warm repeat: dict lookup, zero new traces
+    traces = cache.traces
+    distributed_inner_join(
+        build, probe, hcomm, shuffle="hierarchical",
+        out_capacity_factor=3.0, program_cache=cache)
+    assert cache.traces == traces
+
+
+def test_service_serves_hierarchical_warm(hcomm):
+    """The daemon path: a JoinService stood up on the hierarchical
+    mesh (``tpu-join-service --slices K``) serves wire-shaped
+    hierarchical joins — ``shuffle``/``dcn_codec`` ride the query
+    spec (``_WIRE_JOIN_OPTS``) — and a warm repeat is a zero-trace
+    dispatch of the cached hierarchical program."""
+    from distributed_join_tpu.service.server import (
+        _WIRE_JOIN_OPTS,
+        JoinService,
+        ServiceConfig,
+        _join_opts_from_spec,
+    )
+
+    assert "dcn_codec" in _WIRE_JOIN_OPTS
+    opts = _join_opts_from_spec(
+        {"shuffle": "hierarchical", "dcn_codec": "on", "seed": 3})
+    assert opts == {"shuffle": "hierarchical", "dcn_codec": "on"}
+    build, probe = generate_build_probe_tables(
+        seed=29, build_nrows=2048, probe_nrows=2048, rand_max=1024,
+        selectivity=0.5)
+    service = JoinService(hcomm, ServiceConfig())
+    res = service.join(build, probe, out_capacity_factor=3.0, **opts)
+    want = len(build.to_pandas().merge(probe.to_pandas(), on="key"))
+    assert int(res.total) == want
+    warm = service.join(build, probe, out_capacity_factor=3.0, **opts)
+    assert int(warm.total) == want
+    assert warm.new_traces == 0
+
+
+# -- chaos / integrity on the cross-slice seam ------------------------
+
+
+@pytest.mark.parametrize("mode", ["bit_flip", "misroute"])
+def test_integrity_detects_cross_slice_corruption(mode):
+    """A corrupted cross-slice exchange must be caught by the wire
+    digests and retried to a clean, oracle-exact result — the
+    retry_integrity rung on the hierarchical route."""
+    from distributed_join_tpu.parallel import integrity
+
+    build, probe = generate_build_probe_tables(
+        seed=29, build_nrows=1024, probe_nrows=2048, rand_max=700,
+        selectivity=0.5)
+    comm = FaultInjectingCommunicator(
+        HierarchicalTpuCommunicator(n_slices=2, n_ranks=8),
+        FaultPlan(seed=5, corrupt_mode=mode, corrupt_collectives=1))
+    res = distributed_inner_join(
+        build, probe, comm, shuffle="hierarchical", dcn_codec="off",
+        out_capacity_factor=3.0, auto_retry=3,
+        verify_integrity=True)
+    assert not bool(res.overflow)
+    assert res.integrity_report.ok
+    actions = [a.action for a in res.retry_report.attempts]
+    assert "retry_integrity" in actions, actions
+    got = _normalize(res.table.to_pandas())
+    want = _normalize(
+        build.to_pandas().merge(probe.to_pandas(), on="key"))
+    pd.testing.assert_frame_equal(got, want)
+    # the corruption budget was real: a zero-budget twin runs clean
+    assert isinstance(integrity.verify_join_result(res),
+                      integrity.IntegrityReport)
+
+
+def test_chaos_hier_slice_fixed_seed():
+    """An in-suite slice of the --hier-slice soak: every trial must
+    grade ok/recovered/detected — never a silent corruption."""
+    from distributed_join_tpu.parallel.chaos import run_hier_trial
+
+    for trial in range(2):
+        rec = run_hier_trial(42, trial, n_ranks=8, deadline_s=240.0)
+        assert not rec["verdict"].startswith("FAILED"), rec
+
+
+# -- probe-only integrity rungs (resident serving) --------------------
+
+
+def test_probe_only_integrity_rung_fires(fcomm):
+    """ISSUE 12 satellite: with_integrity threaded through
+    make_probe_join_step — a corrupted probe-side shuffle on a
+    PROBE-ONLY dispatch must fire the ladder's retry_integrity rung,
+    evict the tainted program, and settle oracle-exact."""
+    from distributed_join_tpu.service.programs import JoinProgramCache
+    from distributed_join_tpu.service.resident import (
+        ResidentTableRegistry,
+    )
+
+    build, probe = generate_build_probe_tables(
+        seed=31, build_nrows=1024, probe_nrows=2048, rand_max=700,
+        selectivity=0.5)
+    plan = FaultPlan(seed=3, corrupt_mode="bit_flip",
+                     corrupt_collectives=0)
+    comm = FaultInjectingCommunicator(TpuCommunicator(n_ranks=8),
+                                      plan)
+    cache = JoinProgramCache(comm)
+    registry = ResidentTableRegistry(comm, cache)
+    # registration traces its prep programs CLEAN (budget 0)...
+    registry.register("t", build, key="key")
+    # ...then the probe-only program faces one corrupted collective.
+    plan.corrupt_collectives = 1
+    comm.rearm_corruption()
+    res = registry.join("t", probe, auto_retry=3,
+                        verify_integrity=True,
+                        out_capacity_factor=3.0)
+    assert res.integrity_report.ok
+    actions = [a.action for a in res.retry_report.attempts]
+    assert "retry_integrity" in actions, actions
+    want = build.to_pandas().merge(probe.to_pandas(), on="key")
+    assert int(res.total) == len(want)
+    assert cache.integrity_evictions >= 1
+
+
+def test_probe_only_integrity_terminal_raises(fcomm):
+    """Budget-exhausting corruption on every retry must raise
+    IntegrityError — never corrupt rows — and evict the program."""
+    from distributed_join_tpu.parallel import integrity
+    from distributed_join_tpu.service.programs import JoinProgramCache
+    from distributed_join_tpu.service.resident import (
+        ResidentTableRegistry,
+    )
+
+    build, probe = generate_build_probe_tables(
+        seed=32, build_nrows=1024, probe_nrows=1024, rand_max=512,
+        selectivity=0.5)
+    plan = FaultPlan(seed=3, corrupt_mode="bit_flip",
+                     corrupt_collectives=0)
+    comm = FaultInjectingCommunicator(TpuCommunicator(n_ranks=8),
+                                      plan)
+    cache = JoinProgramCache(comm)
+    registry = ResidentTableRegistry(comm, cache)
+    registry.register("t", build, key="key")
+    plan.corrupt_collectives = 1_000_000   # never exhausts
+    comm.rearm_corruption()
+    with pytest.raises(integrity.IntegrityError):
+        registry.join("t", probe, auto_retry=1,
+                      verify_integrity=True,
+                      out_capacity_factor=3.0)
+
+
+# -- tuner policies ---------------------------------------------------
+
+
+def test_dcn_constant_refits_only_from_dcn_carrying_profiles():
+    """calibrate_from_stage_profile attributes each shuffle ratio to
+    exactly one tier: a FLAT profile's ratio carries zero cross-slice
+    evidence, so it must not rescale the uncalibrated dcn_bytes_per_s
+    spec constant (it could silently cross the codec break-even) —
+    and symmetrically, a DCN-carrying profile's shuffle wall is
+    dominated by the slow tier, so its ratio refits ONLY
+    dcn_bytes_per_s, never the ici/codec constants."""
+    from distributed_join_tpu.planning.cost import (
+        DEFAULT_COST_MODEL,
+        calibrate_from_stage_profile,
+    )
+
+    def profile(shuf_ratio, dcn_bytes):
+        def stage(ratio, counters=None):
+            return {"ran": True, "wall_s": 0.001 * ratio,
+                    "wall_min_s": 0.001 * ratio, "predicted_s": 0.001,
+                    "ratio": ratio, "counters": counters or {}}
+
+        return {
+            "schema_version": 1, "kind": "stageprofile",
+            "plan_digest": "x" * 64, "shuffle": "padded",
+            "n_ranks": 8, "over_decomposition": 1, "repeats": 3,
+            "platform": "tpu", "overflow": False,
+            "stages": {
+                "partition": stage(2.0),
+                "shuffle": stage(
+                    shuf_ratio,
+                    {"build.wire_bytes_dcn": dcn_bytes,
+                     "probe.wire_bytes_dcn": dcn_bytes}),
+                "join": stage(3.0),
+                "skew": {"ran": False, "wall_s": 0.0,
+                         "wall_min_s": 0.0, "predicted_s": 0.0,
+                         "ratio": None, "counters": {}},
+            },
+            "sum_of_stages_s": 0.009, "sum_of_stages_min_s": 0.009,
+            "monolithic": {"wall_s": 0.008, "wall_min_s": 0.008,
+                           "walls_s": [0.008]},
+            "overlap": {"credit_s": 0.001, "fraction": 0.1},
+        }
+
+    base = DEFAULT_COST_MODEL
+    # flat profile (zero DCN bytes): ICI refits, DCN untouched
+    model, report = calibrate_from_stage_profile(profile(4.0, 0))
+    assert report["calibrated"]
+    assert model.ici_bytes_per_s == pytest.approx(
+        base.ici_bytes_per_s / 4.0)
+    assert model.dcn_bytes_per_s == base.dcn_bytes_per_s
+    assert report["dcn_scale"] is None
+    assert "dcn_bytes_per_s" not in report["refit"]["shuffle"]
+    # DCN-carrying profile: ONLY the DCN constant refits — the ratio
+    # is slow-tier evidence and must not corrupt the ICI constant.
+    model, report = calibrate_from_stage_profile(profile(4.0, 8192))
+    assert model.dcn_bytes_per_s == pytest.approx(
+        base.dcn_bytes_per_s / 4.0)
+    assert report["dcn_scale"] == 4.0
+    assert "dcn_bytes_per_s" in report["refit"]["shuffle"]
+    assert model.ici_bytes_per_s == base.ici_bytes_per_s
+    assert model.codec_bytes_per_s == base.codec_bytes_per_s
+
+
+def test_probe_only_refuses_multislice_mesh(hcomm):
+    """Resident (probe-only) serving routes flat GLOBAL collectives;
+    on a multi-slice mesh that would drag intra-slice traffic across
+    DCN — both the step factory and the registry chokepoint must
+    refuse loudly (hierarchical probe-only serving is a named ROADMAP
+    leftover), never mis-route."""
+    from distributed_join_tpu.parallel.distributed_join import (
+        make_probe_join_step,
+    )
+    from distributed_join_tpu.service.resident import (
+        ResidentError,
+        ResidentTableRegistry,
+    )
+
+    with pytest.raises(ValueError, match="multi-slice"):
+        make_probe_join_step(hcomm)
+    reg = ResidentTableRegistry(hcomm)
+    build, _ = generate_build_probe_tables(
+        seed=28, build_nrows=1024, probe_nrows=1024, rand_max=512,
+        selectivity=0.5)
+    with pytest.raises(ResidentError, match="multi-slice"):
+        reg.register("dim", build)
+    assert reg.refused == 1
+
+
+def test_tuner_recommends_dcn_codec_from_tier_counters():
+    from distributed_join_tpu.planning.tuner import JoinTuner
+
+    tuner = JoinTuner()
+    entry = {
+        "signature": "cafe",
+        "outcome": "ok",
+        "op": "join",
+        "wall_s": 0.2,
+        "counter_signature": {"signature_version": 1, "n_ranks": 8,
+                              "counters": {
+                                  "build.wire_bytes": 1000,
+                                  "build.wire_bytes_ici": 400,
+                                  "build.wire_bytes_dcn": 600,
+                                  "probe.wire_bytes": 1000,
+                                  "probe.wire_bytes_ici": 400,
+                                  "probe.wire_bytes_dcn": 600,
+                              }},
+    }
+    tuner.observe_entry(entry)
+    cfg = tuner.recommend("cafe",
+                          user_opts={"shuffle": "hierarchical"})
+    assert cfg.structural.get("dcn_codec") == "on"
+    assert cfg.basis["dcn_codec"]["dcn_share"] == 0.6
+    # explicit knob is never overridden
+    cfg2 = tuner.recommend("cafe",
+                           user_opts={"shuffle": "hierarchical",
+                                      "dcn_codec": "off"})
+    assert "dcn_codec" not in cfg2.structural
+    # codec already on (savings recorded): no recommendation
+    tuner2 = JoinTuner()
+    entry2 = dict(entry)
+    entry2["counter_signature"] = {
+        "signature_version": 1, "n_ranks": 8,
+        "counters": {**entry["counter_signature"]["counters"],
+                     "build.wire_bytes_saved": 123}}
+    tuner2.observe_entry(entry2)
+    cfg3 = tuner2.recommend("cafe",
+                            user_opts={"shuffle": "hierarchical"})
+    assert "dcn_codec" not in cfg3.structural
+
+
+def test_tuner_wire_clause_prefers_hierarchical_on_multislice():
+    from distributed_join_tpu.planning.tuner import JoinTuner
+
+    tuner = JoinTuner(wire_efficiency_warn=0.9)
+    entry = {
+        "signature": "feed",
+        "outcome": "ok",
+        "op": "join",
+        "wall_s": 0.2,
+        "counter_signature": {"signature_version": 1, "n_ranks": 8,
+                              "counters": {
+                                  "build.wire_bytes": 10_000,
+                                  "build.rows_shuffled": 100,
+                                  "probe.wire_bytes": 10_000,
+                                  "probe.rows_shuffled": 100,
+                              }},
+    }
+    tuner.observe_entry(entry)
+    geo = {"nb": 8, "n_ranks": 8, "b_local": 128, "p_local": 128,
+           "row_bytes": {"build": 16, "probe": 16}}
+    flat = tuner.recommend("feed", user_opts={},
+                           side_geometry=dict(geo, n_slices=1))
+    assert flat.structural.get("shuffle") == "ragged"
+    multi = tuner.recommend("feed", user_opts={},
+                            side_geometry=dict(geo, n_slices=2))
+    assert multi.structural.get("shuffle") == "hierarchical"

@@ -583,10 +583,12 @@ def test_hanging_register_poisons_service(tmp_path):
                 t.join(timeout=120.0)
 
 
-def test_verify_integrity_service_refuses_resident():
-    """A verify-integrity service refuses probe-only joins loudly
-    (the digest rungs are not in the probe-only program yet) instead
-    of silently skipping verification."""
+def test_verify_integrity_service_serves_resident():
+    """A verify-integrity service SERVES probe-only joins (PR 12:
+    ``make_probe_join_step(with_integrity=)`` threads the digest
+    rungs through the resident path) and the result carries a clean
+    host-verified integrity report — verification rides the program,
+    never silently skipped."""
     from distributed_join_tpu.service.server import (
         JoinService,
         ServiceConfig,
@@ -596,9 +598,11 @@ def test_verify_integrity_service_refuses_resident():
     svc = JoinService(comm, ServiceConfig(verify_integrity=True))
     b, p = _tables(seed=37)
     svc.register_table("dim", b)
-    with pytest.raises(ResidentError, match="integrity"):
-        svc.resident_join("dim", p)
-    assert svc.failed == 1
+    res = svc.resident_join("dim", p)
+    assert res.integrity_report.ok
+    plain = svc.resident_join("dim", p)
+    assert int(res.total) == int(plain.total)
+    assert svc.failed == 0
 
 
 # -- driver A/B -------------------------------------------------------
