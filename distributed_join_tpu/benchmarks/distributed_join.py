@@ -168,6 +168,16 @@ def parse_args(argv=None):
                    help="join on a fixed-width STRING key of this many "
                         "bytes (derived from the int key; packed-word "
                         "composite-key machinery)")
+    p.add_argument("--agg-ab", type=int, default=0, metavar="N",
+                   help="after the timed run: time N warm fused "
+                        "join+aggregate (pushdown) dispatches vs N "
+                        "warm materialize-then-host-group-by passes "
+                        "of the same query (group by the join key, "
+                        "count + per-side payload sums), both graded "
+                        "against the pandas group-by oracle — one "
+                        "record under 'agg_ab' (docs/AGGREGATION.md). "
+                        "Shapes the pushdown refuses (string keys, "
+                        "the skew sidecar) skip with a named reason")
     p.add_argument("--resident-ab", type=int, default=0, metavar="N",
                    help="after the timed run: register the build "
                         "table as a resident image (service/"
@@ -569,6 +579,15 @@ def run(args) -> dict:
             comm, build, probe, join_key, args.resident_ab,
             dict(fixed_opts, **ladder.sizing()))
 
+    # --agg-ab: the materialization-sidestep lever measured in place
+    # (ROADMAP item 3 / docs/AGGREGATION.md): the fused pushdown vs
+    # materialize-then-host-group-by of the same aggregate query.
+    agg_ab = None
+    if args.agg_ab > 0:
+        agg_ab = _agg_ab(
+            comm, build, probe, join_key, args.agg_ab,
+            dict(fixed_opts, **ladder.sizing()), args)
+
     rows = b_rows + p_rows
     rows_per_sec = rows / sec_per_join
     record = {
@@ -605,6 +624,7 @@ def run(args) -> dict:
         "string_key_bytes": args.string_key_bytes,
         "string_wire_bytes": _string_wire_accounting(build, args.shuffle),
         "resident_ab": resident_ab,
+        "agg_ab": agg_ab,
         "tuned": tuned_rec,
         "matches_per_join": matches,
         "overflow": overflow,
@@ -706,6 +726,119 @@ def _resident_ab(comm, build, probe, join_key, n_joins, join_opts):
         "matches_probe_only": po_matches,
         "matches_equal": cold_matches == po_matches,
         "resident": registry.stats()["tables"]["driver_build"],
+    }
+
+
+def _agg_ab(comm, build, probe, join_key, n_joins, join_opts, args):
+    """The in-driver aggregation-pushdown A/B (docs/AGGREGATION.md):
+    the SAME aggregate query — group by the join key, count plus one
+    sum per side's first scalar payload — answered two ways. A-side
+    (the status quo): the warm materializing join, its full output
+    fetched to host and reduced with pandas. B-side (the lever): the
+    warm fused pushdown, its groups-sized result fetched. Both graded
+    against the pandas group-by oracle; the warm pushdown passes must
+    add zero traces. Refusable shapes skip with a NAMED reason. The
+    record carries the pushdown step's deterministic counter
+    signature (the agg_smoke baseline gate)."""
+    import numpy as np
+
+    from distributed_join_tpu.ops import aggregate as agg_ops
+    from distributed_join_tpu.parallel.distributed_join import (
+        JOIN_METRICS_SHARDED_OUT,
+        JOIN_SHARDED_OUT,
+    )
+    from distributed_join_tpu.service.programs import JoinProgramCache
+    from distributed_join_tpu.telemetry import baselines
+
+    if args.string_key_bytes:
+        return {"skipped": "string join keys: the fused pushdown "
+                           "covers scalar keys"}
+    if join_opts.get("skew_threshold") is not None:
+        return {"skipped": "skew sidecar on: the fused pushdown "
+                           "refuses the heavy-hitter path"}
+    keys = [join_key] if isinstance(join_key, str) else list(join_key)
+
+    def scalar_payload(t):
+        for nm, c in t.columns.items():
+            if nm not in keys and c.ndim == 1 \
+                    and not nm.endswith("#len"):
+                return nm
+        return None
+
+    bp, pp = scalar_payload(build), scalar_payload(probe)
+    aggs = [("count", None, "n_rows")]
+    if bp is not None:
+        aggs.append(("sum", bp, f"sum_{bp}"))
+    if pp is not None:
+        aggs.append(("sum", pp, f"sum_{pp}"))
+    spec = agg_ops.AggregateSpec.of(keys, aggs)
+
+    opts = {k: v for k, v in join_opts.items() if k != "key"}
+    mat_step = make_join_step(comm, key=join_key, **opts)
+    mat_fn = comm.spmd(mat_step, sharded_out=JOIN_SHARDED_OUT)
+
+    def run_materialize():
+        res = mat_fn(build, probe)
+        # The workload CONSUMES aggregates: the honest A-side wall
+        # includes pulling the full join output off the device and
+        # reducing it on host.
+        joined = res.table.to_pandas()
+        return res, agg_ops.group_reduce_frame(joined, spec)
+
+    cache = JoinProgramCache(comm)
+
+    def run_pushdown():
+        fn, _ = cache.get(build, probe, key=join_key,
+                          with_metrics=False, aggregate=spec, **opts)
+        res = fn(build, probe)
+        return res, agg_ops.groups_frame(res.table, spec, keys)
+
+    try:
+        mat_res, mat_frame = run_materialize()       # warm both
+        push_res, push_frame = run_pushdown()
+    except agg_ops.AggregatePushdownUnsupported as exc:
+        return {"skipped": str(exc)}
+    if bool(mat_res.overflow):
+        return {"skipped": "materializing join overflowed at this "
+                           "sizing; A-side frame would be partial — "
+                           "rerun with larger capacity factors"}
+    traces0 = cache.traces
+    mat_walls, push_walls = [], []
+    for _ in range(n_joins):
+        t0 = time.perf_counter()
+        mat_res, mat_frame = run_materialize()
+        mat_walls.append(time.perf_counter() - t0)
+    for _ in range(n_joins):
+        t0 = time.perf_counter()
+        push_res, push_frame = run_pushdown()
+        push_walls.append(time.perf_counter() - t0)
+    oracle = agg_ops.aggregate_oracle(build, probe, keys, spec)
+    # One metrics-instrumented pushdown pass (untimed): the
+    # deterministic counter signature the perfgate lane gates against
+    # results/baselines/agg_smoke.json.
+    mstep = make_join_step(comm, key=join_key, with_metrics=True,
+                           aggregate=spec, **opts)
+    mfn = comm.spmd(mstep, sharded_out=JOIN_METRICS_SHARDED_OUT)
+    _, metrics = mfn(build, probe)
+    return {
+        "kind": "agg_ab",
+        "n_joins": n_joins,
+        "n_ranks": comm.n_ranks,
+        "spec": spec.as_record(),
+        "matches": int(push_res.total),
+        "groups": int(np.asarray(push_res.table.valid).sum()),
+        "overflow": bool(push_res.overflow),
+        "materialize_wall_min_s": min(mat_walls),
+        "pushdown_wall_min_s": min(push_walls),
+        "pushdown_speedup": (min(mat_walls) / min(push_walls)
+                             if min(push_walls) else None),
+        "warm_pushdown_new_traces": cache.traces - traces0,
+        "oracle_equal_pushdown": agg_ops.frames_equal(push_frame,
+                                                      oracle),
+        "oracle_equal_materialize": agg_ops.frames_equal(mat_frame,
+                                                         oracle),
+        "counter_signature": baselines.counter_signature(
+            metrics.to_dict()),
     }
 
 

@@ -48,6 +48,15 @@ def parse_args(argv=None):
     p.add_argument("--iterations", type=int, default=4)
     p.add_argument("--q3-filters", action="store_true",
                    help="apply Q3's date predicates before the join")
+    p.add_argument("--agg", action="store_true",
+                   help="Q3/Q10-shaped aggregation pushdown: run the "
+                        "join as ONE fused join+group-by program — "
+                        "group by orderkey, revenue = "
+                        "sum(l_extendedprice), line count, carry "
+                        "o_orderdate — with zero materialization "
+                        "gathers (docs/AGGREGATION.md), graded "
+                        "against the pandas group-by oracle. "
+                        "Single-shot path only")
     p.add_argument("--batches", type=int, default=1,
                    help=">1 engages the out-of-core key-range path")
     p.add_argument("--host-generator", action="store_true",
@@ -134,6 +143,13 @@ def run(args) -> dict:
             "apply to the batched paths; add --batches > 1 or "
             "--host-generator"
         )
+    if args.agg and (args.batches > 1 or args.host_generator):
+        # The batched paths re-plan per key-range batch; the fused
+        # pushdown is a single compiled program. Refuse loudly.
+        raise SystemExit(
+            "--agg covers the single-shot path; the batched/"
+            "out-of-core paths materialize per batch — drop "
+            "--batches/--host-generator")
     if args.fetch_results and args.batches <= 1 and not args.host_generator:
         # The single-shot path times chained in-loop iterations whose
         # outputs never leave the device; silently dropping the flag
@@ -282,6 +298,23 @@ def run(args) -> dict:
             shuffle_capacity_factor=args.shuffle_capacity_factor,
             out_capacity_factor=args.out_capacity_factor,
         )
+        agg_spec = None
+        if args.agg:
+            # The Q3/Q10 shape: per-order revenue + line count +
+            # latest ship date, the order date carried (functionally
+            # dependent on the group key). One fused program — the
+            # 0.75N join output is never materialized.
+            from distributed_join_tpu.ops.aggregate import (
+                AggregateSpec,
+            )
+
+            agg_spec = AggregateSpec.of(
+                "key",
+                [("sum", "l_extendedprice", "revenue"),
+                 ("count", None, "n_lines"),
+                 ("max", "l_shipdate", "last_ship")],
+                carry=("o_orderdate",))
+            join_opts["aggregate"] = agg_spec
         step = make_join_step(comm, **join_opts)
         sec, matches, overflow = timed_join_throughput(
             comm, step, build, probe, args.iterations,
@@ -292,6 +325,37 @@ def run(args) -> dict:
         # digest-verified untimed step with the same discipline.
         collect_join_metrics(comm, build, probe, join_opts)
         extra_single = {}
+        if args.agg:
+            # Untimed oracle grading on the UNshifted inputs (the
+            # timed loop shifts keys): the fused program's groups must
+            # equal the pandas join+group-by — wrong sums refuse here,
+            # never land in the record as success.
+            import numpy as np
+
+            from distributed_join_tpu.ops.aggregate import (
+                aggregate_oracle,
+                frames_equal,
+                groups_frame,
+            )
+            from distributed_join_tpu.parallel.distributed_join import (
+                JOIN_SHARDED_OUT,
+            )
+
+            fn = comm.spmd(step, sharded_out=JOIN_SHARDED_OUT)
+            res = fn(build, probe)
+            got = groups_frame(res.table, agg_spec, ["key"])
+            want = aggregate_oracle(build, probe, "key", agg_spec)
+            oracle_ok = frames_equal(got, want)
+            if not oracle_ok and not bool(res.overflow):
+                raise SystemExit(
+                    "--agg: fused group-by diverged from the pandas "
+                    "oracle — refusing to report wrong aggregates")
+            extra_single["agg"] = True
+            extra_single["aggregate"] = dict(
+                agg_spec.as_record(),
+                groups=int(np.asarray(res.table.valid).sum()),
+                oracle_equal=oracle_ok,
+            )
         if args.verify_integrity:
             extra_single["integrity"] = collect_integrity(
                 comm, build, probe, join_opts)

@@ -1,0 +1,779 @@
+"""Fused join+aggregate: segment reductions in the merged domain.
+
+The TPC-H-shaped workloads PAPER.md drives (Q3/Q10: join -> group-by)
+consume AGGREGATES, not rows — yet the join pipeline materializes the
+full 0.75N output first, and docs/ROOFLINE.md §1-§3 measured that
+materialization as the dominant irreducible cost on v5e: the two
+packed output row-gathers run at ~21 ns/element (~0.4 GB/s effective)
+while ``lax.sort`` value lanes ride almost free (+6 ms per i64 lane on
+a 139 ms sort). This module is the lever that SIDESTEPS that floor
+instead of fighting it: reduce in the merged/compacted domain and
+never run the output gathers at all.
+
+The algebra that makes it cheap: after the join's merged sort, every
+equal-key run holds B build rows followed by P probe rows, and the
+inner join of that run is the full B x P cross product. So per run:
+
+- ``COUNT(*)            = B * P``
+- ``SUM(probe_col)      = B * sum_over_probes(col)``
+- ``SUM(build_col)      = P * sum_over_builds(col)``
+- ``MIN/MAX(col)        = min/max over the column's own side`` (each
+  side's rows all participate when the other side is non-empty)
+- ``MEAN = SUM / COUNT`` (two lanes, finalized after the last combine)
+
+All of it falls out of SEGMENTED SCANS over the already-sorted merged
+domain — log-shift (Hillis-Steele) passes of elementwise combine+shift,
+the same doubling idiom as ops/compact_planes.py, with zero gathers and
+zero scatters. Group keys equal to the join keys ("key mode") need no
+extra sort at all: the merged sort IS the group order, and hash
+partitioning already co-locates each group on one rank — per-rank
+partials are final, no second shuffle. Non-key group-bys ("probe
+mode": group columns live on the probe side) pay one extra
+value-carrying sort by group key plus a cross-rank exchange of the
+per-group PARTIALS — wire bytes collapse from O(output rows) to
+O(groups).
+
+Refusal contract: shapes this pushdown cannot fuse (build-side group
+columns, aggregates over the join key itself, 2-D/string columns, a
+column present on both sides, float group keys) raise
+:class:`AggregatePushdownUnsupported` with a named reason — callers
+fall back to the materializing join; wrong sums are never returned.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_join_tpu.table import Table
+
+AGG_OPS = ("sum", "count", "min", "max", "mean")
+
+# Internal partial-lane suffixes (the '#len' companion idiom): a mean
+# rides as two combinable lanes until the LAST combine divides them.
+SUM_SUFFIX = "#sum"
+CNT_SUFFIX = "#cnt"
+
+_I32_MAX = 2**31 - 1
+
+
+class AggregatePushdownUnsupported(ValueError):
+    """This (spec, schema) shape cannot ride the fused pushdown — the
+    message names the reason; run the materializing join instead."""
+
+
+@dataclasses.dataclass(frozen=True)
+class AggExpr:
+    """One aggregate output: ``op`` over ``column`` (None for count),
+    emitted as output column ``name``."""
+
+    op: str
+    column: Optional[str]
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateSpec:
+    """The pushdown contract of one fused join+aggregate query.
+
+    ``group_keys``: the GROUP BY columns. Exactly the join key(s) ->
+    key mode (no extra sort, no second shuffle); probe-side payload
+    columns -> probe mode (one regroup sort + a partials-only
+    exchange). ``aggs``: the :class:`AggExpr` outputs. ``carry``:
+    columns functionally dependent on the group key (Q3's
+    o_orderdate/o_shippriority), carried as any-value-per-group.
+    ``groups_per_rank``: static per-rank partial-groups capacity; None
+    derives it from the join's out capacity (always sufficient —
+    groups <= matches — at the cost of a larger partials block; size
+    it explicitly to collapse the wire). Hashable and repr-stable by
+    construction: it rides :class:`~..service.programs.JoinSignature`
+    verbatim, so aggregate queries cache/serve warm as their own
+    workloads.
+    """
+
+    group_keys: tuple
+    aggs: tuple
+    carry: tuple = ()
+    groups_per_rank: Optional[int] = None
+
+    @classmethod
+    def of(cls, group_by, aggs, carry=(), groups_per_rank=None
+           ) -> "AggregateSpec":
+        """Normalize loose forms: ``group_by`` a name or sequence;
+        ``aggs`` entries may be ``AggExpr``, ``"count"``, ``(op,
+        column)`` or ``(op, column, name)``."""
+        gk = ((group_by,) if isinstance(group_by, str)
+              else tuple(group_by))
+        out = []
+        for a in aggs:
+            if isinstance(a, AggExpr):
+                out.append(a)
+                continue
+            if isinstance(a, str):
+                a = (a, None)
+            op = a[0]
+            column = a[1] if len(a) > 1 else None
+            name = a[2] if len(a) > 2 else (
+                "count" if op == "count" else f"{op}_{column}")
+            out.append(AggExpr(op=op, column=column, name=name))
+        return cls(group_keys=gk, aggs=tuple(out), carry=tuple(carry),
+                   groups_per_rank=(int(groups_per_rank)
+                                    if groups_per_rank else None))
+
+    @classmethod
+    def from_wire(cls, spec: dict) -> "AggregateSpec":
+        """The daemon's wire form: ``{"group_by": [...], "aggs":
+        [["sum", "col"], ["count"], ...], "carry": [...],
+        "groups_per_rank": N}``."""
+        return cls.of(
+            spec["group_by"],
+            [tuple(a) if not isinstance(a, str) else a
+             for a in spec.get("aggs") or ()],
+            carry=tuple(spec.get("carry") or ()),
+            groups_per_rank=spec.get("groups_per_rank"),
+        )
+
+    def as_record(self) -> dict:
+        return {
+            "group_keys": list(self.group_keys),
+            "aggs": [[a.op, a.column, a.name] for a in self.aggs],
+            "carry": list(self.carry),
+            "groups_per_rank": self.groups_per_rank,
+        }
+
+
+# -- spec validation (schema-level: shared by the step AND the plan) ---
+
+
+def _refuse(reason: str):
+    raise AggregatePushdownUnsupported(
+        f"aggregate pushdown unsupported: {reason}")
+
+
+def resolve_agg_mode(spec: AggregateSpec, keys: Sequence[str],
+                     build_cols: dict, probe_cols: dict) -> str:
+    """Validate ``spec`` against the join and return the fused mode:
+    ``"key"`` (group keys == join keys: reduce in the merged order,
+    partials final per rank) or ``"probe"`` (probe-side group columns:
+    one regroup sort + a partials-only cross-rank exchange).
+
+    ``build_cols``/``probe_cols`` map column name ->
+    ``(dtype_str, ndim)`` — pure schema, so :mod:`..planning.plan`
+    validates the identical contract without touching arrays. Every
+    refusal names its reason (:class:`AggregatePushdownUnsupported`).
+    """
+    keys = list(keys)
+    if not spec.group_keys:
+        _refuse("empty group_keys")
+    if not spec.aggs:
+        _refuse("no aggregate expressions")
+    if len(set(spec.group_keys)) != len(spec.group_keys):
+        _refuse("duplicate group_keys")
+    names = [a.name for a in spec.aggs]
+    out_names = list(spec.group_keys) + names + list(spec.carry)
+    if len(set(out_names)) != len(out_names):
+        _refuse(f"output name collision in {sorted(out_names)}")
+    for nm in names:
+        if nm.startswith("__") or "#" in nm:
+            _refuse(f"aggregate name {nm!r} uses reserved characters")
+    if spec.groups_per_rank is not None and spec.groups_per_rank < 1:
+        _refuse("groups_per_rank must be >= 1")
+
+    def side_of(col: str, what: str) -> str:
+        if col in keys:
+            _refuse(f"{what} {col!r} is a join key column; join keys "
+                    "ride as group keys, not aggregate inputs")
+        b, p = col in build_cols, col in probe_cols
+        if b and p:
+            _refuse(f"{what} {col!r} exists on BOTH sides — rename "
+                    "one side")
+        if not (b or p):
+            _refuse(f"{what} {col!r} not found on either side")
+        dtype, ndim = (build_cols if b else probe_cols)[col]
+        if ndim != 1:
+            _refuse(f"{what} {col!r} is {ndim}-D; pushdown covers "
+                    "scalar columns")
+        return "b" if b else "p"
+
+    for a in spec.aggs:
+        if a.op not in AGG_OPS:
+            _refuse(f"unknown aggregate op {a.op!r} (have {AGG_OPS})")
+        if a.op == "count":
+            if a.column is not None:
+                _refuse("count takes no column")
+            continue
+        if a.column is None:
+            _refuse(f"{a.op} needs a column")
+        side_of(a.column, f"aggregate column")
+
+    if tuple(spec.group_keys) == tuple(keys):
+        for c in spec.carry:
+            side_of(c, "carry column")
+        return "key"
+
+    # probe mode: every group key must be a scalar probe-side column
+    # (join keys exist on the probe side too, so subsets route here).
+    for g in spec.group_keys:
+        if g in keys:
+            # a strict subset of a composite key is probe-resolvable
+            # only through the probe's copy of that key column.
+            if g not in probe_cols:
+                _refuse(f"group key {g!r} (a join key) has no "
+                        "probe-side column to regroup by")
+            dtype, ndim = probe_cols[g]
+        elif g in probe_cols:
+            dtype, ndim = probe_cols[g]
+        elif g in build_cols:
+            _refuse(f"group key {g!r} lives on the BUILD side; "
+                    "build-side group-bys are unimplemented — group "
+                    "by the join key and carry the column instead")
+        else:
+            _refuse(f"group key {g!r} not found")
+        if ndim != 1:
+            _refuse(f"group key {g!r} is {ndim}-D")
+        if not str(dtype).startswith(("int", "uint")):
+            _refuse(f"group key {g!r} has dtype {dtype}; non-key "
+                    "group keys must be integers (hash-partitioned "
+                    "partials exchange)")
+    for c in spec.carry:
+        if side_of(c, "carry column") != "p":
+            _refuse(f"carry column {c!r} lives on the build side; "
+                    "under a non-key group-by only probe-side "
+                    "carries are functionally sound")
+    return "probe"
+
+
+def partial_lane_schema(spec: AggregateSpec, build_cols: dict,
+                        probe_cols: dict) -> tuple:
+    """The combinable partial lanes, in output order:
+    ``((lane_name, combine_op, source_column_or_None, dtype_str),...)``
+    — ``combine_op`` in {"sum", "min", "max", "first"}. One
+    definition shared by the device step and the plan's wire/memory
+    accounting so the two can never drift."""
+    def dtype_of(col):
+        d, _ = build_cols.get(col) or probe_cols[col]
+        return str(d)
+
+    def acc_dtype(col):
+        d = dtype_of(col)
+        return d if d.startswith("float") else "int64"
+
+    lanes = []
+    for a in spec.aggs:
+        if a.op == "count":
+            lanes.append((a.name, "sum", None, "int64"))
+        elif a.op == "sum":
+            lanes.append((a.name, "sum", a.column,
+                          acc_dtype(a.column)))
+        elif a.op in ("min", "max"):
+            lanes.append((a.name, a.op, a.column, dtype_of(a.column)))
+        elif a.op == "mean":
+            lanes.append((a.name + SUM_SUFFIX, "sum", a.column,
+                          acc_dtype(a.column)))
+            lanes.append((a.name + CNT_SUFFIX, "sum", None, "int64"))
+    for c in spec.carry:
+        lanes.append((c, "first", c, dtype_of(c)))
+    return tuple(lanes)
+
+
+def wire_columns(spec: AggregateSpec, mode: str, keys: Sequence[str],
+                 build_cols: dict, probe_cols: dict) -> tuple:
+    """THE one resolution of which columns each side actually
+    partitions + shuffles under pushdown — the join keys plus exactly
+    the columns the fused reduction reads (aggregate inputs, probe
+    group keys in probe mode, carries). Shared by the device step and
+    :func:`..planning.plan.build_plan`'s wire accounting so the two
+    can never drift. Returns ``(build_names, probe_names)``,
+    name-sorted with keys first (the shuffle bills per column; order
+    is cosmetic but deterministic)."""
+    keys = list(keys)
+    need_b, need_p = set(), set()
+    for a in spec.aggs:
+        if a.column is None:
+            continue
+        (need_b if a.column in build_cols else need_p).add(a.column)
+    for c in spec.carry:
+        (need_b if c in build_cols else need_p).add(c)
+    if mode == "probe":
+        for g in spec.group_keys:
+            need_p.add(g)
+    return (tuple(keys) + tuple(sorted(need_b - set(keys))),
+            tuple(keys) + tuple(sorted(need_p - set(keys))))
+
+
+def partial_columns(spec: AggregateSpec, mode: str,
+                    keys: Sequence[str], build_cols: dict,
+                    probe_cols: dict) -> tuple:
+    """The physical columns of the per-rank PARTIALS table (group key
+    columns then combinable lanes) as ``((name, dtype_str), ...)`` —
+    the wire schema of the probe-mode partials exchange, shared by the
+    step's tape billing mirror in planning and the docs' accounting
+    story."""
+    group_names = (tuple(keys) if mode == "key"
+                   else tuple(spec.group_keys))
+    cols = []
+    for g in group_names:
+        d, _ = (probe_cols.get(g) if mode == "probe"
+                else build_cols.get(g) or probe_cols.get(g))
+        cols.append((g, str(d)))
+    for name, _op, _col, dt in partial_lane_schema(spec, build_cols,
+                                                   probe_cols):
+        cols.append((name, str(dt)))
+    return tuple(cols)
+
+
+def resolve_groups_capacity(spec: AggregateSpec, out_cap: int) -> int:
+    """THE one per-rank partial-groups capacity resolution (step and
+    plan agree by construction): the caller's ``groups_per_rank``, or
+    the join's out capacity (groups <= matches, so the derived value
+    inherits the ladder's doubling on overflow)."""
+    g = spec.groups_per_rank if spec.groups_per_rank else out_cap
+    return max((int(g) + 7) // 8 * 8, 8)
+
+
+def table_schema(table: Table) -> dict:
+    """{name: (dtype_str, ndim)} of a Table — the validation basis."""
+    return {name: (str(c.dtype), int(c.ndim))
+            for name, c in table.columns.items()}
+
+
+# -- segmented scans (log-shift doubling; no gathers, no scatters) -----
+
+
+def _sentinel_max(dt):
+    if jnp.issubdtype(dt, jnp.integer):
+        return jnp.asarray(jnp.iinfo(dt).max, dtype=dt)
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.asarray(jnp.inf, dtype=dt)
+    raise TypeError(f"unsupported aggregate dtype {dt}")
+
+
+def _sentinel_min(dt):
+    if jnp.issubdtype(dt, jnp.integer):
+        return jnp.asarray(jnp.iinfo(dt).min, dtype=dt)
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.asarray(-jnp.inf, dtype=dt)
+    raise TypeError(f"unsupported aggregate dtype {dt}")
+
+
+def _shift(x: jax.Array, d: int) -> jax.Array:
+    """x[i-d] with zeros shifted in (the shifted-in values are never
+    taken: ``i - d >= seg_start >= 0`` fails for i < d)."""
+    return jnp.concatenate(
+        [jnp.zeros((d,), x.dtype), x[:-d]]) if d < x.shape[0] \
+        else jnp.zeros_like(x)
+
+
+def seg_start(first: jax.Array) -> jax.Array:
+    """Per-position index of its segment's first element — a cummax
+    broadcast of the (non-decreasing) run-start iota."""
+    n = first.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    return lax.cummax(jnp.where(first, iota, 0))
+
+
+def seg_scan(x: jax.Array, seg0: jax.Array, op: str) -> jax.Array:
+    """Inclusive segmented scan by log-shift doubling: ceil(log2 n)
+    elementwise combine+shift passes (each a sequential HBM stream —
+    ROOFLINE §1's cheap class), any associative ``op`` in
+    {"sum", "min", "max"}."""
+    n = x.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    combine = {"sum": jnp.add, "min": jnp.minimum,
+               "max": jnp.maximum}[op]
+    d = 1
+    while d < n:
+        take = (iota - d) >= seg0
+        x = jnp.where(take, combine(_shift(x, d), x), x)
+        d *= 2
+    return x
+
+
+def seg_first(v: jax.Array, flag: jax.Array, seg0: jax.Array):
+    """Inclusive segmented first-valid scan: at each position, the
+    value of the segment's FIRST row with ``flag`` set (and whether
+    one exists). Associative left-priority combine, doubled."""
+    n = v.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    d = 1
+    while d < n:
+        take = (iota - d) >= seg0
+        pv, pf = _shift(v, d), _shift(flag, d)
+        use_prev = take & pf
+        v = jnp.where(use_prev, pv, v)
+        flag = jnp.where(take, pf | flag, flag)
+        d *= 2
+    return v, flag
+
+
+# -- run extraction + compaction ---------------------------------------
+
+
+def _run_last(first: jax.Array) -> jax.Array:
+    n = first.shape[0]
+    return jnp.concatenate(
+        [first[1:], jnp.ones((1,), dtype=bool)]) if n > 1 \
+        else jnp.ones((1,), dtype=bool)
+
+
+def _compact_runs(is_rec: jax.Array, cols: list, out_capacity: int):
+    """Compact run-last records to a dense prefix with ONE sort keyed
+    by the record's running index (strictly increasing over records,
+    so keys are unique) — the join's record-compaction idiom, sized to
+    groups instead of output rows. ``cols`` is ``[(name, arr), ...]``;
+    returns ``(dict name -> (out_capacity,) arr, valid, groups_total,
+    overflow)``."""
+    n = is_rec.shape[0]
+    groups_total = jnp.sum(is_rec.astype(jnp.int64))
+    rec_idx = jnp.cumsum(is_rec.astype(jnp.int32)) - 1
+    rkey = jnp.where(is_rec, rec_idx, jnp.int32(_I32_MAX))
+    sorted_r = lax.sort((rkey, *[c for _, c in cols]), num_keys=1)
+
+    def _prefix(a):
+        if n >= out_capacity:
+            return a[:out_capacity]
+        pad = jnp.zeros((out_capacity - n,), dtype=a.dtype)
+        return jnp.concatenate([a, pad])
+
+    j = jnp.arange(out_capacity, dtype=jnp.int32)
+    kept = jnp.minimum(groups_total, jnp.int64(out_capacity))
+    out = {name: _prefix(c)
+           for (name, _), c in zip(cols, sorted_r[1:])}
+    valid = j.astype(jnp.int64) < kept
+    return out, valid, groups_total, groups_total > out_capacity
+
+
+def _reduce_sorted(group_vals: list, lanes: list, part: jax.Array,
+                   out_capacity: int):
+    """Group-reduce rows that are NOT yet grouped: one value-carrying
+    sort by (participation tag, group columns), segmented scans per
+    lane, run-last extraction, compaction. ``group_vals`` is
+    ``[(name, arr)]`` (become sort keys AND output columns);
+    ``lanes`` is ``[(name, op, arr)]`` with op in
+    {"sum","min","max","first"}; ``part`` masks contributing rows.
+    The shared machinery of probe-mode local reduction, cross-batch
+    combines, and the post-exchange combine."""
+    tag = jnp.where(part, jnp.int8(0), jnp.int8(1))
+    ops = (tag, *[g for _, g in group_vals])
+    vals = [v for _, _, v in lanes]
+    nk = 1 + len(group_vals)
+    sorted_all = lax.sort((*ops, *vals), num_keys=nk)
+    stag = sorted_all[0]
+    sgroups = sorted_all[1:nk]
+    svals = sorted_all[nk:]
+    spart = stag == jnp.int8(0)
+
+    n = stag.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    changed = jnp.zeros((n,), dtype=bool)
+    for skc in (stag, *sgroups):
+        prev = jnp.concatenate([skc[:1], skc[:-1]])
+        changed = changed | (skc != prev)
+    first = changed | (iota == 0)
+    seg0 = seg_start(first)
+
+    part_cnt = seg_scan(spart.astype(jnp.int32), seg0, "sum")
+    reduced = []
+    for (name, op, _), sv in zip(lanes, svals):
+        if op == "sum":
+            x = seg_scan(jnp.where(spart, sv,
+                                   jnp.zeros((), sv.dtype)), seg0,
+                         "sum")
+        elif op in ("min", "max"):
+            ident = (_sentinel_max(sv.dtype) if op == "min"
+                     else _sentinel_min(sv.dtype))
+            x = seg_scan(jnp.where(spart, sv, ident), seg0, op)
+        else:  # first
+            x, _ = seg_first(sv, spart, seg0)
+        reduced.append((name, x))
+
+    is_rec = _run_last(first) & spart & (part_cnt > 0)
+    cols = ([(nm, g) for (nm, _), g in zip(group_vals, sgroups)]
+            + reduced)
+    return _compact_runs(is_rec, cols, out_capacity)
+
+
+# -- the local fused op ------------------------------------------------
+
+
+def local_join_aggregate(build: Table, probe: Table,
+                         keys: Sequence[str], spec: AggregateSpec,
+                         mode: str, groups_capacity: int):
+    """One shard's fused join+aggregate: the join's merged sort with
+    every needed column riding as a value lane, segmented scans in
+    place of record-expansion, and one groups-sized compaction sort —
+    ZERO materialization gathers. Returns ``(partials: Table, total,
+    groups_total, overflow)`` where ``partials`` carries the
+    combinable lanes of :func:`partial_lane_schema` (finalize with
+    :func:`finalize_groups` after the last combine)."""
+    keys = list(keys)
+    bcols, pcols = table_schema(build), table_schema(probe)
+    lanes_schema = partial_lane_schema(spec, bcols, pcols)
+
+    def side_of(col):
+        return "b" if col in build.columns else "p"
+
+    # Every column the reduction reads, one physical lane per
+    # (side, column) — group columns (probe mode), aggregate inputs,
+    # carries.
+    needed = {}
+    for _, op, col, _dt in lanes_schema:
+        if col is not None:
+            needed[(side_of(col), col)] = None
+    if mode == "probe":
+        for g in spec.group_keys:
+            needed[("p", g)] = None
+
+    nb_rows, np_rows = build.capacity, probe.capacity
+    bvalid, pvalid = build.valid, probe.valid
+
+    m_ops = []
+    for kname in keys:
+        b, p = build.columns[kname], probe.columns[kname]
+        sentinel = _sentinel_max(b.dtype)
+        m_ops.append(jnp.concatenate([
+            jnp.where(bvalid, b, sentinel),
+            jnp.where(pvalid, p, sentinel),
+        ]))
+    tag = jnp.concatenate([
+        jnp.where(bvalid, jnp.int8(0), jnp.int8(2)),
+        jnp.where(pvalid, jnp.int8(1), jnp.int8(2)),
+    ])
+    m_vals, m_names = [], []
+    for (side, col) in needed:
+        c = (build if side == "b" else probe).columns[col]
+        if side == "b":
+            m_vals.append(jnp.concatenate(
+                [c, jnp.zeros((np_rows,), dtype=c.dtype)]))
+        else:
+            m_vals.append(jnp.concatenate(
+                [jnp.zeros((nb_rows,), dtype=c.dtype), c]))
+        m_names.append((side, col))
+    sorted_m = lax.sort((*m_ops, tag, *m_vals),
+                        num_keys=len(keys) + 1)
+    skeys = sorted_m[:len(keys)]
+    stag = sorted_m[len(keys)]
+    svals = dict(zip(m_names, sorted_m[len(keys) + 1:]))
+
+    n = nb_rows + np_rows
+    iota = jnp.arange(n, dtype=jnp.int32)
+    changed = jnp.zeros((n,), dtype=bool)
+    for sk in skeys:
+        prev = jnp.concatenate([sk[:1], sk[:-1]])
+        changed = changed | (sk != prev)
+    first = changed | (iota == 0)
+    seg0 = seg_start(first)
+
+    is_build = stag == jnp.int8(0)
+    is_probe = stag == jnp.int8(1)
+    # All builds of a run precede its probes (tag order), so at any
+    # probe position the inclusive build count/sum covers the WHOLE
+    # run's build side.
+    b_cnt = seg_scan(is_build.astype(jnp.int32), seg0, "sum")
+    # The join total the materializing pipeline would produce:
+    # sum over probe rows of their run's build count = sum_runs B*P.
+    total = jnp.sum(jnp.where(is_probe, b_cnt, 0).astype(jnp.int64))
+
+    def build_scan(col, op):
+        v = svals[("b", col)]
+        if op == "sum":
+            acc = jnp.dtype(
+                v.dtype if jnp.issubdtype(v.dtype, jnp.floating)
+                else jnp.int64)
+            return seg_scan(
+                jnp.where(is_build, v.astype(acc),
+                          jnp.zeros((), acc)), seg0, "sum")
+        ident = (_sentinel_max(v.dtype) if op == "min"
+                 else _sentinel_min(v.dtype))
+        return seg_scan(jnp.where(is_build, v, ident), seg0, op)
+
+    if mode == "key":
+        p_cnt = seg_scan(is_probe.astype(jnp.int32), seg0, "sum")
+        reduced = []
+        for lane_name, op, col, dt in lanes_schema:
+            adt = jnp.dtype(dt)
+            if op == "sum" and col is None:       # count-style lane
+                x = b_cnt.astype(adt) * p_cnt.astype(adt)
+            elif op == "sum":
+                if side_of(col) == "p":
+                    s = seg_scan(jnp.where(
+                        is_probe, svals[("p", col)].astype(adt),
+                        jnp.zeros((), adt)), seg0, "sum")
+                    x = s * b_cnt.astype(adt)
+                else:
+                    x = build_scan(col, "sum").astype(adt) \
+                        * p_cnt.astype(adt)
+            elif op in ("min", "max"):
+                if side_of(col) == "p":
+                    v = svals[("p", col)]
+                    ident = (_sentinel_max(v.dtype) if op == "min"
+                             else _sentinel_min(v.dtype))
+                    x = seg_scan(jnp.where(is_probe, v, ident),
+                                 seg0, op)
+                else:
+                    x = build_scan(col, op)
+            else:  # first (carry; either side in key mode)
+                sd = side_of(col)
+                flag = is_build if sd == "b" else is_probe
+                x, _ = seg_first(svals[(sd, col)], flag, seg0)
+            reduced.append((lane_name, x))
+        is_rec = _run_last(first) & (b_cnt > 0) \
+            & (seg_scan(is_probe.astype(jnp.int32), seg0, "sum") > 0)
+        cols = ([(kname, sk) for kname, sk in zip(keys, skeys)]
+                + reduced)
+        groups, valid, g_total, overflow = _compact_runs(
+            is_rec, cols, groups_capacity)
+        group_names = keys
+    else:
+        # probe mode: per-probe-row contributions in the merged
+        # domain, then ONE regroup sort by the group columns (value
+        # lanes ride ~free, ROOFLINE §1) and the same segmented
+        # reduce.
+        part = is_probe & (b_cnt > 0)
+        lanes = []
+        for lane_name, op, col, dt in lanes_schema:
+            adt = jnp.dtype(dt)
+            if op == "sum" and col is None:
+                contrib = b_cnt.astype(adt)
+            elif op == "sum":
+                if side_of(col) == "p":
+                    contrib = svals[("p", col)].astype(adt) \
+                        * b_cnt.astype(adt)
+                else:
+                    contrib = build_scan(col, "sum").astype(adt)
+            elif op in ("min", "max"):
+                if side_of(col) == "p":
+                    contrib = svals[("p", col)]
+                else:
+                    contrib = build_scan(col, op)
+            else:  # first: probe-side carry
+                contrib = svals[("p", col)]
+            lanes.append((lane_name, op, contrib))
+        group_vals = [(g, svals[("p", g)]) for g in spec.group_keys]
+        groups, valid, g_total, overflow = _reduce_sorted(
+            group_vals, lanes, part, groups_capacity)
+        group_names = list(spec.group_keys)
+
+    cols = {nm: groups[nm] for nm in group_names}
+    for lane_name, _, _, _ in lanes_schema:
+        cols[lane_name] = groups[lane_name]
+    return Table(cols, valid), total, g_total, overflow
+
+
+def combine_partials(tables: Sequence[Table], spec: AggregateSpec,
+                     group_names: Sequence[str], lanes_schema,
+                     out_capacity: int):
+    """Merge partial-groups tables (cross-batch, or the received block
+    of the cross-rank partials exchange) into one: concatenate, sort
+    by group, segmented-combine each lane by ITS op (sums add, mins
+    min, carries keep any), compact. Returns ``(partials, groups_total,
+    overflow)``."""
+    cat = tables[0] if len(tables) == 1 else Table(
+        {nm: jnp.concatenate([t.columns[nm] for t in tables])
+         for nm in tables[0].column_names},
+        jnp.concatenate([t.valid for t in tables]),
+    )
+    group_vals = [(nm, cat.columns[nm]) for nm in group_names]
+    lanes = [(nm, op, cat.columns[nm])
+             for nm, op, _, _ in lanes_schema]
+    groups, valid, g_total, overflow = _reduce_sorted(
+        group_vals, lanes, cat.valid, out_capacity)
+    cols = {nm: groups[nm] for nm in group_names}
+    for nm, _, _, _ in lanes_schema:
+        cols[nm] = groups[nm]
+    return Table(cols, valid), g_total, overflow
+
+
+def group_reduce_frame(joined, spec: AggregateSpec):
+    """Host group-by of an already-joined DataFrame — the "materialize
+    then reduce on host" half of the driver's ``--agg-ab``, and the
+    reduction shared with :func:`aggregate_oracle`. Returns one row
+    per group (group keys, aggregates, carries), sorted by the group
+    keys."""
+    gk = list(spec.group_keys)
+    out = joined.groupby(gk, as_index=False).size()[gk]
+    grouped = joined.groupby(gk)
+    for a in spec.aggs:
+        if a.op == "count":
+            col = grouped.size()
+        elif a.op == "mean":
+            col = grouped[a.column].sum() / grouped.size()
+        else:
+            col = getattr(grouped[a.column], a.op)()
+        out[a.name] = col.reset_index(drop=True)
+    for c in spec.carry:
+        out[c] = grouped[c].first().reset_index(drop=True)
+    return out.sort_values(gk).reset_index(drop=True)
+
+
+def aggregate_oracle(build: Table, probe: Table, keys, spec:
+                     AggregateSpec):
+    """THE one pandas reference of the fused pipeline (host-side, NOT
+    jittable): materialize the inner join, group by ``spec.group_keys``
+    and reduce — what every pushdown variant is graded against (tests,
+    the join driver's ``--agg-ab``, the tpch driver's ``--agg``).
+    Returns a DataFrame with one row per group, columns in the
+    pushdown's output order (group keys, aggregates, carries), sorted
+    by the group keys."""
+    keys = [keys] if isinstance(keys, str) else list(keys)
+    joined = build.to_pandas().merge(probe.to_pandas(), on=keys,
+                                     how="inner")
+    return group_reduce_frame(joined, spec)
+
+
+def frames_equal(got, want) -> bool:
+    """Tolerant equality of a pushdown groups frame vs the oracle
+    frame (same columns; integer lanes exact, float lanes allclose) —
+    the grading predicate the drivers and tests share."""
+    import numpy as np
+
+    if len(got) != len(want) or list(got.columns) != \
+            list(want.columns):
+        return False
+    for c in want.columns:
+        g = got[c].to_numpy()
+        w = want[c].to_numpy()
+        if np.issubdtype(w.dtype, np.floating) or \
+                np.issubdtype(g.dtype, np.floating):
+            if not np.allclose(g.astype(float), w.astype(float)):
+                return False
+        elif not (g.astype(np.int64) == w.astype(np.int64)).all():
+            return False
+    return True
+
+
+def groups_frame(table: Table, spec: AggregateSpec, group_names):
+    """A finalized pushdown result Table (``JoinResult.table`` of an
+    aggregate query) as a DataFrame in oracle order: columns
+    re-ordered to (group keys, aggregates, carries) — jax's pytree
+    dict flattening key-sorts a jitted Table's columns — and rows
+    sorted by the group keys."""
+    df = table.to_pandas()
+    gk = list(group_names)
+    order = gk + [a.name for a in spec.aggs] + list(spec.carry)
+    return df[order].sort_values(gk).reset_index(drop=True)
+
+
+def finalize_groups(partials: Table, spec: AggregateSpec,
+                    group_names: Sequence[str]) -> Table:
+    """The LAST step after every combine settled: divide the mean
+    lanes, drop internals, order columns (group keys, aggregates,
+    carries)."""
+    cols = {nm: partials.columns[nm] for nm in group_names}
+    for a in spec.aggs:
+        if a.op == "mean":
+            s = partials.columns[a.name + SUM_SUFFIX]
+            c = partials.columns[a.name + CNT_SUFFIX]
+            fdt = (s.dtype if jnp.issubdtype(s.dtype, jnp.floating)
+                   else jnp.float32)
+            c_safe = jnp.maximum(c, jnp.int64(1)).astype(fdt)
+            cols[a.name] = s.astype(fdt) / c_safe
+        else:
+            cols[a.name] = partials.columns[a.name]
+    for c in spec.carry:
+        cols[c] = partials.columns[c]
+    return Table(cols, partials.valid)

@@ -152,12 +152,40 @@ def make_join_step(
     shuffle: str = "padded",
     compression_bits: Optional[int] = None,
     dcn_codec: str = "auto",
+    aggregate=None,
     kernel_config=None,
     with_metrics: bool = False,
     with_integrity: bool = False,
     metrics_static: Optional[dict] = None,
 ):
     """The raw per-rank join step (partition -> shuffle -> local join).
+
+    ``aggregate`` (an :class:`~..ops.aggregate.AggregateSpec`, or
+    None): the FUSED join+aggregate pipeline (docs/AGGREGATION.md).
+    The step then reduces in the merged/compacted domain — segment
+    scans ride the join's own sorts — and NEVER runs the output
+    row-gathers that dominate materialization (docs/ROOFLINE.md
+    §1-§3): only the columns the reduction reads are partitioned and
+    shuffled, the local result is a per-group PARTIALS block of
+    ``ops.aggregate.resolve_groups_capacity`` rows, and the returned
+    ``JoinResult.table`` holds finalized per-group aggregates (group
+    keys, aggregate outputs, carries; ``.valid`` marks real groups)
+    instead of joined rows. ``total`` stays the row count the
+    materializing join WOULD have produced — free from the run
+    algebra, and the oracle/accounting anchor. Group keys equal to the
+    join keys ("key mode") are co-located by hash partitioning, so
+    per-rank partials are final — no second exchange; probe-side
+    group-bys ("probe mode") exchange only the tiny per-group partials
+    (one groups-sized padded collective — hierarchical routing on a
+    multi-slice mesh — billed under the ``partials.*`` counters), so
+    wire bytes collapse from O(output rows) to O(groups). A partials
+    block too small for the distinct groups raises the overflow flag
+    (rows are dropped loudly, never wrong sums); the ladder's
+    out-capacity escalation grows the derived block. Shapes the fused
+    pipeline cannot cover (the skew sidecar, string/2-D keys, explicit
+    payload lists, build-side group-bys...) refuse with a named
+    :class:`~..ops.aggregate.AggregatePushdownUnsupported` — callers
+    fall back to the materializing join.
 
     ``shuffle``: "padded" (capacity-padded all_to_all, the default),
     "ragged" (exact-size ``lax.ragged_all_to_all`` — wire bytes equal
@@ -296,6 +324,42 @@ def make_join_step(
     nb = k * n
 
     keys = [key] if isinstance(key, str) else list(key)
+
+    if aggregate is not None:
+        from distributed_join_tpu.ops import aggregate as agg_ops
+
+        if not isinstance(aggregate, agg_ops.AggregateSpec):
+            raise TypeError(
+                "aggregate must be an ops.aggregate.AggregateSpec "
+                f"(got {type(aggregate).__name__}); build one with "
+                "AggregateSpec.of(group_by, aggs, ...)")
+        if skew_threshold is not None:
+            raise agg_ops.AggregatePushdownUnsupported(
+                "aggregate pushdown unsupported: the skew sidecar "
+                "joins heavy hitters through a separate output block "
+                "the fused reduction does not cover — run skewed "
+                "workloads through the materializing join")
+        if build_payload is not None or probe_payload is not None:
+            raise agg_ops.AggregatePushdownUnsupported(
+                "aggregate pushdown unsupported: explicit payload "
+                "lists conflict with the pushdown's own wire-column "
+                "resolution (ops.aggregate.wire_columns resolves "
+                "exactly the columns the reduction reads)")
+        if kernel_config is not None:
+            raise agg_ops.AggregatePushdownUnsupported(
+                "aggregate pushdown unsupported: kernel_config tunes "
+                "the materializing expand/compact gathers the fused "
+                "reduction never runs — drop the knob (silently "
+                "ignoring it would cache one program per value)")
+        return _make_join_agg_step(
+            comm, aggregate, keys=keys, k=k,
+            shuffle_capacity_factor=shuffle_capacity_factor,
+            out_capacity_factor=out_capacity_factor,
+            out_rows_per_rank=out_rows_per_rank,
+            shuffle=shuffle, compression_bits=compression_bits,
+            dcn_on=dcn_on, with_metrics=with_metrics,
+            with_integrity=with_integrity,
+            metrics_static=metrics_static)
 
     def step(build_local: Table, probe_local: Table):
         # The integrity digests ride the same Metrics slot, so either
@@ -509,6 +573,184 @@ def make_join_step(
     return step
 
 
+def _make_join_agg_step(comm, spec, *, keys, k,
+                        shuffle_capacity_factor, out_capacity_factor,
+                        out_rows_per_rank, shuffle, compression_bits,
+                        dcn_on, with_metrics, with_integrity,
+                        metrics_static):
+    """The FUSED join+aggregate step (``make_join_step(aggregate=)``;
+    docs/AGGREGATION.md): partition + shuffle ONLY the columns the
+    reduction reads, reduce each batch in the merged domain with
+    :func:`~..ops.aggregate.local_join_aggregate` (segment scans ride
+    the join's own sorts — zero output gathers), then settle the
+    per-group partials: key mode is final per rank (hash co-location),
+    probe mode pays one cross-batch combine plus the groups-sized
+    cross-rank partials exchange (padded; hierarchical routing on a
+    multi-slice mesh; billed under the ``partials.*`` counters).
+    Returns the same ``step(build, probe) -> JoinResult`` shape as the
+    materializing step — ``table`` holds finalized groups, ``total``
+    the would-be join row count, ``overflow`` any shuffle-bucket or
+    partial-groups capacity trip (rows dropped loudly, never wrong
+    sums)."""
+    from distributed_join_tpu.ops import aggregate as agg_ops
+
+    n = comm.n_ranks
+    nb = k * n
+    partials_mode = "hierarchical" if shuffle == "hierarchical" \
+        else "padded"
+
+    def step(build_local: Table, probe_local: Table):
+        tape = telemetry.MetricsTape() if (with_metrics
+                                           or with_integrity) else None
+        if tape is not None:
+            for mname, mval in (metrics_static or {}).items():
+                tape.add(mname, int(mval))
+        for kname in keys:
+            bc = build_local.columns[kname]
+            pc = probe_local.columns[kname]
+            if bc.ndim != 1:
+                raise agg_ops.AggregatePushdownUnsupported(
+                    f"aggregate pushdown unsupported: join key "
+                    f"{kname!r} is a 2-D (string) column; the fused "
+                    "reduction covers scalar keys — run string-key "
+                    "workloads through the materializing join")
+            if bc.dtype != pc.dtype:
+                raise TypeError(
+                    f"key {kname!r} dtype mismatch: build {bc.dtype} "
+                    f"vs probe {pc.dtype}")
+        bschema = agg_ops.table_schema(build_local)
+        pschema = agg_ops.table_schema(probe_local)
+        mode = agg_ops.resolve_agg_mode(spec, keys, bschema, pschema)
+        wire_b, wire_p = agg_ops.wire_columns(spec, mode, keys,
+                                              bschema, pschema)
+        build_w = build_local.select(wire_b)
+        probe_w = probe_local.select(wire_p)
+        lanes_schema = agg_ops.partial_lane_schema(spec, bschema,
+                                                   pschema)
+        group_names = list(keys) if mode == "key" \
+            else list(spec.group_keys)
+
+        b_rows, p_rows = build_w.capacity, probe_w.capacity
+        # Capacity arithmetic VERBATIM from the materializing step —
+        # planning.build_plan mirrors it, and the ladder's escalation
+        # relieves the same contract.
+        b_cap = _round_up(
+            int(math.ceil(b_rows / nb * shuffle_capacity_factor)), 8)
+        p_cap = _round_up(
+            int(math.ceil(p_rows / nb * shuffle_capacity_factor)), 8)
+        if out_rows_per_rank is not None:
+            out_cap = _round_up(
+                int(math.ceil(out_rows_per_rank / k)), 8)
+        else:
+            out_cap = _round_up(
+                int(math.ceil(p_rows / k * out_capacity_factor)), 8)
+        groups_cap = agg_ops.resolve_groups_capacity(spec, out_cap)
+
+        total = jnp.int64(0)
+        overflow = jnp.bool_(False)
+        parts = []
+        if nb == 1:
+            with telemetry.span("join_agg"):
+                partials, t, _g, ovf = agg_ops.local_join_aggregate(
+                    build_w, probe_w, keys, spec, mode, groups_cap)
+            parts.append(partials)
+            total = total + t
+            overflow = overflow | ovf
+        else:
+            with telemetry.span("partition"):
+                ptb = radix_hash_partition(build_w, keys, nb)
+                ptp = radix_hash_partition(probe_w, keys, nb)
+            tb = tape.scoped("build") if tape is not None else None
+            tp = tape.scoped("probe") if tape is not None else None
+            dtb = tape.scoped("build.integrity") if with_integrity \
+                else None
+            dtp = tape.scoped("probe.integrity") if with_integrity \
+                else None
+            if tape is not None:
+                for t_, pt, cap in ((tb, ptb, b_cap), (tp, ptp, p_cap)):
+                    t_.add("rows_partitioned",
+                           jnp.sum(pt.counts.astype(jnp.int64)))
+                    t_.record_min(
+                        "overflow_margin_min",
+                        jnp.int64(cap)
+                        - jnp.max(pt.counts).astype(jnp.int64))
+            for b in range(k):
+                with telemetry.span("shuffle", batch=b):
+                    recv_build, ovf_b = _batch_shuffle(
+                        comm, ptb, b, n, b_cap, mode=shuffle,
+                        compression_bits=compression_bits,
+                        tape=tb, digest_tape=dtb, dcn_codec_on=dcn_on)
+                    recv_probe, ovf_p = _batch_shuffle(
+                        comm, ptp, b, n, p_cap, mode=shuffle,
+                        compression_bits=compression_bits,
+                        tape=tp, digest_tape=dtp, dcn_codec_on=dcn_on)
+                with telemetry.span("join_agg", batch=b):
+                    partials, t, _g, ovf_j = \
+                        agg_ops.local_join_aggregate(
+                            recv_build, recv_probe, keys, spec, mode,
+                            groups_cap)
+                parts.append(partials)
+                total = total + t
+                overflow = overflow | ovf_b | ovf_p | ovf_j
+        if mode == "probe":
+            # Key mode needs NEITHER settle pass: a key lives in
+            # exactly one (batch, rank) by the bucket arithmetic, so
+            # per-batch per-rank partials are disjoint final groups.
+            if len(parts) > 1:
+                # Non-key groups recur across batches — one combine
+                # (concat + regroup sort at groups size) settles them.
+                with telemetry.span("agg_combine"):
+                    combined, _g, ovf_c = agg_ops.combine_partials(
+                        parts, spec, group_names, lanes_schema,
+                        groups_cap)
+                overflow = overflow | ovf_c
+                parts = [combined]
+            if n > 1:
+                # The partials-only exchange: wire bytes are
+                # O(groups), not O(output rows). Per-destination
+                # capacity = the full partials block, so a SEND bucket
+                # can never overflow (a rank holds at most groups_cap
+                # valid partials); the post-exchange combine's flag
+                # fires if one rank receives more distinct groups than
+                # its block holds.
+                with telemetry.span("partials_exchange"):
+                    ptg = radix_hash_partition(parts[0], group_names,
+                                               n)
+                    tg = tape.scoped("partials") if tape is not None \
+                        else None
+                    dtg = tape.scoped("partials.integrity") \
+                        if with_integrity else None
+                    recv, ovf_x = _batch_shuffle(
+                        comm, ptg, 0, n, groups_cap,
+                        mode=partials_mode, tape=tg, digest_tape=dtg)
+                    combined, _g, ovf_c = agg_ops.combine_partials(
+                        [recv], spec, group_names, lanes_schema,
+                        groups_cap)
+                overflow = overflow | ovf_x | ovf_c
+                parts = [combined]
+        finals = [agg_ops.finalize_groups(p, spec, group_names)
+                  for p in parts]
+        out = Table(
+            {name: jnp.concatenate([t_.columns[name] for t_ in finals])
+             for name in finals[0].column_names},
+            jnp.concatenate([t_.valid for t_ in finals]),
+        )
+        if tape is not None:
+            tape.add("matches", total)
+            # Per-rank FINAL groups emitted (the gathered vector sums
+            # to the global group count — every group lives on exactly
+            # one rank after the settle passes above).
+            tape.add("agg.groups",
+                     jnp.sum(out.valid.astype(jnp.int64)))
+            metrics = tape.gathered(comm)
+        total = comm.psum(total)
+        overflow = comm.psum(overflow.astype(jnp.int32)) > 0
+        result = JoinResult(out, total=total, overflow=overflow)
+        return (result, metrics) if tape is not None else result
+
+    return step
+
+
 def resolve_probe_capacities(p_local: int, n: int, k: int,
                              shuffle_capacity_factor: float,
                              out_capacity_factor: float,
@@ -542,6 +784,7 @@ def make_probe_join_step(
     probe_payload: Optional[Sequence[str]] = None,
     shuffle: str = "padded",
     compression_bits: Optional[int] = None,
+    aggregate=None,
     kernel_config=None,
     with_metrics: bool = False,
     with_integrity: bool = False,
@@ -549,6 +792,16 @@ def make_probe_join_step(
 ):
     """The PROBE-ONLY join step against a resident build image
     (service/resident.py; ROADMAP item 4).
+
+    ``aggregate`` (an :class:`~..ops.aggregate.AggregateSpec`, or
+    None): the fused join+aggregate pipeline on the probe-only
+    dispatch — partition + shuffle only the probe columns the
+    reduction reads, reduce each batch against the full resident
+    shard in the merged domain, and return per-group aggregates with
+    zero materialization gathers (docs/AGGREGATION.md). Key-mode
+    co-location holds by the registration hash ((h % kn) % n ==
+    h % n); probe mode exchanges only the groups-sized partials. The
+    same refusal contract as ``make_join_step(aggregate=)``.
 
     ``with_integrity=True`` weaves the wire-integrity digests
     (parallel/integrity.py) into the probe-side shuffle exactly as
@@ -606,6 +859,32 @@ def make_probe_join_step(
             "flat 1-D communicator")
     nb = k * n
     keys = [key] if isinstance(key, str) else list(key)
+
+    if aggregate is not None:
+        from distributed_join_tpu.ops import aggregate as agg_ops
+
+        if not isinstance(aggregate, agg_ops.AggregateSpec):
+            raise TypeError(
+                "aggregate must be an ops.aggregate.AggregateSpec "
+                f"(got {type(aggregate).__name__})")
+        if build_payload is not None or probe_payload is not None:
+            raise agg_ops.AggregatePushdownUnsupported(
+                "aggregate pushdown unsupported: explicit payload "
+                "lists conflict with the pushdown's own wire-column "
+                "resolution")
+        if kernel_config is not None:
+            raise agg_ops.AggregatePushdownUnsupported(
+                "aggregate pushdown unsupported: kernel_config tunes "
+                "the materializing expand/compact gathers the fused "
+                "reduction never runs — drop the knob")
+        return _make_probe_agg_step(
+            comm, aggregate, keys=keys, k=k,
+            shuffle_capacity_factor=shuffle_capacity_factor,
+            out_capacity_factor=out_capacity_factor,
+            out_rows_per_rank=out_rows_per_rank,
+            shuffle=shuffle, compression_bits=compression_bits,
+            with_metrics=with_metrics, with_integrity=with_integrity,
+            metrics_static=metrics_static)
 
     def step(resident_local: Table, probe_local: Table):
         # The integrity digests ride the same Metrics slot, so either
@@ -693,6 +972,149 @@ def make_probe_join_step(
         )
         if tape is not None:
             tape.add("matches", total)
+            metrics = tape.gathered(comm)
+        total = comm.psum(total)
+        overflow = comm.psum(overflow.astype(jnp.int32)) > 0
+        result = JoinResult(out, total=total, overflow=overflow)
+        return (result, metrics) if tape is not None else result
+
+    return step
+
+
+def _make_probe_agg_step(comm, spec, *, keys, k,
+                         shuffle_capacity_factor, out_capacity_factor,
+                         out_rows_per_rank, shuffle, compression_bits,
+                         with_metrics, with_integrity, metrics_static):
+    """The PROBE-ONLY fused join+aggregate step
+    (``make_probe_join_step(aggregate=)``): the resident build image
+    is already hash-co-located, so only the probe's needed columns
+    partition + shuffle, each batch reduces against the full resident
+    shard, and the partials settle exactly as in the full fused step
+    (key mode final per rank; probe mode one cross-batch combine plus
+    the groups-sized padded partials exchange)."""
+    from distributed_join_tpu.ops import aggregate as agg_ops
+
+    n = comm.n_ranks
+    nb = k * n
+    # Probe-only refuses shuffle="hierarchical" today, so this always
+    # resolves to "padded" — kept as the full fused step's expression
+    # so the two pipelines cannot route partials apart if the
+    # probe-only path ever learns the two-level exchange.
+    partials_mode = "hierarchical" if shuffle == "hierarchical" \
+        else "padded"
+
+    def step(resident_local: Table, probe_local: Table):
+        tape = telemetry.MetricsTape() if (with_metrics
+                                           or with_integrity) else None
+        if tape is not None:
+            for mname, mval in (metrics_static or {}).items():
+                tape.add(mname, int(mval))
+        for t, side in ((resident_local, "resident"),
+                        (probe_local, "probe")):
+            for name, c in t.columns.items():
+                if c.ndim != 1:
+                    raise TypeError(
+                        f"{side} column {name!r} is {c.ndim}-D; the "
+                        "probe-only program covers scalar columns")
+        for kname in keys:
+            bdt = resident_local.columns[kname].dtype
+            pdt = probe_local.columns[kname].dtype
+            if bdt != pdt:
+                raise TypeError(
+                    f"key {kname!r} dtype mismatch: resident {bdt} "
+                    f"vs probe {pdt}")
+        bschema = agg_ops.table_schema(resident_local)
+        pschema = agg_ops.table_schema(probe_local)
+        mode = agg_ops.resolve_agg_mode(spec, keys, bschema, pschema)
+        wire_b, wire_p = agg_ops.wire_columns(spec, mode, keys,
+                                              bschema, pschema)
+        resident_w = resident_local.select(wire_b)
+        probe_w = probe_local.select(wire_p)
+        lanes_schema = agg_ops.partial_lane_schema(spec, bschema,
+                                                   pschema)
+        group_names = list(keys) if mode == "key" \
+            else list(spec.group_keys)
+
+        p_cap, out_cap = resolve_probe_capacities(
+            probe_w.capacity, n, k, shuffle_capacity_factor,
+            out_capacity_factor, out_rows_per_rank)
+        groups_cap = agg_ops.resolve_groups_capacity(spec, out_cap)
+        if tape is not None:
+            tape.add("resident.rows",
+                     jnp.sum(resident_local.valid.astype(jnp.int64)))
+
+        total = jnp.int64(0)
+        overflow = jnp.bool_(False)
+        parts = []
+        if nb == 1:
+            with telemetry.span("join_agg"):
+                partials, t, _g, ovf = agg_ops.local_join_aggregate(
+                    resident_w, probe_w, keys, spec, mode, groups_cap)
+            parts.append(partials)
+            total = total + t
+            overflow = overflow | ovf
+        else:
+            with telemetry.span("partition"):
+                ptp = radix_hash_partition(probe_w, keys, nb)
+            tp = tape.scoped("probe") if tape is not None else None
+            dtp = tape.scoped("probe.integrity") if with_integrity \
+                else None
+            if tape is not None:
+                tp.add("rows_partitioned",
+                       jnp.sum(ptp.counts.astype(jnp.int64)))
+                tp.record_min(
+                    "overflow_margin_min",
+                    jnp.int64(p_cap)
+                    - jnp.max(ptp.counts).astype(jnp.int64))
+            for b in range(k):
+                with telemetry.span("shuffle", batch=b):
+                    recv_probe, ovf_p = _batch_shuffle(
+                        comm, ptp, b, n, p_cap, mode=shuffle,
+                        compression_bits=compression_bits, tape=tp,
+                        digest_tape=dtp)
+                with telemetry.span("join_agg", batch=b):
+                    partials, t, _g, ovf_j = \
+                        agg_ops.local_join_aggregate(
+                            resident_w, recv_probe, keys, spec, mode,
+                            groups_cap)
+                parts.append(partials)
+                total = total + t
+                overflow = overflow | ovf_p | ovf_j
+        if mode == "probe":
+            if len(parts) > 1:
+                with telemetry.span("agg_combine"):
+                    combined, _g, ovf_c = agg_ops.combine_partials(
+                        parts, spec, group_names, lanes_schema,
+                        groups_cap)
+                overflow = overflow | ovf_c
+                parts = [combined]
+            if n > 1:
+                with telemetry.span("partials_exchange"):
+                    ptg = radix_hash_partition(parts[0], group_names,
+                                               n)
+                    tg = tape.scoped("partials") if tape is not None \
+                        else None
+                    dtg = tape.scoped("partials.integrity") \
+                        if with_integrity else None
+                    recv, ovf_x = _batch_shuffle(
+                        comm, ptg, 0, n, groups_cap,
+                        mode=partials_mode, tape=tg, digest_tape=dtg)
+                    combined, _g, ovf_c = agg_ops.combine_partials(
+                        [recv], spec, group_names, lanes_schema,
+                        groups_cap)
+                overflow = overflow | ovf_x | ovf_c
+                parts = [combined]
+        finals = [agg_ops.finalize_groups(p, spec, group_names)
+                  for p in parts]
+        out = Table(
+            {name: jnp.concatenate([t_.columns[name] for t_ in finals])
+             for name in finals[0].column_names},
+            jnp.concatenate([t_.valid for t_ in finals]),
+        )
+        if tape is not None:
+            tape.add("matches", total)
+            tape.add("agg.groups",
+                     jnp.sum(out.valid.astype(jnp.int64)))
             metrics = tape.gathered(comm)
         total = comm.psum(total)
         overflow = comm.psum(overflow.astype(jnp.int32)) > 0

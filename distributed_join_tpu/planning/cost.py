@@ -218,6 +218,16 @@ def predict(plan, model: Optional[CostModel] = None) -> dict:
     # join stage still merges each batch against the full resident
     # shard (capacities["resident_rows_per_rank"]).
     probe_only = bool(getattr(plan, "probe_only", False))
+    # Aggregation pushdown (pipeline "join_agg", docs/AGGREGATION.md):
+    # the fused pipeline reduces in the merged domain and NEVER runs
+    # the output expand/gathers that dominate materialization
+    # (ROOFLINE §1-§3) — the expand constant drops out of the join
+    # stage entirely, and the shuffle stage gains the groups-sized
+    # partials exchange (plan.wire["partials"], probe mode only).
+    fused_agg = getattr(plan, "pipeline", "join") in (
+        "join_agg", "probe_join_agg")
+    wire_sides = ("build", "probe", "partials") if fused_agg \
+        else ("build", "probe")
 
     b_local = plan.build.rows_local
     p_local = plan.probe.rows_local
@@ -260,15 +270,18 @@ def predict(plan, model: Optional[CostModel] = None) -> dict:
         # crosses slices.
         s_ = getattr(plan, "n_slices", 1)
         c_ = max(n // s_, 1)
-        ici_rank = sum(plan.wire[side].get("ici_bytes_per_rank", 0)
-                       for side in ("build", "probe"))
-        dcn_rank = sum(plan.wire[side].get("dcn_bytes_per_rank", 0)
-                       for side in ("build", "probe"))
+        ici_rank = sum((plan.wire.get(side) or {})
+                       .get("ici_bytes_per_rank", 0)
+                       for side in wire_sides)
+        dcn_rank = sum((plan.wire.get(side) or {})
+                       .get("dcn_bytes_per_rank", 0)
+                       for side in wire_sides)
         ici_s = (ici_rank * (c_ - 1) / c_) / m.ici_bytes_per_s
         dcn_s = (dcn_rank * (s_ - 1) / s_) / m.dcn_bytes_per_s
         codec_s = 0.0
-        raw = sum(plan.wire[side].get("dcn_raw_bytes_per_rank", 0)
-                  for side in ("build", "probe"))
+        raw = sum((plan.wire.get(side) or {})
+                  .get("dcn_raw_bytes_per_rank", 0)
+                  for side in wire_sides)
         if raw:
             # encode + decode of the raw cross-slice block bytes.
             codec_s = 2.0 * raw / m.codec_bytes_per_s
@@ -279,8 +292,9 @@ def predict(plan, model: Optional[CostModel] = None) -> dict:
                          "dcn_s": _round_s(dcn_s),
                          "codec_s": _round_s(codec_s)}
     else:
-        wire_rank = (plan.wire["build"]["bytes_per_rank"]
-                     + plan.wire["probe"]["bytes_per_rank"])
+        wire_rank = sum((plan.wire.get(side) or {})
+                        .get("bytes_per_rank", 0)
+                        for side in wire_sides)
         offchip = wire_rank * (n - 1) / n
         shuffle_s = (offchip / m.ici_bytes_per_s
                      + plan.wire["collectives_per_step"]
@@ -308,6 +322,11 @@ def predict(plan, model: Optional[CostModel] = None) -> dict:
                   + n * plan.capacities["shuffle_probe_per_bucket"])
         out_total = plan.capacities["out_rows_per_batch"]
         batches = k
+    if fused_agg:
+        # Zero materialization: no record expand, no output gathers —
+        # the segmented scans and the groups-sized compaction ride the
+        # scan/compact constants over the merged domain.
+        out_total = 0
     join_s = batches * ns * (
         merged * (m.sort_ns_per_elem
                   + m.sort_lane_ns_per_elem * 2

@@ -124,6 +124,15 @@ class JoinPlan:
     # wire bytes, no build partition work — and `pipeline` says so.
     pipeline: str = "join"
     probe_only: bool = False
+    # Aggregation pushdown (docs/AGGREGATION.md): the fused
+    # join+aggregate pipeline (``pipeline == "join_agg"``) — the spec,
+    # the resolved mode ("key"/"probe"), and the per-rank partial-
+    # groups capacity. The wire story changes with it: the build/probe
+    # sides ship ONLY the columns the reduction reads, there are zero
+    # materialization gathers, and probe mode adds the groups-sized
+    # ``wire["partials"]`` exchange (exact in padded mode, gated like
+    # the sides).
+    aggregate: Optional[dict] = None
     # Slow-tier topology (hierarchical shuffle, docs/HIERARCHY.md):
     # slices of the communicator's mesh; 1 = flat. Mirrored from the
     # signature so plan digest == cache key holds for hierarchical
@@ -138,6 +147,7 @@ class JoinPlan:
         return {
             "pipeline": self.pipeline,
             "probe_only": self.probe_only,
+            "aggregate": self.aggregate,
             "signature_digest": self.digest,
             "n_ranks": self.n_ranks,
             "n_slices": self.n_slices,
@@ -509,6 +519,42 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
     wb, wp, keys_eff = _wire_schemas(
         build, probe, keys,
         resolved.get("build_payload"), resolved.get("probe_payload"))
+    # Aggregation pushdown (docs/AGGREGATION.md): validate the spec
+    # against the SAME schema contract the step enforces, then restrict
+    # each side's wire schema to exactly the columns the fused
+    # reduction reads — the plan's padded wire bytes stay exact vs the
+    # device counters because the step shuffles exactly these columns.
+    agg_spec = opts.get("aggregate")
+    agg_mode = None
+    agg_schemas = None
+    if agg_spec is not None:
+        from distributed_join_tpu.ops import aggregate as agg_ops
+
+        if resolved.get("skew_threshold") is not None:
+            raise agg_ops.AggregatePushdownUnsupported(
+                "aggregate pushdown unsupported: the skew sidecar is "
+                "not part of the fused pipeline")
+        if resolved.get("build_payload") or resolved.get(
+                "probe_payload"):
+            raise agg_ops.AggregatePushdownUnsupported(
+                "aggregate pushdown unsupported: explicit payload "
+                "lists conflict with the pushdown's wire-column "
+                "resolution")
+        bcols0, pcols0 = _schema_cols(build), _schema_cols(probe)
+        for kname in keys:
+            if bcols0[kname][1]:
+                raise agg_ops.AggregatePushdownUnsupported(
+                    f"aggregate pushdown unsupported: join key "
+                    f"{kname!r} is a 2-D (string) column")
+        bsch = {name: (dtype, 1 + len(tr)) for name, dtype, tr in wb}
+        psch = {name: (dtype, 1 + len(tr)) for name, dtype, tr in wp}
+        agg_mode = agg_ops.resolve_agg_mode(agg_spec, keys_eff, bsch,
+                                            psch)
+        agg_schemas = (bsch, psch)
+        need_b, need_p = agg_ops.wire_columns(
+            agg_spec, agg_mode, keys_eff, bsch, psch)
+        wb = tuple(c for c in wb if c[0] in set(need_b))
+        wp = tuple(c for c in wp if c[0] in set(need_p))
     vb = _varwidth_names(wb) if shuffle == "ragged" else ()
     vp = _varwidth_names(wp) if shuffle == "ragged" else ()
     side_b = SidePlan(
@@ -559,9 +605,53 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
                          b_cap, p_cap, n_slices=n_slices,
                          dcn_codec_on=dcn_on)
 
+    agg_record = None
+    agg_out_row_bytes = None
+    if agg_spec is not None:
+        # agg_ops is bound above — the wire-schema restriction runs
+        # under the same agg_spec gate.
+        bsch, psch = agg_schemas
+        groups_cap = agg_ops.resolve_groups_capacity(agg_spec, out_cap)
+        capacities["groups_per_rank"] = groups_cap
+        partial_cols = agg_ops.partial_columns(
+            agg_spec, agg_mode, keys_eff, bsch, psch)
+        partial_row_bytes = sum(_itemsize(dt) for _, dt in partial_cols)
+        agg_out_row_bytes = partial_row_bytes
+        agg_record = {
+            "spec": agg_spec.as_record(),
+            "mode": agg_mode,
+            "groups_per_rank": groups_cap,
+            "partial_columns": [list(c) for c in partial_cols],
+            "partial_row_bytes": partial_row_bytes,
+        }
+        if agg_mode == "probe" and n > 1:
+            # The partials-only cross-rank exchange (ONE padded
+            # collective, not per batch): per-destination capacity is
+            # the full groups block, so the billed bytes are EXACTLY
+            # shuffle_padded's static n x groups_cap block per column
+            # — or both tiers of the hierarchical route, raw (no
+            # codec on the tiny partials).
+            block = n * groups_cap * partial_row_bytes
+            hier = shuffle == "hierarchical" and n_slices > 1
+            per_rank = 2 * block if hier else block
+            wire["partials"] = {
+                "bytes_per_rank": int(per_rank),
+                "bytes_total": int(per_rank) * n,
+                "rows_estimate": groups_cap,
+            }
+            if hier:
+                wire["partials"]["ici_bytes_per_rank"] = int(block)
+                wire["partials"]["dcn_bytes_per_rank"] = int(block)
+                wire["collectives_per_step"] += 2 * (
+                    1 + len(partial_cols))
+            else:
+                wire["collectives_per_step"] += 1 + len(partial_cols)
+
     model = cost_model or CostModel()
-    memory = _predict_memory(n, k, side_b, side_p, b_cap, p_cap,
-                             out_cap, capacities, model)
+    memory = _predict_memory(
+        n, k, side_b, side_p, b_cap, p_cap,
+        out_cap if agg_spec is None else capacities["groups_per_rank"],
+        capacities, model, out_row_bytes=agg_out_row_bytes)
 
     plan = JoinPlan(
         digest=sig.digest(),
@@ -581,6 +671,8 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
         resolved_options=_jsonable(resolved),
         cost={},
         n_slices=n_slices,
+        pipeline="join" if agg_spec is None else "join_agg",
+        aggregate=agg_record,
     )
     # cost needs the assembled plan; frozen dataclass -> rebuild field.
     object.__setattr__(plan, "cost", predict(plan, model))
@@ -588,7 +680,8 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
 
 
 def _predict_memory(n, k, side_b, side_p, b_cap, p_cap, out_cap,
-                    capacities, model: CostModel) -> dict:
+                    capacities, model: CostModel,
+                    out_row_bytes: Optional[int] = None) -> dict:
     """Per-rank HBM footprint of the resident arrays the step
     materializes: the local table shards, one batch's shuffle
     send/recv blocks per side, and the k output blocks. A roofline
@@ -597,7 +690,11 @@ def _predict_memory(n, k, side_b, side_p, b_cap, p_cap, out_cap,
                + side_p.rows_local * side_p.row_bytes)
     shuffle_b = 2 * n * (b_cap * side_b.row_bytes
                          + p_cap * side_p.row_bytes)
-    out_row_bytes = side_b.row_bytes + side_p.row_bytes
+    if out_row_bytes is None:
+        # Materializing join: each output row carries both sides.
+        # Aggregation pushdown passes the PARTIALS row width instead
+        # (group keys + combinable lanes; out_cap is then groups_cap).
+        out_row_bytes = side_b.row_bytes + side_p.row_bytes
     output_b = k * out_cap * out_row_bytes
     hh_b = 0
     if "hh_build" in capacities:
@@ -750,6 +847,29 @@ def build_probe_plan(comm, resident, probe, key="key",
 
     rcols = _sorted_cols(_schema_cols(resident))
     pcols = _sorted_cols(_schema_cols(probe))
+    # The FULL registered image stays resident regardless of any
+    # aggregate wire-column restriction below — the memory story
+    # prices it at its true width.
+    r_row_bytes_full = _row_bytes(rcols)
+    # Aggregation pushdown on the probe-only dispatch
+    # (make_probe_join_step(aggregate=), docs/AGGREGATION.md): the
+    # probe side ships only the columns the fused reduction reads.
+    agg_spec = opts.get("aggregate")
+    agg_mode = None
+    agg_schemas = None
+    if agg_spec is not None:
+        from distributed_join_tpu.ops import aggregate as agg_ops
+
+        rsch = {name: (dtype, 1 + len(tr))
+                for name, dtype, tr in rcols}
+        psch = {name: (dtype, 1 + len(tr))
+                for name, dtype, tr in pcols}
+        agg_mode = agg_ops.resolve_agg_mode(agg_spec, keys, rsch, psch)
+        agg_schemas = (rsch, psch)
+        need_b, need_p = agg_ops.wire_columns(
+            agg_spec, agg_mode, keys, rsch, psch)
+        rcols = tuple(c for c in rcols if c[0] in set(need_b))
+        pcols = tuple(c for c in pcols if c[0] in set(need_p))
     side_b = SidePlan(
         rows_global=r_global, rows_local=r_local, columns=rcols,
         varwidth=(), row_bytes=_row_bytes(rcols),
@@ -806,19 +926,53 @@ def build_probe_plan(comm, resident, probe, key="key",
         "collectives_per_step": coll,
     }
 
+    agg_record = None
+    if agg_spec is not None:
+        # agg_ops is bound above, under the same agg_spec gate.
+        rsch, psch = agg_schemas
+        groups_cap = agg_ops.resolve_groups_capacity(agg_spec, out_cap)
+        capacities["groups_per_rank"] = groups_cap
+        partial_cols = agg_ops.partial_columns(
+            agg_spec, agg_mode, keys, rsch, psch)
+        partial_row_bytes = sum(_itemsize(dt) for _, dt in partial_cols)
+        agg_record = {
+            "spec": agg_spec.as_record(),
+            "mode": agg_mode,
+            "groups_per_rank": groups_cap,
+            "partial_columns": [list(c) for c in partial_cols],
+            "partial_row_bytes": partial_row_bytes,
+        }
+        if agg_mode == "probe" and n > 1:
+            block = n * groups_cap * partial_row_bytes
+            wire["partials"] = {
+                "bytes_per_rank": int(block),
+                "bytes_total": int(block) * n,
+                "rows_estimate": groups_cap,
+            }
+            wire["collectives_per_step"] += 1 + len(partial_cols)
+
     model = cost_model or CostModel()
     # Resident shards + one batch's probe shuffle blocks + outputs.
-    out_row_bytes = side_b.row_bytes + side_p.row_bytes
-    mem_total = (r_local * side_b.row_bytes
+    # The input term prices the FULL registered image (it stays
+    # resident whatever an aggregate spec reads); a fused plan's
+    # output is the groups-sized partials block, not joined rows —
+    # the build_plan discipline, mirrored.
+    if agg_record is None:
+        out_blocks = k * out_cap * (side_b.row_bytes
+                                    + side_p.row_bytes)
+    else:
+        out_blocks = (k * agg_record["groups_per_rank"]
+                      * agg_record["partial_row_bytes"])
+    mem_total = (r_local * r_row_bytes_full
                  + p_local * side_p.row_bytes
                  + 2 * n * p_cap * side_p.row_bytes
-                 + k * out_cap * out_row_bytes)
+                 + out_blocks)
     memory = {
         "per_rank_bytes": {
-            "input": int(r_local * side_b.row_bytes
+            "input": int(r_local * r_row_bytes_full
                          + p_local * side_p.row_bytes),
             "shuffle_blocks": int(2 * n * p_cap * side_p.row_bytes),
-            "output_blocks": int(k * out_cap * out_row_bytes),
+            "output_blocks": int(out_blocks),
             "skew_blocks": 0,
         },
         "total_per_rank_bytes": int(mem_total),
@@ -831,7 +985,8 @@ def build_probe_plan(comm, resident, probe, key="key",
             {"probe_only": True, "n_ranks": n, "key": keys,
              "resident": [list(c) for c in rcols],
              "probe": [list(c) for c in pcols],
-             "capacities": capacities, "shuffle": shuffle},
+             "capacities": capacities, "shuffle": shuffle,
+             "aggregate": agg_record},
             sort_keys=True, default=str).encode()).hexdigest()
 
     plan = JoinPlan(
@@ -852,8 +1007,10 @@ def build_probe_plan(comm, resident, probe, key="key",
         resolved_options=_jsonable(
             {k_: v for k_, v in opts.items()}),
         cost={},
-        pipeline="probe_join",
+        pipeline="probe_join" if agg_spec is None
+        else "probe_join_agg",
         probe_only=True,
+        aggregate=agg_record,
     )
     object.__setattr__(plan, "cost", predict(plan, model))
     return plan

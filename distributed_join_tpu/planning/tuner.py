@@ -323,8 +323,13 @@ class JoinTuner:
                 cfg.source = "history"
                 cfg.basis["headroom"] = bump[1]
 
-        # 4. skew: enable PRPD on observed per-rank imbalance.
-        if "skew_threshold" not in user_opts:
+        # 4. skew: enable PRPD on observed per-rank imbalance. Never
+        # under aggregation pushdown — the fused pipeline refuses the
+        # skew sidecar loudly (ops/aggregate.py's contract), and a
+        # history-filled knob must not turn a working aggregate
+        # workload into an error.
+        if "skew_threshold" not in user_opts \
+                and user_opts.get("aggregate") is None:
             gini = self._worst_gini(trend.indicators_last)
             if gini is not None and gini[1] > self.skew_gini_warn:
                 cfg.structural["skew_threshold"] = \

@@ -91,6 +91,21 @@ def _backend_platform() -> Optional[str]:
         return None
 
 
+def _count_groups(valid) -> Optional[int]:
+    """Host count of the fused pipeline's valid GROUPS — groups-sized,
+    so cheap. The result table is row-sharded: materialize it only
+    when every shard is addressable (np.asarray on non-addressable
+    shards crashes multi-controller — the resident.py/stageprof
+    discipline); otherwise report the count as unknown (None) rather
+    than crash or sum a partial local view."""
+    import jax
+    import numpy as np
+
+    if isinstance(valid, jax.Array) and not valid.is_fully_addressable:
+        return None
+    return int(np.asarray(valid).sum())
+
+
 @dataclasses.dataclass
 class ServiceConfig:
     """Serving policy knobs (the per-run driver flags, made resident).
@@ -166,6 +181,14 @@ class JoinService:
         self.served = 0
         self.rejected = 0
         self.failed = 0
+        # Aggregation-pushdown accounting (docs/AGGREGATION.md): how
+        # many requests ran the fused join+aggregate pipeline, how
+        # many of those dispatched warm (zero new traces), and the
+        # groups they emitted — exposed via stats() and the
+        # djtpu_agg_* Prometheus gauges.
+        self.agg_queries = 0
+        self.agg_warm_hits = 0
+        self.agg_groups_emitted = 0
         self.live = tel_live.LiveMetrics()
         self.recorder = tel_live.FlightRecorder(
             self.config.flight_records)
@@ -300,6 +323,8 @@ class JoinService:
         res = None
         err: Optional[BaseException] = None
         new_traces = cache_hits = 0
+        agg_spec = opts.get("aggregate")
+        agg_rec = agg_spec.as_record() if agg_spec is not None else None
         try:
             # Inside the try: anything raising after _admit must still
             # release the pending-admission slot in the finally.
@@ -366,6 +391,18 @@ class JoinService:
                 new_traces = self.cache.traces - traces0
                 cache_hits = self.cache.hits - hits0
                 outcome = "served"
+                if agg_spec is not None:
+                    # The fused pipeline's result table holds GROUPS
+                    # (valid marks real ones).
+                    groups = _count_groups(res.table.valid)
+                    agg_rec = dict(agg_rec, groups=groups)
+                    with self._admit_lock:
+                        self.agg_queries += 1
+                        if new_traces == 0:
+                            self.agg_warm_hits += 1
+                        if groups is not None:
+                            self.agg_groups_emitted += groups
+                    object.__setattr__(res, "agg_groups", groups)
                 object.__setattr__(res, "new_traces", new_traces)
                 object.__setattr__(res, "request_id", rid)
                 return res
@@ -398,7 +435,7 @@ class JoinService:
             self._observe(rid, op, sig, outcome, res, err,
                           time.perf_counter() - t_start,
                           new_traces, cache_hits, predicted,
-                          plan_digest)
+                          plan_digest, aggregate=agg_rec)
 
     def join_batched(self, requests, key="key", *,
                      slot_build_rows=None, slot_probe_rows=None,
@@ -474,6 +511,8 @@ class JoinService:
         err: Optional[BaseException] = None
         new_traces = cache_hits = 0
         resident_rec = None
+        agg_spec = opts.get("aggregate")
+        agg_rec = agg_spec.as_record() if agg_spec is not None else None
         try:
             sig = self.resident.workload_signature(
                 table, probe, dict(opts))
@@ -530,6 +569,16 @@ class JoinService:
                 cache_hits = self.cache.hits - hits0
                 outcome = "served"
                 resident_rec = getattr(res, "resident", None)
+                if agg_spec is not None:
+                    groups = _count_groups(res.table.valid)
+                    agg_rec = dict(agg_rec, groups=groups)
+                    with self._admit_lock:
+                        self.agg_queries += 1
+                        if new_traces == 0:
+                            self.agg_warm_hits += 1
+                        if groups is not None:
+                            self.agg_groups_emitted += groups
+                    object.__setattr__(res, "agg_groups", groups)
                 object.__setattr__(res, "new_traces", new_traces)
                 object.__setattr__(res, "request_id", rid)
                 return res
@@ -551,7 +600,8 @@ class JoinService:
             self._observe(rid, op, sig, outcome, res, err,
                           time.perf_counter() - t_start,
                           new_traces, cache_hits, predicted,
-                          plan_digest, resident=resident_rec)
+                          plan_digest, resident=resident_rec,
+                          aggregate=agg_rec)
 
     def _table_op(self, op: str, table: str, fn, request_id=None):
         """Admission + exec-lock + accounting wrapper for the
@@ -794,7 +844,7 @@ class JoinService:
 
     def _observe(self, rid, op, sig, outcome, res, err, elapsed_s,
                  new_traces, cache_hits, predicted_wall_s=None,
-                 plan_digest=None, resident=None):
+                 plan_digest=None, resident=None, aggregate=None):
         """Per-request accounting fan-out: live metrics, the flight-
         recorder ring, the workload-history store, and the poison-time
         flight dump. Observability must never turn a served request
@@ -835,7 +885,7 @@ class JoinService:
                 overflow=overflow, new_traces=new_traces,
                 cache_hits=cache_hits, rung_path=rung_path,
                 tuned=tel_history.tuned_summary(tuned),
-                resident=resident, error=error)
+                resident=resident, aggregate=aggregate, error=error)
             if self.history is not None or self.tuner is not None:
                 tel = (getattr(res, "telemetry", None)
                        if res is not None else None)
@@ -847,7 +897,8 @@ class JoinService:
                     metrics=tel.to_dict() if tel is not None else None,
                     predicted_wall_s=predicted_wall_s,
                     tuned=tuned, platform=_backend_platform(),
-                    resident=resident, error=error)
+                    resident=resident, aggregate=aggregate,
+                    error=error)
                 if self.history is not None:
                     self.history.append(entry)
                 if self.tuner is not None:
@@ -903,6 +954,11 @@ class JoinService:
             "poisoned": self.poisoned,
             "cache": self.cache.stats(),
             "resident": self.resident.stats(),
+            "aggregate": {
+                "queries": self.agg_queries,
+                "warm_hits": self.agg_warm_hits,
+                "groups_emitted": self.agg_groups_emitted,
+            },
             "tuner": (self.tuner.stats() if self.tuner is not None
                       else None),
         }
@@ -955,6 +1011,13 @@ class JoinService:
             "resident_warm_probe_joins_total":
                 resident["warm_probe_joins"],
             "resident_refused_total": resident["refused"],
+            # Aggregation pushdown (docs/AGGREGATION.md): fused
+            # join+aggregate traffic — queries, zero-trace warm hits,
+            # groups emitted instead of materialized rows.
+            "agg_queries_total": st["aggregate"]["queries"],
+            "agg_warm_hits_total": st["aggregate"]["warm_hits"],
+            "agg_groups_emitted_total":
+                st["aggregate"]["groups_emitted"],
         })
 
 
@@ -965,7 +1028,7 @@ class JoinService:
 _WIRE_JOIN_OPTS = (
     "shuffle", "over_decomposition", "shuffle_capacity_factor",
     "out_capacity_factor", "compression_bits", "skew_threshold",
-    "dcn_codec",
+    "dcn_codec", "aggregate",
 )
 
 
@@ -990,8 +1053,16 @@ def _tables_from_spec(spec: dict):
 
 
 def _join_opts_from_spec(spec: dict) -> dict:
-    return {k: spec[k] for k in _WIRE_JOIN_OPTS if spec.get(k)
+    opts = {k: spec[k] for k in _WIRE_JOIN_OPTS if spec.get(k)
             is not None}
+    if "aggregate" in opts:
+        # The wire form ({"group_by": [...], "aggs": [["sum", col],
+        # ...], ...}) becomes the canonical AggregateSpec the step,
+        # signature, and plan all key on (docs/AGGREGATION.md).
+        from distributed_join_tpu.ops.aggregate import AggregateSpec
+
+        opts["aggregate"] = AggregateSpec.from_wire(opts["aggregate"])
+    return opts
 
 
 def _build_from_spec(spec: dict):
@@ -1178,6 +1249,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 "table": name,
                 "resident": getattr(res, "resident", None),
                 "matches": int(res.total),
+                "groups": getattr(res, "agg_groups", None),
                 "overflow": bool(res.overflow),
                 "elapsed_s": elapsed,
                 "new_traces": getattr(res, "new_traces", 0),
@@ -1199,6 +1271,9 @@ class _Handler(socketserver.StreamRequestHandler):
                 # response with the daemon's JSONL/trace views
                 "request_id": getattr(res, "request_id", None),
                 "matches": matches,
+                # Aggregation pushdown: the group count the fused
+                # pipeline emitted (None for materializing joins).
+                "groups": getattr(res, "agg_groups", None),
                 "overflow": bool(res.overflow),
                 "elapsed_s": elapsed,
                 # accounted under the service's exec lock, so a

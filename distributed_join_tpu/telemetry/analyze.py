@@ -685,7 +685,12 @@ def grade_explain(explain: dict, metrics: Optional[dict],
     }
     exact = bool(wire.get("exact"))
     n_ranks = int(plan.get("n_ranks") or 0)
-    for side in ("build", "probe"):
+    # Aggregation-pushdown plans (pipeline "join_agg") add the
+    # groups-sized partials exchange as its own gated side — exact in
+    # padded mode like build/probe (docs/AGGREGATION.md).
+    sides = ("build", "probe", "partials") if "partials" in wire \
+        else ("build", "probe")
+    for side in sides:
         pred = (wire.get(side) or {}).get("bytes_total")
         meas = red.get(f"{side}.wire_bytes")
         if pred is not None and meas is not None:
@@ -943,6 +948,18 @@ def check_file(path: str) -> list:
                             problems.append(
                                 f"line {i}: resident stamp missing "
                                 "table/generation keys")
+                    # Aggregation-pushdown stamp (history.
+                    # request_entry / run_entry): fused-pipeline
+                    # entries carry the spec shape; None = a
+                    # materializing join.
+                    agg_stamp = ev.get("aggregate")
+                    if agg_stamp is not None:
+                        if not isinstance(agg_stamp, dict) or not \
+                                {"group_keys", "aggs"} <= \
+                                set(agg_stamp):
+                            problems.append(
+                                f"line {i}: aggregate stamp missing "
+                                "group_keys/aggs keys")
                 elif kind not in ("event", "span"):
                     problems.append(f"line {i}: bad kind {kind!r}")
             # A torn FINAL line is the advertised killed-run artifact
@@ -1020,6 +1037,22 @@ def check_file(path: str) -> list:
         # perfgate lane gates against results/baselines/
         # resident_smoke.json.
         for key in ("kind", "n_ranks", "counter_signature"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        sig = doc.get("counter_signature")
+        if isinstance(sig, dict):
+            if not isinstance(sig.get("counters"), dict):
+                problems.append("counter_signature missing "
+                                "'counters'")
+        elif "counter_signature" in doc:
+            problems.append("counter_signature is not an object")
+        return problems
+    elif name.startswith("agg_smoke") or doc.get("kind") == "agg_ab":
+        # The join driver's --agg-ab sub-record (fused pushdown vs
+        # materialize-then-host-group-by; docs/AGGREGATION.md):
+        # carries the deterministic counter signature the perfgate
+        # lane gates against results/baselines/agg_smoke.json.
+        for key in ("kind", "n_ranks", "counter_signature", "spec"):
             if key not in doc:
                 problems.append(f"missing required key {key!r}")
         sig = doc.get("counter_signature")

@@ -72,7 +72,7 @@ WORKLOAD_KEYS = (
     "selectivity", "shuffle", "key_type", "payload_type",
     "key_columns", "over_decomposition_factor", "zipf_alpha",
     "skew_threshold", "string_payload_bytes", "string_key_bytes",
-    "scale_factor", "nbytes", "slices", "dcn_codec",
+    "scale_factor", "nbytes", "slices", "dcn_codec", "agg",
 )
 
 
@@ -333,6 +333,7 @@ def request_entry(*, request_id: str, op: str, signature: str,
                   platform: Optional[str] = None,
                   stage_profile: Optional[dict] = None,
                   resident: Optional[dict] = None,
+                  aggregate: Optional[dict] = None,
                   error: Optional[str] = None) -> dict:
     """One serving request's history line (the JoinService write
     path). ``metrics`` is the request's ``Metrics.to_dict()`` block
@@ -369,6 +370,11 @@ def request_entry(*, request_id: str, op: str, signature: str,
         "prediction": prediction_block(wall_s, predicted_wall_s),
         "stages": stages_block(stage_profile),
         "resident": resident,
+        # Aggregation-pushdown stamp (docs/AGGREGATION.md): requests
+        # that ran the fused join+aggregate pipeline carry the spec
+        # (group_keys/aggs/...) plus the groups emitted; None = a
+        # materializing join. `analyze check` validates the shape.
+        "aggregate": aggregate,
         "error": error,
     }
 
@@ -449,6 +455,11 @@ def run_entry(record: Optional[dict] = None,
         # A --stage-profile run embeds its compact per-stage summary;
         # the trend shows per-stage drift next to counter drift.
         "stages": stages_block(record.get("stage_profile")),
+        # The tpch driver's --agg mode (and any record carrying an
+        # aggregate block) stamps the pushdown spec + groups emitted.
+        "aggregate": (record.get("aggregate")
+                      if isinstance(record.get("aggregate"), dict)
+                      else None),
         "error": record.get("error"),
     }
 

@@ -384,6 +384,50 @@ def main() -> None:
             else:
                 ok["dcn_calibration"] = False
 
+    # 9. Aggregation-pushdown A/B on chip (docs/AGGREGATION.md): the
+    # fused join+group-by vs materialize-then-host-group-by at spec
+    # scale — on real hardware the A-side pays the measured
+    # ~21 ns/element output gathers AND the D2H of the 0.75N block,
+    # so the expected win is larger than the CPU-mesh smoke's.
+    # Refusable shapes skip with a named reason inside the record
+    # (skipped-not-failed, like the DCN step); resumable like every
+    # other artifact.
+    agg_art = RESULTS / "agg_ab_r6.json"
+    if agg_art.exists():
+        print("== agg A/B: exists, skipping", flush=True)
+        ok["agg_ab"] = True
+    else:
+        done = step(
+            "agg A/B", "agg_ab_driver_r6.json",
+            drv + ["--build-table-nrows", "10000000",
+                   "--probe-table-nrows", "10000000",
+                   "--duplicate-build-keys", "--rand-max", "1000000",
+                   "--iterations", "2", "--communicator", "local",
+                   "--out-capacity-factor", "30",
+                   "--agg-ab", "3",
+                   "--history", str(HISTORY),
+                   "--json-output", "results/agg_ab_driver_r6.json"],
+            timeout_s=10800)
+        if done:
+            rec = json.loads(
+                (RESULTS / "agg_ab_driver_r6.json").read_text())
+            ab = rec.get("agg_ab") or {}
+            print(json.dumps({k: ab.get(k) for k in
+                              ("skipped", "pushdown_speedup",
+                               "oracle_equal_pushdown", "groups")}),
+                  flush=True)
+            # A named skip (refusable shape) is not a session
+            # failure; a measured A/B must be oracle-clean. The
+            # resumable artifact is written ONLY on a clean gate —
+            # an oracle-divergent A/B must rerun next session, not
+            # turn into a silent `exists, skipping` pass.
+            ok["agg_ab"] = bool(ab.get("skipped")) or bool(
+                ab.get("oracle_equal_pushdown"))
+            if ok["agg_ab"]:
+                agg_art.write_text(json.dumps(ab, indent=2) + "\n")
+        else:
+            ok["agg_ab"] = False
+
     print(json.dumps(ok, indent=2), flush=True)
     if not all(ok.values()):
         sys.exit(1)

@@ -63,6 +63,19 @@
 #                                   # signature (matches included)
 #                                   # gated vs results/baselines/
 #                                   # hier_smoke.json
+#   scripts/run_tier1.sh agg        # aggregation pushdown: -m agg
+#                                   # suite + a deterministic CPU-mesh
+#                                   # driver A/B smoke on the
+#                                   # duplicate-key high-fan-out shape
+#                                   # — pandas-oracle equality on BOTH
+#                                   # sides, zero warm pushdown
+#                                   # traces, pushdown strictly faster
+#                                   # than materialize-then-host-
+#                                   # group-by, counter signature
+#                                   # gated vs results/baselines/
+#                                   # agg_smoke.json — plus the tpch
+#                                   # driver's --agg mode (oracle-
+#                                   # graded in-driver)
 #   scripts/run_tier1.sh tuner      # autotuner: -m tuner suite + a
 #                                   # cold/warm driver A/B (warm run
 #                                   # must start at the escalated
@@ -207,7 +220,86 @@ PY
       --json-output "$tmp/hier_record.json"
     python -m distributed_join_tpu.telemetry.analyze compare \
       "$tmp/hier_record.json" --baseline hier_smoke
+    # The aggregation-pushdown A/B's counter signature is part of the
+    # same gate (docs/AGGREGATION.md): a deterministic duplicate-key
+    # fan-out join's pushdown counters (wire-column-restricted bytes,
+    # matches, agg.groups) — a changed reduction, wire-column
+    # resolution, or partials exchange moves them. The strict
+    # speedup/oracle gates live in the agg lane.
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.benchmarks.distributed_join \
+      --platform cpu --n-ranks 8 \
+      --build-table-nrows 16000 --probe-table-nrows 16000 \
+      --duplicate-build-keys --rand-max 1000 \
+      --iterations 1 --out-capacity-factor 30 --agg-ab 1 \
+      --json-output "$tmp/agg_record.json"
+    python - "$tmp" <<'PY'
+import json, sys
+ab = json.load(open(f"{sys.argv[1]}/agg_record.json"))["agg_ab"]
+json.dump(ab, open(f"{sys.argv[1]}/agg_smoke.json", "w"), indent=1)
+PY
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/agg_smoke.json" --baseline agg_smoke
     exit $?
+    ;;
+  agg)
+    # Aggregation pushdown (docs/AGGREGATION.md). 1. the -m agg unit
+    # suite (oracle exactness across shuffle modes/ranks/batching,
+    # exact wire accounting incl. the partials exchange, refusal
+    # contract, overflow ladder, warm serving, corruption chaos
+    # slice); 2. a deterministic CPU-mesh driver A/B smoke on the
+    # duplicate-key high-fan-out shape — where materialization
+    # actually hurts — gating oracle equality on BOTH sides, zero
+    # warm pushdown traces, a strict pushdown-beats-materialize wall
+    # win, and the agg_smoke counter signature; 3. the tpch driver's
+    # Q3/Q10-shaped --agg mode (oracle-graded in-driver — divergence
+    # exits nonzero).
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m agg --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    tmp="$(mktemp -d /tmp/djtpu_agg.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.benchmarks.distributed_join \
+      --platform cpu --n-ranks 8 \
+      --build-table-nrows 16000 --probe-table-nrows 16000 \
+      --duplicate-build-keys --rand-max 1000 \
+      --iterations 1 --out-capacity-factor 30 --agg-ab 3 \
+      --json-output "$tmp/record.json"
+    python - "$tmp" <<'PY'
+import json, sys
+ab = json.load(open(f"{sys.argv[1]}/record.json"))["agg_ab"]
+json.dump(ab, open(f"{sys.argv[1]}/agg_smoke.json", "w"), indent=1)
+assert ab.get("skipped") is None, ab
+assert ab["oracle_equal_pushdown"] and ab["oracle_equal_materialize"], ab
+assert ab["warm_pushdown_new_traces"] == 0, ab
+assert ab["pushdown_speedup"] and ab["pushdown_speedup"] > 1.0, ab
+print(f"agg A/B: pushdown x{ab['pushdown_speedup']:.2f} vs "
+      f"materialize+host-group-by, {ab['groups']} groups, "
+      f"{ab['matches']} would-be join rows, 0 warm traces")
+PY
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/agg_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/agg_smoke.json" --baseline agg_smoke
+    # no exec: the EXIT trap must still clean $tmp
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.benchmarks.tpch_join \
+      --platform cpu --n-ranks 8 --scale-factor 0.01 --q3-filters \
+      --agg --iterations 1 --out-capacity-factor 3.0 \
+      --json-output "$tmp/tpch_agg.json"
+    python - "$tmp" <<'PY'
+import json, sys
+rec = json.load(open(f"{sys.argv[1]}/tpch_agg.json"))
+agg = rec["aggregate"]
+assert rec["agg"] and agg["oracle_equal"], rec
+print(f"tpch --agg: {agg['groups']} groups oracle-exact, "
+      f"{rec['matches_per_join']} would-be join rows fused away")
+PY
     ;;
   lint)
     # Static analysis (docs/STATIC_ANALYSIS.md): level-1 AST rules
@@ -508,7 +600,7 @@ PY
     exit $?
     ;;
   *)
-    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|stageprof|tuner|resident|hier]" >&2
+    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|stageprof|tuner|resident|hier|agg]" >&2
     exit 2
     ;;
 esac

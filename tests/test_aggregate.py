@@ -1,0 +1,580 @@
+"""Aggregation pushdown (ops/aggregate.py + make_join_step(aggregate=))
+on the 8-virtual-device CPU mesh.
+
+The contracts (docs/AGGREGATION.md):
+
+- **Oracle exactness.** The fused join+group-by — key mode (group by
+  the join key: partials final per rank) and probe mode (probe-side
+  group columns: one partials-only exchange) — equals the pandas
+  join+group-by across padded/ragged/ppermute/hierarchical shuffles,
+  single rank, over-decomposition, duplicate-key expansion, and every
+  op (sum/count/min/max/mean) plus carries. ``total`` stays the row
+  count the materializing join would have produced.
+- **Exact wire accounting.** The ``join_agg`` plan's padded wire bytes
+  (restricted to the columns the reduction reads, plus the
+  ``partials`` exchange in probe mode) equal the device counters to
+  the byte, and the plan digest equals the program-cache key.
+- **Loud refusal, never wrong sums.** Unsupported shapes (skew
+  sidecar, string keys, build-side group-bys, explicit payload lists,
+  unknown columns) raise :class:`AggregatePushdownUnsupported`; an
+  undersized partials block raises the overflow flag and the ladder's
+  out-capacity escalation grows the derived block; injected wire
+  corruption under ``verify_integrity`` refuses via the integrity
+  rung instead of returning wrong aggregates (the fixed-seed chaos
+  slice).
+- **Serving.** Aggregate queries cache and serve warm (zero new
+  traces) through the program cache, the service, the daemon wire,
+  and the resident probe-only path; the tuner keys them as their own
+  workloads and never fills the skew knob under pushdown.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_join_tpu import planning, telemetry
+from distributed_join_tpu.ops.aggregate import (
+    AggregatePushdownUnsupported,
+    AggregateSpec,
+    aggregate_oracle,
+    frames_equal,
+    groups_frame,
+    resolve_agg_mode,
+    table_schema,
+)
+from distributed_join_tpu.parallel.communicator import (
+    HierarchicalTpuCommunicator,
+    LocalCommunicator,
+    TpuCommunicator,
+)
+from distributed_join_tpu.parallel.distributed_join import (
+    JOIN_METRICS_SHARDED_OUT,
+    distributed_inner_join,
+    make_join_step,
+)
+from distributed_join_tpu.service.programs import JoinProgramCache
+from distributed_join_tpu.table import Table
+from distributed_join_tpu.utils.generators import (
+    generate_build_probe_tables,
+)
+
+pytestmark = pytest.mark.agg
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_session():
+    telemetry.finalize()
+    yield
+    telemetry.finalize()
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return TpuCommunicator(n_ranks=8)
+
+
+@pytest.fixture(scope="module")
+def tables():
+    """Duplicate build keys -> real runs-x-runs expansion under the
+    pushdown's B*P algebra."""
+    return generate_build_probe_tables(
+        seed=7, build_nrows=512, probe_nrows=1024, rand_max=128,
+        selectivity=0.6, unique_build_keys=False)
+
+
+@pytest.fixture(scope="module")
+def probe_grouped():
+    """Build/probe pair with a probe-side group column (few distinct
+    values) plus a carry functionally dependent on it."""
+    rng = np.random.default_rng(3)
+    bkeys = rng.integers(0, 100, 512)
+    pkeys = rng.integers(0, 140, 1024)
+    build = Table.from_dense({
+        "key": jnp.asarray(bkeys, jnp.int64),
+        "b_val": jnp.asarray(rng.integers(0, 1000, 512), jnp.int64),
+    })
+    probe = Table.from_dense({
+        "key": jnp.asarray(pkeys, jnp.int64),
+        "p_val": jnp.asarray(rng.integers(0, 1000, 1024), jnp.int64),
+        "grp": jnp.asarray(pkeys % 7, jnp.int32),
+        "grp_tag": jnp.asarray((pkeys % 7) * 11, jnp.int32),
+    })
+    return build, probe
+
+
+SPEC_KEY = AggregateSpec.of(
+    "key",
+    [("count", None), ("sum", "probe_payload"),
+     ("sum", "build_payload"), ("min", "probe_payload"),
+     ("max", "build_payload"), ("mean", "probe_payload")])
+SPEC_PROBE = AggregateSpec.of(
+    "grp",
+    [("count", None), ("sum", "p_val"), ("sum", "b_val"),
+     ("min", "b_val"), ("max", "p_val"), ("mean", "b_val")],
+    carry=("grp_tag",))
+
+
+def _grade(res, build, probe, spec, group_names, comm, **full_opts):
+    got = groups_frame(res.table, spec, group_names)
+    want = aggregate_oracle(build, probe, "key", spec)
+    assert frames_equal(got, want), (got.head(), want.head())
+    full = distributed_inner_join(build, probe, comm, key="key",
+                                  out_capacity_factor=30.0,
+                                  **full_opts)
+    assert int(res.total) == int(full.total)
+    return len(want)
+
+
+# -- oracle exactness --------------------------------------------------
+
+
+@pytest.mark.parametrize("shuffle", ["padded", "ragged", "ppermute"])
+def test_key_mode_oracle(comm, tables, shuffle):
+    build, probe = tables
+    res = distributed_inner_join(build, probe, comm, key="key",
+                                 aggregate=SPEC_KEY, auto_retry=3,
+                                 shuffle=shuffle)
+    assert not bool(res.overflow)
+    _grade(res, build, probe, SPEC_KEY, ["key"], comm)
+
+
+def test_key_mode_single_rank(tables):
+    build, probe = tables
+    comm = LocalCommunicator()
+    res = distributed_inner_join(build, probe, comm, key="key",
+                                 aggregate=SPEC_KEY)
+    _grade(res, build, probe, SPEC_KEY, ["key"], comm)
+
+
+def test_key_mode_over_decomposition(comm, tables):
+    build, probe = tables
+    res = distributed_inner_join(build, probe, comm, key="key",
+                                 aggregate=SPEC_KEY, auto_retry=3,
+                                 over_decomposition=2)
+    _grade(res, build, probe, SPEC_KEY, ["key"], comm)
+
+
+@pytest.mark.parametrize("shuffle", ["padded", "ragged"])
+def test_probe_mode_oracle(comm, probe_grouped, shuffle):
+    build, probe = probe_grouped
+    res = distributed_inner_join(build, probe, comm, key="key",
+                                 aggregate=SPEC_PROBE, auto_retry=3,
+                                 shuffle=shuffle)
+    _grade(res, build, probe, SPEC_PROBE, ["grp"], comm)
+
+
+def test_probe_mode_over_decomposition(comm, probe_grouped):
+    # Cross-batch combine: non-key groups recur across batches.
+    build, probe = probe_grouped
+    res = distributed_inner_join(build, probe, comm, key="key",
+                                 aggregate=SPEC_PROBE, auto_retry=3,
+                                 over_decomposition=2)
+    _grade(res, build, probe, SPEC_PROBE, ["grp"], comm)
+
+
+@pytest.mark.hier
+def test_hierarchical_pushdown(probe_grouped, tables):
+    hcomm = HierarchicalTpuCommunicator(n_slices=2, n_ranks=8)
+    build, probe = probe_grouped
+    res = distributed_inner_join(build, probe, hcomm, key="key",
+                                 aggregate=SPEC_PROBE, auto_retry=3,
+                                 shuffle="hierarchical")
+    _grade(res, build, probe, SPEC_PROBE, ["grp"], hcomm,
+           shuffle="hierarchical")
+    build, probe = tables
+    res = distributed_inner_join(build, probe, hcomm, key="key",
+                                 aggregate=SPEC_KEY, auto_retry=3,
+                                 shuffle="hierarchical")
+    _grade(res, build, probe, SPEC_KEY, ["key"], hcomm,
+           shuffle="hierarchical")
+
+
+def test_composite_key_mode(comm):
+    from distributed_join_tpu.utils.generators import (
+        generate_composite_build_probe_tables,
+    )
+
+    build, probe, key_names = generate_composite_build_probe_tables(
+        seed=5, build_nrows=512, probe_nrows=512, key_columns=2,
+        rand_max=None, selectivity=0.5, string_payload_len=0,
+        unique_build_keys=True)
+    spec = AggregateSpec.of(list(key_names), [("count", None)])
+    res = distributed_inner_join(build, probe, comm,
+                                 key=list(key_names), aggregate=spec,
+                                 auto_retry=3)
+    got = groups_frame(res.table, spec, list(key_names))
+    want = aggregate_oracle(build, probe, list(key_names), spec)
+    assert frames_equal(got, want)
+
+
+# -- overflow / refusal contract ---------------------------------------
+
+
+def test_ladder_grows_derived_groups(comm, tables):
+    build, probe = tables
+    res = distributed_inner_join(build, probe, comm, key="key",
+                                 aggregate=SPEC_KEY, auto_retry=6,
+                                 out_capacity_factor=0.02)
+    assert res.retry_report.n_attempts > 1
+    assert not bool(res.overflow)
+    _grade(res, build, probe, SPEC_KEY, ["key"], comm)
+
+
+def test_explicit_groups_overflow_is_loud(comm, tables):
+    build, probe = tables
+    spec = AggregateSpec.of("key", [("count", None)], groups_per_rank=8)
+    res = distributed_inner_join(build, probe, comm, key="key",
+                                 aggregate=spec, auto_retry=1)
+    assert bool(res.overflow)
+
+
+@pytest.mark.parametrize("spec,opts,reason", [
+    (AggregateSpec.of("key", [("sum", "nope")]), {}, "not found"),
+    (AggregateSpec.of("build_payload", [("count", None)]), {},
+     "BUILD side"),
+    (AggregateSpec.of("key", [("sum", "key")]), {}, "join key"),
+    (SPEC_KEY, {"skew_threshold": 0.001}, "skew sidecar"),
+    (SPEC_KEY, {"build_payload": ["build_payload"]}, "payload lists"),
+    (SPEC_KEY, {"kernel_config": {"expand": "xla"}}, "kernel_config"),
+])
+def test_refusals(comm, tables, spec, opts, reason):
+    build, probe = tables
+    with pytest.raises(AggregatePushdownUnsupported, match=reason):
+        distributed_inner_join(build, probe, comm, key="key",
+                               aggregate=spec, **opts)
+
+
+def test_string_key_refused(comm):
+    from distributed_join_tpu.utils.strings import encode_strings
+
+    b, l = encode_strings(["aa", "bb", "cc", "dd"] * 2, max_len=8)
+    build = Table.from_dense({"skey": b, "skey#len": l,
+                              "v": jnp.arange(8, dtype=jnp.int64)})
+    probe = Table.from_dense({"skey": b, "skey#len": l,
+                              "w": jnp.arange(8, dtype=jnp.int64)})
+    spec = AggregateSpec.of("skey", [("count", None)])
+    with pytest.raises(AggregatePushdownUnsupported, match="2-D"):
+        distributed_inner_join(build, probe, comm, key="skey",
+                               aggregate=spec)
+
+
+def test_mode_resolution_schema_level(tables):
+    build, probe = tables
+    bsch, psch = table_schema(build), table_schema(probe)
+    assert resolve_agg_mode(SPEC_KEY, ["key"], bsch, psch) == "key"
+    spec = AggregateSpec.of("probe_payload", [("count", None)])
+    assert resolve_agg_mode(spec, ["key"], bsch, psch) == "probe"
+    with pytest.raises(AggregatePushdownUnsupported,
+                       match="BOTH sides"):
+        resolve_agg_mode(
+            AggregateSpec.of("key", [("sum", "dup")]), ["key"],
+            {"key": ("int64", 1), "dup": ("int64", 1)},
+            {"key": ("int64", 1), "dup": ("int64", 1)})
+
+
+# -- wire accounting / plan agreement ----------------------------------
+
+
+def _exact_wire(comm, build, probe, spec, **opts):
+    n = comm.n_ranks
+    b = build.pad_to(-(-build.capacity // n) * n)
+    p = probe.pad_to(-(-probe.capacity // n) * n)
+    b, p = comm.device_put_sharded((b, p))
+    step = make_join_step(comm, key="key", aggregate=spec,
+                          with_metrics=True, **opts)
+    fn = comm.spmd(step, sharded_out=JOIN_METRICS_SHARDED_OUT)
+    res, metrics = fn(b, p)
+    red = metrics.to_dict()["reduced"]
+    plan = planning.build_plan(comm, b, p, key="key", aggregate=spec,
+                               with_metrics=True, **opts)
+    assert plan.pipeline == "join_agg"
+    sides = ["build", "probe"]
+    if "partials" in plan.wire:
+        sides.append("partials")
+    for side in sides:
+        assert plan.wire[side]["bytes_total"] == \
+            red[f"{side}.wire_bytes"], side
+        for tier in ("ici", "dcn"):
+            pr = plan.wire[side].get(f"{tier}_bytes_per_rank")
+            if pr is not None:
+                assert pr * n == red[f"{side}.wire_bytes_{tier}"], \
+                    (side, tier)
+    return plan, red
+
+
+def test_wire_exact_key_mode(comm, tables):
+    build, probe = tables
+    plan, red = _exact_wire(comm, build, probe, SPEC_KEY)
+    assert "partials" not in plan.wire       # key mode: no exchange
+    assert red["agg.groups"] > 0
+
+
+def test_wire_exact_probe_mode(comm, probe_grouped):
+    build, probe = probe_grouped
+    plan, red = _exact_wire(comm, build, probe, SPEC_PROBE)
+    assert "partials" in plan.wire
+    assert plan.wire["partials"]["bytes_total"] == \
+        red["partials.wire_bytes"]
+
+
+@pytest.mark.hier
+def test_wire_exact_hierarchical_partials(probe_grouped):
+    hcomm = HierarchicalTpuCommunicator(n_slices=2, n_ranks=8)
+    build, probe = probe_grouped
+    plan, _ = _exact_wire(hcomm, build, probe, SPEC_PROBE,
+                          shuffle="hierarchical")
+    assert "ici_bytes_per_rank" in plan.wire["partials"]
+
+
+def test_wire_columns_shrink(comm, tables):
+    """Pushdown ships ONLY the columns the reduction reads: a spec
+    touching one payload must move fewer bytes than the full join."""
+    build, probe = tables
+    spec = AggregateSpec.of("key", [("count", None)])
+    plan_agg, _ = _exact_wire(comm, build, probe, spec)
+    plan_full = planning.build_plan(
+        comm,
+        build.pad_to(-(-build.capacity // 8) * 8),
+        probe.pad_to(-(-probe.capacity // 8) * 8),
+        key="key", with_metrics=True)
+    assert plan_agg.wire["build"]["bytes_total"] < \
+        plan_full.wire["build"]["bytes_total"]
+    # count-only: neither payload rides the wire.
+    assert [c[0] for c in plan_agg.build.columns] == ["key"]
+
+
+def test_plan_digest_equals_cache_key(comm, tables):
+    build, probe = tables
+    cache = JoinProgramCache(comm)
+    res = distributed_inner_join(build, probe, comm, key="key",
+                                 aggregate=SPEC_KEY,
+                                 program_cache=cache, explain=True)
+    assert res.plan.pipeline == "join_agg"
+    assert res.plan.aggregate["mode"] == "key"
+    assert res.plan.digest in {s.digest() for s in cache._entries}
+
+
+def test_cost_drops_expand(comm, tables):
+    """cost.predict prices the pushdown without the expand constant:
+    a join_agg plan's join stage must undercut the materializing
+    plan's at the same shapes."""
+    build, probe = tables
+    n = comm.n_ranks
+    b = build.pad_to(-(-build.capacity // n) * n)
+    p = probe.pad_to(-(-probe.capacity // n) * n)
+    agg = planning.build_plan(comm, b, p, key="key",
+                              aggregate=SPEC_KEY)
+    full = planning.build_plan(comm, b, p, key="key")
+    assert agg.cost["stages"]["join"] < full.cost["stages"]["join"]
+
+
+# -- serving: cache / service / daemon / resident / tuner --------------
+
+
+def test_warm_cache_zero_traces(comm, tables):
+    build, probe = tables
+    cache = JoinProgramCache(comm)
+    distributed_inner_join(build, probe, comm, key="key",
+                           aggregate=SPEC_KEY, program_cache=cache)
+    t0 = cache.traces
+    distributed_inner_join(build, probe, comm, key="key",
+                           aggregate=SPEC_KEY, program_cache=cache)
+    assert cache.traces == t0
+    # the materializing join of the same tables keys its OWN program
+    distributed_inner_join(build, probe, comm, key="key",
+                           program_cache=cache, out_capacity_factor=8.0)
+    assert cache.traces == t0 + 1
+
+
+@pytest.mark.service
+def test_service_aggregate_counters_and_history(comm, tables,
+                                                tmp_path):
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+    from distributed_join_tpu.telemetry.analyze import check_file
+
+    build, probe = tables
+    svc = JoinService(comm, ServiceConfig(history_dir=str(tmp_path)))
+    r1 = svc.join(build, probe, aggregate=SPEC_KEY)
+    r2 = svc.join(build, probe, aggregate=SPEC_KEY)
+    st = svc.stats()
+    assert st["aggregate"]["queries"] == 2
+    assert st["aggregate"]["warm_hits"] == 1
+    assert st["aggregate"]["groups_emitted"] == 2 * r1.agg_groups
+    prom = svc.prometheus_metrics()
+    for g in ("djtpu_agg_queries_total", "djtpu_agg_warm_hits_total",
+              "djtpu_agg_groups_emitted_total"):
+        assert g in prom
+    hist = tmp_path / "history.jsonl"
+    assert not check_file(str(hist))
+    entries = [json.loads(ln) for ln in hist.read_text().splitlines()]
+    stamped = [e for e in entries if e.get("aggregate")]
+    assert len(stamped) == 2
+    assert stamped[0]["aggregate"]["group_keys"] == ["key"]
+    assert stamped[0]["aggregate"]["groups"] == r1.agg_groups
+    # a broken stamp must fail validation
+    bad = dict(stamped[0], aggregate={"oops": 1})
+    bad_path = tmp_path / "bad.jsonl"
+    bad_path.write_text(json.dumps(bad) + "\n")
+    assert any("aggregate stamp" in p for p in check_file(str(bad_path)))
+
+
+@pytest.mark.service
+def test_daemon_wire_aggregate(comm):
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceClient,
+        ServiceConfig,
+        start_daemon,
+    )
+
+    svc = JoinService(comm, ServiceConfig())
+    server, port = start_daemon(svc)
+    try:
+        c = ServiceClient("127.0.0.1", port)
+        spec_wire = {"group_by": ["key"],
+                     "aggs": [["count"], ["sum", "probe_payload"]]}
+        r1 = c.send({"op": "join", "build_nrows": 512,
+                     "probe_nrows": 1024, "rand_max": 128,
+                     "selectivity": 0.6, "aggregate": spec_wire})
+        assert r1["ok"] and r1["groups"] > 0
+        r2 = c.send({"op": "join", "build_nrows": 512,
+                     "probe_nrows": 1024, "rand_max": 128,
+                     "selectivity": 0.6, "aggregate": spec_wire})
+        assert r2["new_traces"] == 0
+        assert (r2["groups"], r2["matches"]) == (r1["groups"],
+                                                 r1["matches"])
+        r3 = c.send({"op": "explain", "build_nrows": 512,
+                     "probe_nrows": 1024, "aggregate": spec_wire})
+        assert r3["ok"] and r3["plan"]["pipeline"] == "join_agg"
+        c.close()
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.resident
+def test_resident_aggregate_probe_only(comm, tables):
+    from distributed_join_tpu.service.resident import (
+        ResidentTableRegistry,
+    )
+
+    build, probe = tables
+    cache = JoinProgramCache(comm)
+    reg = ResidentTableRegistry(comm, cache)
+    reg.register("t", build, key="key")
+    spec = AggregateSpec.of("key", [("count", None),
+                                    ("sum", "probe_payload"),
+                                    ("sum", "build_payload")])
+    r1 = reg.join("t", probe, aggregate=spec)
+    got = groups_frame(r1.table, spec, ["key"])
+    want = aggregate_oracle(build, probe, "key", spec)
+    assert frames_equal(got, want)
+    t0 = cache.traces
+    r2 = reg.join("t", probe, aggregate=spec)
+    assert cache.traces == t0 and r2.resident["warm"]
+    # the materializing probe-only join keys its own program
+    reg.join("t", probe, out_capacity_factor=8.0)
+    assert cache.traces == t0 + 1
+
+
+@pytest.mark.tuner
+def test_tuner_keys_aggregate_workloads_and_skips_skew(comm, tables):
+    from distributed_join_tpu.planning.tuner import (
+        JoinTuner,
+        workload_signature,
+    )
+
+    build, probe = tables
+    sig_agg = workload_signature(comm, build, probe, key="key",
+                                 aggregate=SPEC_KEY)
+    sig_full = workload_signature(comm, build, probe, key="key")
+    assert sig_agg != sig_full
+    # a history screaming "skew!" must not fill skew_threshold into a
+    # pushdown workload — the fused pipeline refuses the sidecar.
+    tuner = JoinTuner(min_entries=1)
+    entry = {
+        "signature": sig_agg, "outcome": "served", "op": "join",
+        "wall_s": 0.1, "retry": {},
+        "counter_signature": None,
+        "indicators": {"matches": {"gini": 0.99,
+                                   "max_over_mean": 8.0}},
+    }
+    tuner.observe_entry(entry)
+    cfg = tuner.recommend(sig_agg,
+                          user_opts={"aggregate": SPEC_KEY})
+    assert "skew_threshold" not in cfg.structural
+    cfg2 = tuner.recommend(sig_agg, user_opts={})
+    assert cfg2.structural.get("skew_threshold") is not None
+
+
+# -- chaos slice: corruption refuses, never wrong sums -----------------
+
+
+@pytest.mark.chaos
+def test_corruption_refuses_not_wrong_sums(tables):
+    from distributed_join_tpu.parallel import integrity
+    from distributed_join_tpu.parallel.faults import (
+        FaultInjectingCommunicator,
+        FaultPlan,
+    )
+
+    build, probe = tables
+    plan = FaultPlan(corrupt_mode="bit_flip", corrupt_collectives=2,
+                     seed=5)
+    ccomm = FaultInjectingCommunicator(TpuCommunicator(n_ranks=8),
+                                       plan)
+    with pytest.raises(integrity.IntegrityError):
+        distributed_inner_join(build, probe, ccomm, key="key",
+                               aggregate=SPEC_KEY,
+                               verify_integrity=True, auto_retry=0)
+    # with budget the rerun exhausts the injected corruption and the
+    # verified-clean result matches the oracle
+    ccomm2 = FaultInjectingCommunicator(
+        TpuCommunicator(n_ranks=8),
+        FaultPlan(corrupt_mode="bit_flip", corrupt_collectives=2,
+                  seed=5))
+    res = distributed_inner_join(build, probe, ccomm2, key="key",
+                                 aggregate=SPEC_KEY,
+                                 verify_integrity=True, auto_retry=3)
+    assert res.integrity_report.ok
+    got = groups_frame(res.table, SPEC_KEY, ["key"])
+    want = aggregate_oracle(build, probe, "key", SPEC_KEY)
+    assert frames_equal(got, want)
+
+
+@pytest.mark.chaos
+def test_partials_exchange_corruption_detected(probe_grouped):
+    """Probe mode's partials exchange is a digest channel of its own
+    — corruption landing there must fail verification too."""
+    from distributed_join_tpu.parallel import integrity
+    from distributed_join_tpu.parallel.faults import (
+        FaultInjectingCommunicator,
+        FaultPlan,
+    )
+
+    build, probe = probe_grouped
+    hit = False
+    # Sweep the corruption budget so at least one trial lands its
+    # bit-flip on the partials exchange (the LAST collectives traced).
+    for budget in (5, 6, 7, 8):
+        ccomm = FaultInjectingCommunicator(
+            TpuCommunicator(n_ranks=8),
+            FaultPlan(corrupt_mode="bit_flip",
+                      corrupt_collectives=budget, seed=11))
+        try:
+            distributed_inner_join(build, probe, ccomm, key="key",
+                                   aggregate=SPEC_PROBE,
+                                   verify_integrity=True,
+                                   auto_retry=0)
+        except integrity.IntegrityError as exc:
+            hit = True
+            channels = {m["channel"]
+                        for m in exc.report.mismatches}
+            if "partials" in channels:
+                return
+    assert hit, "no corruption detected across the sweep"
