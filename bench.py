@@ -351,6 +351,21 @@ def _run(args=None) -> dict:
     # documents the driver-path contract). The workload identity keys
     # ride the record so the end-of-run --history entry files under
     # the same signature the lookup used.
+    # --sort-mode: the headline bench A/Bs the flat default against
+    # the segmented-sort pipeline on real chips (ROOFLINE §9; relay
+    # step 10). auto = the shared resolution's verdict at this shape.
+    sort_mode = getattr(args, "sort_mode", None) or "flat"
+    if sort_mode == "auto":
+        from distributed_join_tpu.benchmarks import resolve_sort_mode
+        from distributed_join_tpu.parallel.distributed_join import (
+            DEFAULT_SHUFFLE_CAPACITY_FACTOR as _DSCF,
+        )
+
+        sort_mode = resolve_sort_mode(
+            args, n_dev, 1, BUILD_NROWS // max(n_dev, 1),
+            PROBE_NROWS // max(n_dev, 1), _DSCF, shuffle_mode,
+            n_slices=slices or 1,
+            dcn_codec=getattr(args, "dcn_codec", "auto") or "auto")
     workload = {k: v for k, v in {
         "benchmark": "bench",
         "n_ranks": n_dev,
@@ -362,6 +377,9 @@ def _run(args=None) -> dict:
         "slices": slices if (slices or 1) > 1 else None,
         "dcn_codec": ((getattr(args, "dcn_codec", "auto") or "auto")
                       if shuffle_mode == "hierarchical" else None),
+        "sort_mode": sort_mode if sort_mode != "flat" else None,
+        "sort_segments": (getattr(args, "sort_segments", None)
+                          if sort_mode != "flat" else None),
     }.items() if v is not None}
     tuned_sizing, tuned_rung, tuned_rec = {}, 0, None
     if args is not None:
@@ -391,6 +409,10 @@ def _run(args=None) -> dict:
                      shuffle=shuffle_mode,
                      dcn_codec=getattr(args, "dcn_codec", "auto")
                      or "auto")
+    if sort_mode != "flat":
+        join_base["sort_mode"] = sort_mode
+        if getattr(args, "sort_segments", None):
+            join_base["sort_segments"] = args.sort_segments
 
     def measure(out_rows_per_rank=None):
         # Overflow escalates instead of crashing (faults.CapacityLadder

@@ -477,6 +477,26 @@ def add_robustness_args(parser) -> None:
              "unguarded (hours-long out-of-core runs are legitimate)",
     )
     parser.add_argument(
+        "--sort-mode", choices=["flat", "segmented", "auto"],
+        default=None,
+        help="local-sort pipeline (docs/ROOFLINE.md §9): 'flat' is "
+             "the existing merged sort, 'segmented' rides the "
+             "shuffle's free bucketing — sub-bucket hash bits on the "
+             "sender's existing partition sort, per-segment padded "
+             "receive blocks, one batched short-run lax.sort at the "
+             "receiver (the §6 run-length regime). 'auto' segments "
+             "exactly when the shared resolution "
+             "(ops/segmented.resolve_sort_segments) would and the "
+             "shuffle mode supports it. Default: flat (the exact "
+             "existing program)",
+    )
+    parser.add_argument(
+        "--sort-segments", type=int, default=None, metavar="N",
+        help="override the segmented-sort segment count per (batch, "
+             "rank) receive (default: resolve_sort_segments from the "
+             "table shapes — the plan's shared owner)",
+    )
+    parser.add_argument(
         "--auto-tune", nargs="?", const="", default=None,
         metavar="HISTORY",
         help="consult the history-driven autotuner "
@@ -503,6 +523,8 @@ FORWARDED_CHILD_FLAGS = (
     ("--history", "history", True),
     ("--explain", "explain", False),
     ("--stage-profile", "stage_profile", True),
+    ("--sort-mode", "sort_mode", True),
+    ("--sort-segments", "sort_segments", True),
     ("--auto-tune", "auto_tune", True),
     ("--verify-integrity", "verify_integrity", False),
     ("--chaos-seed", "chaos_seed", True),
@@ -586,6 +608,46 @@ def tuned_driver_record(tuner, workload: dict):
     rec["applied"] = dict(cfg.sizing)
     rec.pop("structural", None)
     return dict(cfg.sizing), cfg.rung, rec
+
+
+def resolve_sort_mode(args, n_ranks: int, k: int, b_local: int,
+                      p_local: int, shuffle_factor: float,
+                      shuffle: str, n_slices: int = 1,
+                      dcn_codec: str = "auto",
+                      compression_bits=None,
+                      kernel_config=None) -> str:
+    """The drivers' ``--sort-mode`` resolution — and THE one owner of
+    auto's eligibility verdict: flat/segmented pass through verbatim
+    (the step refuses unsupported combinations loudly); ``auto``
+    picks "segmented" exactly when the shared segment-count owner
+    (ops/segmented.resolve_sort_segments) would actually segment at
+    this shape AND the combination compiles — never over the ragged
+    wire, the compressed wire, explicit kernel flags, or a
+    hierarchical mesh whose DCN codec resolves on (the step refuses
+    all of those; auto must pick a config that runs, not an error).
+    Unset = flat, the exact existing program."""
+    mode = getattr(args, "sort_mode", None) or "flat"
+    if mode != "auto":
+        return mode
+    if (shuffle == "ragged" or n_ranks * k <= 1
+            or compression_bits is not None
+            or kernel_config is not None):
+        return "flat"
+    if shuffle == "hierarchical" and n_slices > 1:
+        from distributed_join_tpu.planning.cost import (
+            resolve_dcn_codec,
+        )
+
+        if resolve_dcn_codec(dcn_codec or "auto"):
+            return "flat"
+    from distributed_join_tpu.ops.segmented import (
+        resolve_sort_segments,
+    )
+
+    segs = resolve_sort_segments(
+        getattr(args, "sort_segments", None), max(b_local, p_local),
+        n_ranks, k, shuffle_factor)
+    return "segmented" if segs > 1 else "flat"
 
 
 def maybe_chaos_communicator(comm, args):

@@ -100,6 +100,10 @@ def run(args) -> dict:
             "--stage-profile needs the multi-stage join pipeline; "
             "this microbenchmark IS one shuffle stage — its timed "
             "wall already answers per-stage timing")
+    if getattr(args, "sort_mode", None) not in (None, "flat"):
+        raise SystemExit(
+            "--sort-mode selects the join's LOCAL sort pipeline; "
+            "this microbenchmark has no local sort")
     apply_platform(args.platform, args.n_ranks)
     comm = maybe_chaos_communicator(
         make_communicator(args.communicator, n_ranks=args.n_ranks),

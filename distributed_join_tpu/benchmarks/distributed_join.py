@@ -178,6 +178,17 @@ def parse_args(argv=None):
                         "record under 'agg_ab' (docs/AGGREGATION.md). "
                         "Shapes the pushdown refuses (string keys, "
                         "the skew sidecar) skip with a named reason")
+    p.add_argument("--sort-ab", type=int, default=0, metavar="N",
+                   help="after the timed run: time N warm segmented-"
+                        "sort dispatches vs N warm flat dispatches of "
+                        "the same join (docs/ROOFLINE.md §9), both "
+                        "graded against the pandas oracle with full-"
+                        "content multiset comparison — one record "
+                        "under 'sort_ab' with the segmented counter "
+                        "signature (the sortpath_smoke baseline "
+                        "gate). Shapes the segmented path refuses "
+                        "(ragged wire, compression, kernel flags) "
+                        "skip with a named reason")
     p.add_argument("--resident-ab", type=int, default=0, metavar="N",
                    help="after the timed run: register the build "
                         "table as a resident image (service/"
@@ -400,6 +411,24 @@ def run(args) -> dict:
     from distributed_join_tpu.parallel.faults import CapacityLadder
 
     skew_on = skew_threshold is not None
+    # --sort-mode: flat/segmented verbatim (the step refuses
+    # unsupported combinations loudly); auto segments exactly when the
+    # shared resolution would AND nothing flat-only is armed (the
+    # compressed wire and the kernel knobs belong to the flat
+    # pipeline — auto must pick a config that compiles, not refuse).
+    kernel_cfg = _kernel_config_from_args(args)
+    sort_mode = args.sort_mode or "flat"
+    if sort_mode == "auto":
+        from distributed_join_tpu.benchmarks import resolve_sort_mode
+
+        sort_mode = resolve_sort_mode(
+            args, n, args.over_decomposition_factor, b_rows // n,
+            p_rows // n, args.shuffle_capacity_factor,
+            args.shuffle, n_slices=comm.n_slices,
+            dcn_codec=args.dcn_codec,
+            compression_bits=(args.compression_bits
+                              if args.compression else None),
+            kernel_config=kernel_cfg)
     # --auto-tune: pre-size the ladder from this workload's history
     # (planning/tuner.py) — a repeat run starts at the rung its
     # ladder previously escalated to instead of re-paying the
@@ -435,6 +464,10 @@ def run(args) -> dict:
             "skew_threshold": skew_threshold,
             "string_payload_bytes": args.string_payload_bytes,
             "string_key_bytes": args.string_key_bytes,
+            "sort_mode": (sort_mode if sort_mode != "flat"
+                          else None),
+            "sort_segments": (args.sort_segments
+                              if sort_mode != "flat" else None),
         }.items() if v is not None}
         tuned_sizing, tuned_rung, tuned_rec = tuned_driver_record(
             tuner, workload)
@@ -498,10 +531,17 @@ def run(args) -> dict:
         key=join_key,
         shuffle=args.shuffle,
         dcn_codec=args.dcn_codec,
-        kernel_config=_kernel_config_from_args(args),
+        kernel_config=kernel_cfg,
         over_decomposition=args.over_decomposition_factor,
         skew_threshold=skew_threshold,
         hh_slots=args.hh_slots,
+        sort_mode=sort_mode,
+        # Segmented-only knob (the step refuses it under flat): a
+        # bare --sort-segments with the flat default — e.g. armed
+        # only for a --sort-ab side pass — must not fork the timed
+        # flat program's signature.
+        sort_segments=(args.sort_segments
+                       if sort_mode == "segmented" else None),
     )
     iters = args.iterations
 
@@ -588,6 +628,15 @@ def run(args) -> dict:
             comm, build, probe, join_key, args.agg_ab,
             dict(fixed_opts, **ladder.sizing()), args)
 
+    # --sort-ab: the segmented-sort lever measured in place (ROADMAP
+    # item 2 / docs/ROOFLINE.md §9): N warm segmented dispatches vs N
+    # warm flat dispatches of the same join, both oracle-graded.
+    sort_ab = None
+    if args.sort_ab > 0:
+        sort_ab = _sort_ab(
+            comm, build, probe, join_key, args.sort_ab,
+            dict(fixed_opts, **ladder.sizing()), args)
+
     rows = b_rows + p_rows
     rows_per_sec = rows / sec_per_join
     record = {
@@ -623,8 +672,15 @@ def run(args) -> dict:
         "variable_length_strings": args.variable_length_strings,
         "string_key_bytes": args.string_key_bytes,
         "string_wire_bytes": _string_wire_accounting(build, args.shuffle),
+        # Normalized like slices/dcn_codec (non-default else None):
+        # sort_mode/sort_segments are WORKLOAD_KEYS, so the history
+        # entry must hash what the --auto-tune lookup hashed.
+        "sort_mode": sort_mode if sort_mode != "flat" else None,
+        "sort_segments": (args.sort_segments
+                          if sort_mode != "flat" else None),
         "resident_ab": resident_ab,
         "agg_ab": agg_ab,
+        "sort_ab": sort_ab,
         "tuned": tuned_rec,
         "matches_per_join": matches,
         "overflow": overflow,
@@ -837,6 +893,156 @@ def _agg_ab(comm, build, probe, join_key, n_joins, join_opts, args):
                                                       oracle),
         "oracle_equal_materialize": agg_ops.frames_equal(mat_frame,
                                                          oracle),
+        "counter_signature": baselines.counter_signature(
+            metrics.to_dict()),
+    }
+
+
+def _sort_ab(comm, build, probe, join_key, n_joins, join_opts, args):
+    """The in-driver segmented-vs-flat sort A/B (docs/ROOFLINE.md §9):
+    the SAME join answered by both local-sort pipelines — warm flat
+    dispatches vs warm segmented dispatches through one program cache
+    (the warm segmented passes must add zero traces) — each graded
+    against the pandas oracle with full-content multiset comparison,
+    both min-walls in one record. Shapes the segmented path refuses
+    skip with a NAMED reason. The record carries the segmented step's
+    deterministic counter signature (the sortpath_smoke baseline
+    gate) and the plan-vs-measured wire verdict."""
+    from distributed_join_tpu import planning
+    from distributed_join_tpu.parallel.distributed_join import (
+        JOIN_METRICS_SHARDED_OUT,
+    )
+    from distributed_join_tpu.service.programs import JoinProgramCache
+    from distributed_join_tpu.telemetry import baselines
+
+    if join_opts.get("shuffle") == "ragged":
+        return {"skipped": "ragged wire: the segmented path needs "
+                           "static receive boundaries"}
+    if join_opts.get("compression_bits") is not None:
+        return {"skipped": "compressed wire: the codec's per-block "
+                           "framing and the fine layout are disjoint"}
+    if join_opts.get("kernel_config") is not None:
+        return {"skipped": "explicit kernel flags tune the flat "
+                           "pipeline; the segmented path is the "
+                           "batched XLA formulation"}
+    if join_opts.get("shuffle") == "hierarchical" \
+            and comm.n_slices > 1:
+        from distributed_join_tpu.planning.cost import (
+            resolve_dcn_codec,
+        )
+
+        if resolve_dcn_codec(join_opts.get("dcn_codec") or "auto"):
+            return {"skipped": "hierarchical DCN codec armed: the "
+                               "codec's per-block framing and the "
+                               "fine layout are disjoint — rerun "
+                               "with --dcn-codec off"}
+    if comm.n_ranks * (join_opts.get("over_decomposition") or 1) <= 1:
+        return {"skipped": "single-bucket mesh: the segmented and "
+                           "flat paths are the same program"}
+    from distributed_join_tpu.ops.segmented import (
+        resolve_sort_segments,
+    )
+    from distributed_join_tpu.parallel.distributed_join import (
+        DEFAULT_SHUFFLE_CAPACITY_FACTOR,
+    )
+
+    n = comm.n_ranks
+    segs = resolve_sort_segments(
+        args.sort_segments, max(build.capacity, probe.capacity) // n,
+        n, join_opts.get("over_decomposition") or 1,
+        join_opts.get("shuffle_capacity_factor")
+        or DEFAULT_SHUFFLE_CAPACITY_FACTOR)
+    if segs <= 1:
+        return {"skipped": "segment resolution is 1 at this shape "
+                           "(flat parity) — pass --sort-segments N "
+                           "to force a segmentation"}
+
+    opts = {k: v for k, v in join_opts.items()
+            if k not in ("key", "sort_mode", "sort_segments")}
+    cache = JoinProgramCache(comm)
+
+    def run_mode(mode):
+        fn, _ = cache.get(build, probe, key=join_key,
+                          with_metrics=False, sort_mode=mode,
+                          sort_segments=segs if mode == "segmented"
+                          else None, **opts)
+        res = fn(build, probe)
+        jax.block_until_ready(res.total)
+        return res
+
+    flat_res = run_mode("flat")              # warm both programs
+    seg_res = run_mode("segmented")
+    if bool(flat_res.overflow) or bool(seg_res.overflow):
+        return {"skipped": "overflow at this sizing — rerun with "
+                           "larger capacity factors (a clamped A/B "
+                           "would time partial answers)",
+                "overflow_flat": bool(flat_res.overflow),
+                "overflow_segmented": bool(seg_res.overflow)}
+    traces0 = cache.traces
+    walls = {"flat": [], "segmented": []}
+    for mode in ("flat", "segmented"):
+        for _ in range(n_joins):
+            t0 = time.perf_counter()
+            res = run_mode(mode)
+            walls[mode].append(time.perf_counter() - t0)
+
+    def norm(res):
+        df = res.table.to_pandas()
+        cols = sorted(df.columns)
+        return df[cols].sort_values(cols).reset_index(drop=True)
+
+    import pandas as pd
+
+    keys = [join_key] if isinstance(join_key, str) else list(join_key)
+    bdf = build.to_pandas()
+    pdf = probe.to_pandas()
+    clash = [c for c in bdf.columns if c in pdf.columns
+             and c not in keys]
+    oracle = pd.merge(bdf, pdf.drop(columns=clash, errors="ignore")
+                      if clash else pdf, on=keys)
+    oracle = oracle[sorted(oracle.columns)].sort_values(
+        sorted(oracle.columns)).reset_index(drop=True)
+    flat_df, seg_df = norm(flat_res), norm(seg_res)
+    # THE shared grading predicate (ops/aggregate.frames_equal — the
+    # same one _agg_ab and the tests use), over the sort-normalized
+    # full-content frames: a multiset comparison.
+    from distributed_join_tpu.ops.aggregate import frames_equal
+
+    # One metrics-instrumented segmented pass (untimed): the counter
+    # signature the perfgate lane gates against sortpath_smoke.json,
+    # and the plan's exact-wire verdict.
+    mstep = make_join_step(comm, key=join_key, with_metrics=True,
+                           sort_mode="segmented", sort_segments=segs,
+                           **opts)
+    _, metrics = comm.spmd(
+        mstep, sharded_out=JOIN_METRICS_SHARDED_OUT)(build, probe)
+    red = metrics.to_dict()["reduced"]
+    plan = planning.build_plan(comm, build, probe, key=join_key,
+                               with_metrics=True,
+                               sort_mode="segmented",
+                               sort_segments=segs, **opts)
+    wire_exact = all(
+        plan.wire[side]["bytes_per_rank"] * n
+        == red.get(f"{side}.wire_bytes")
+        for side in ("build", "probe"))
+    return {
+        "kind": "sort_ab",
+        "n_joins": n_joins,
+        "n_ranks": n,
+        "sort_segments": segs,
+        "matches": int(seg_res.total),
+        "matches_equal": int(seg_res.total) == int(flat_res.total),
+        "flat_wall_min_s": min(walls["flat"]),
+        "segmented_wall_min_s": min(walls["segmented"]),
+        "segmented_speedup": (min(walls["flat"])
+                              / min(walls["segmented"])
+                              if min(walls["segmented"]) else None),
+        "warm_new_traces": cache.traces - traces0,
+        "oracle_equal_flat": frames_equal(flat_df, oracle),
+        "oracle_equal_segmented": frames_equal(seg_df, oracle),
+        "multiset_equal": frames_equal(seg_df, flat_df),
+        "wire_exact": wire_exact,
+        "plan_digest": plan.digest,
         "counter_signature": baselines.counter_signature(
             metrics.to_dict()),
     }

@@ -135,6 +135,15 @@ def run(args) -> dict:
             "--stage-profile is wired for tpu-distributed-join and "
             "bench.py; profile the equivalent generator workload "
             "(tpu-distributed-join --stage-profile) instead")
+    if getattr(args, "sort_mode", None) not in (None, "flat"):
+        # The TPC-H joins carry string payload columns end to end;
+        # declining loudly beats silently timing the flat path under
+        # a segmented label.
+        raise SystemExit(
+            "--sort-mode is wired for tpu-distributed-join and "
+            "bench.py; the tpch driver runs the flat pipeline — "
+            "A/B the segmented sort on the generator workload "
+            "(tpu-distributed-join --sort-ab)")
     if ((args.manifest or args.batch_retries
          or args.continue_on_batch_failure)
             and args.batches <= 1 and not args.host_generator):

@@ -126,9 +126,10 @@ def _default_chunk(block: int) -> int:
     return chunk
 
 
-def _window_widths(block: int, chunk: int):
-    """Build-window VMEM widths, both B + alignment slop, rounded so
-    the chunked compare loop and the 128-lane tile divide them exactly.
+def _window_widths(block: int, chunk: int,
+                   window: int | None = None):
+    """Build-window VMEM widths, rounded so the chunked compare loop
+    and the 128-lane tile divide them exactly.
 
     Window 2's bound is B (not the naive 2B): middle records' runs
     tile the block, so ``lo[r] - lo[r0+1]`` across them is at most the
@@ -136,14 +137,26 @@ def _window_widths(block: int, chunk: int):
     in-block rank extent is at most the coverage that remains —
     ``(lo[r1] - lo[r0+1]) + extent(r1) <= (S[r1] - blockstart) +
     (blockend - S[r1]) = B``. build_windows_ok checks exactly this
-    quantity per block."""
+    quantity per block.
+
+    ``window`` (default: ``block``) DECOUPLES the build-window width
+    from the output block size (ROADMAP item 2a; ROOFLINE §8):
+    ``results/build_window_blocks_r4.json`` showed that widening the
+    windows by growing ``block`` scales every VMEM buffer in the
+    kernel and hits the 16M scoped-vmem wall — a wider ``window``
+    grows ONLY the two build windows (and relaxes exactly the
+    ``build_windows_ok`` bound that forces the gather fallback on
+    gap-heavy data), while the record window stays block-sized
+    (<= B+1 records ever cover a block, whatever the build windows
+    hold)."""
     lane = max(chunk, 128)
-    w1w = _round_up(block + 128, lane)
+    w1w = _round_up((window or block) + 128, lane)
     return w1w, w1w
 
 
 def build_windows_ok(S: jax.Array, lo: jax.Array, out_capacity: int,
-                     block: int | None = None) -> jax.Array:
+                     block: int | None = None,
+                     window: int | None = None) -> jax.Array:
     """Exact per-run-of-blocks validity of the two-window build scheme.
 
     Window 2 of output block i covers ranks
@@ -162,7 +175,8 @@ def build_windows_ok(S: jax.Array, lo: jax.Array, out_capacity: int,
     """
     if block is None:
         block = _default_block()
-    _, w2w = _window_widths(block, _default_chunk(block))
+    _, w2w = _window_widths(block, _default_chunk(block),
+                            window=window)
     m = S.shape[0]
     out_pad = _round_up(out_capacity, block)
     nblk = out_pad // block
@@ -505,13 +519,13 @@ def _tiled_output_launch(n_blocks, block, tile_bytes, launch, merge):
 
 
 def _expand_gather_b8(S, cols, out_capacity, block, interpret, lo,
-                      build_cols):
+                      build_cols, window=None):
     """v3 build-mode wrapper; see _expand_kernel_b8."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     chunk = _default_chunk(block)
-    w1w, w2w = _window_widths(block, chunk)
+    w1w, w2w = _window_widths(block, chunk, window=window)
     # The kernel clips every block-relative quantity to +-CL = 2^20
     # (see _expand_kernel_b8).  Those quantities are bounded by a few
     # blocks plus one window width; `block` is user-configurable
@@ -524,7 +538,11 @@ def _expand_gather_b8(S, cols, out_capacity, block, interpret, lo,
             f"({3 * block + max(w1w, w2w)}) must stay below the "
             f"2^20 block-relative clip bound"
         )
-    wr = w1w  # record window: b+128 coverage, 128-aligned, chunk-mult
+    # Record window: BLOCK-sized regardless of the build-window width
+    # (<= B+1 records ever cover a B-row block) — b+128 coverage,
+    # 128-aligned, chunk-mult. This is the decoupling: a wider
+    # `window` grows only the b1/b2 build windows below.
+    wr = _window_widths(block, chunk)[0]
     k = len(cols)
     kb = len(build_cols)
     m = S.shape[0]
@@ -684,7 +702,8 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
                   out_capacity: int, block: int | None = None,
                   interpret: bool = False,
                   lo: Optional[jax.Array] = None,
-                  build_cols: Optional[Sequence[jax.Array]] = None):
+                  build_cols: Optional[Sequence[jax.Array]] = None,
+                  window: int | None = None):
     """For each output slot j in [0, out_capacity): find the covering
     record r = max{r : S[r] <= j} and return each column's value at r,
     plus the run-start slot ``start_b[j] = S[r]``.
@@ -728,8 +747,11 @@ def expand_gather(S: jax.Array, cols: Sequence[jax.Array],
         # windows, placeholder start_b/rank (consumed in-kernel only —
         # callers on the build path never read them). Rank/start
         # arithmetic is BLOCK-RELATIVE i32 (round 4) — no 2^24 limit.
+        # ``window`` (build path only) widens the two build windows
+        # independently of the block (_window_widths).
         return _expand_gather_b8(
-            S, cols, out_capacity, block, interpret, lo, build_cols
+            S, cols, out_capacity, block, interpret, lo, build_cols,
+            window=window,
         )
     k = len(cols)
     m = S.shape[0]

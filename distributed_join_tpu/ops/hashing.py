@@ -82,10 +82,26 @@ def hash_columns(cols: Sequence[jax.Array]) -> jax.Array:
     return acc
 
 
-def bucket_ids(cols: Sequence[jax.Array], n_buckets: int) -> jax.Array:
+def bucket_ids(cols: Sequence[jax.Array], n_buckets: int,
+               sub_buckets: int = 1) -> jax.Array:
     """Row-wise bucket id in [0, n_buckets) as int32, via hash modulo
     n_buckets — fmix avalanches fully so the bottom bits are as good as
     any, and modulo matches the reference's ``hash % nranks`` routing.
-    """
+
+    ``sub_buckets`` > 1 returns the FINE id ``(h % n_buckets) *
+    sub_buckets + (h // n_buckets) % sub_buckets`` in
+    [0, n_buckets * sub_buckets): the coarse routing bucket is
+    unchanged (``fine // sub_buckets == h % n_buckets``, so the same
+    rows ride the same wire blocks), and the sub-bucket — drawn from
+    the hash bits ABOVE the routing modulus, so it is consistent
+    across sides and ranks — orders rows within each coarse bucket
+    into disjoint hash classes. The segmented-sort join pipeline
+    (ops/segmented.py) rides this as extra key bits of the partition
+    sort the sender already pays for (docs/ROOFLINE.md §8-§9)."""
     h = hash_columns(cols)
-    return (h % jnp.uint64(n_buckets)).astype(jnp.int32)
+    coarse = (h % jnp.uint64(n_buckets)).astype(jnp.int32)
+    if sub_buckets <= 1:
+        return coarse
+    seg = ((h // jnp.uint64(n_buckets))
+           % jnp.uint64(sub_buckets)).astype(jnp.int32)
+    return coarse * jnp.int32(sub_buckets) + seg

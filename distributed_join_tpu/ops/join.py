@@ -206,6 +206,35 @@ def _expand_records(S, recs: dict, out_capacity: int, j, cfg):
     return out_vals, start_b
 
 
+def _chunked_rank_gather(lanes_u64, idx: jax.Array):
+    """Gather uint64 lanes at ``idx`` through uint32 HALF-PLANES — the
+    kernel fallback's rank gather (ROADMAP item 2b; ROOFLINE §7's
+    named residual large-N cost). The measured economics (§1): XLA's
+    TPU gather is a serialized per-element loop whose cost tracks the
+    element WIDTH — 7.5M i64 gathers run 161-205 ms while i32 runs
+    70 ms — and a packed (rows, k<=4) row gather is flat in k. So
+    splitting each u64 lane into (lo32, hi32) and gathering the
+    (rows, 2k) u32 pack in one pass moves the same bytes at the
+    narrow-element rate; the halves recombine with cheap elementwise
+    shifts, bit-exactly."""
+    planes = []
+    for c in lanes_u64:
+        planes.append((c & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+        planes.append((c >> jnp.uint64(32)).astype(jnp.uint32))
+    if len(planes) == 2:
+        lo, hi = planes[0][idx], planes[1][idx]
+        return [lo.astype(jnp.uint64)
+                | (hi.astype(jnp.uint64) << jnp.uint64(32))]
+    packed = jnp.stack(planes, axis=1)
+    rows = packed[idx]
+    out = []
+    for i in range(len(lanes_u64)):
+        lo = rows[:, 2 * i].astype(jnp.uint64)
+        hi = rows[:, 2 * i + 1].astype(jnp.uint64)
+        out.append(lo | (hi << jnp.uint64(32)))
+    return out
+
+
 def _grouped_row_gather(cols: dict, idx: jax.Array) -> dict:
     """Gather rows ``idx`` from every 1-D column, one packed 2-D gather
     per dtype group (columns of a dtype are stacked, gathered once,
@@ -436,6 +465,7 @@ def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
             return expand_gather(
                 S, cols_list, out_capacity, block=cfg.block,
                 interpret=interpret, lo=lo_rec, build_cols=pack,
+                window=cfg.window,
             )
 
         def _fallback(_):
@@ -445,17 +475,12 @@ def _join_kernel_path(build, probe, keys, b1d, b2d, p1d, p2d,
             )
             rank2 = outs2[-1].astype(jnp.int32) + (j - sb2)
             safe = jnp.clip(rank2, 0, max(nb - 1, 0))
-            if len(pack) == 1:
-                bouts2 = [pack[0][safe]]
-            else:
-                packed = jnp.stack(pack, axis=1)
-                rows_g = packed[safe]
-                bouts2 = [rows_g[:, t] for t in range(len(pack))]
+            bouts2 = _chunked_rank_gather(pack, safe)
             return outs2[:-1], sb2, rank2, bouts2
 
         rec_outs, start_b, _rank, build_outs = lax.cond(
             build_windows_ok(S, lo_rec, out_capacity,
-                             block=cfg.block),
+                             block=cfg.block, window=cfg.window),
             _kernel, _fallback, None,
         )
         build_vals_u64 = dict(zip(pack_names, build_outs))

@@ -10,6 +10,11 @@ time:
 - ``DJTPU_COMPACT``       = plane | mxu (unset = auto)
 - ``DJTPU_PALLAS_BLOCK``  = EXPAND kernel block size (the
   compact/sort kernels own their block defaults)
+- ``DJTPU_PALLAS_WINDOW`` = fused-build expand BUILD-WINDOW width,
+  decoupled from the block (unset = block; ROADMAP item 2a — widening
+  the windows by growing the block scales every VMEM buffer and hits
+  the scoped-vmem wall, while a wider window grows only the two build
+  windows and relaxes the build_windows_ok fallback bound)
 
 (The expand window chunk is deliberately NOT a config field: it is an
 internal tuning constant of ops/expand_pallas.py, overridable only by
@@ -33,6 +38,7 @@ class KernelConfig:
     expand: str = "auto"             # "auto" | "pallas" | "xla"
     compact: Optional[str] = None    # None (auto) | "plane" | "mxu"
     block: Optional[int] = None
+    window: Optional[int] = None     # build-window width (None = block)
 
     def __post_init__(self):
         if self.expand not in ("auto", "pallas", "xla"):
@@ -43,15 +49,21 @@ class KernelConfig:
             raise ValueError(
                 f"compact={self.compact!r}: expected plane|mxu|None"
             )
+        if self.window is not None and self.window < 1:
+            raise ValueError(
+                f"window={self.window!r}: expected a positive width"
+            )
 
     @classmethod
     def from_env(cls) -> "KernelConfig":
         env = os.environ.get("DJTPU_PALLAS_EXPAND")
         block = os.environ.get("DJTPU_PALLAS_BLOCK")
+        window = os.environ.get("DJTPU_PALLAS_WINDOW")
         return cls(
             expand={"0": "xla", "1": "pallas"}.get(env, "auto"),
             compact=os.environ.get("DJTPU_COMPACT"),
             block=int(block) if block else None,
+            window=int(window) if window else None,
         )
 
     # -- resolution helpers (the ONE dispatch site) -------------------

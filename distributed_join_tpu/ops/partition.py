@@ -99,7 +99,7 @@ class PartitionedTable:
 
 def radix_hash_partition(
     table: Table, key_cols: Sequence[str], n_buckets: int,
-    order_within: str | None = None,
+    order_within: str | None = None, sub_buckets: int = 1,
 ) -> PartitionedTable:
     """Partition ``table`` into ``n_buckets`` by hash of ``key_cols``.
 
@@ -108,8 +108,26 @@ def radix_hash_partition(
     variable-width string wire (parallel/shuffle.shuffle_ragged's
     ``varwidth``) relies on this: with rows ordered by byte length
     desc, the rows still alive at u32 word-plane ``w`` form a PREFIX
-    of every bucket, so each plane ships as one ragged slice."""
-    b = bucket_ids([table.columns[c] for c in key_cols], n_buckets)
+    of every bucket, so each plane ships as one ragged slice.
+
+    ``sub_buckets`` > 1 partitions at FINE granularity: the result has
+    ``n_buckets * sub_buckets`` buckets, fine id ``coarse *
+    sub_buckets + seg`` with ``seg`` drawn from the hash bits above
+    the coarse modulus (ops/hashing.bucket_ids). The coarse routing is
+    unchanged — fine buckets of one coarse bucket are contiguous —
+    so the segmented-sort pipeline's sub-bucket ordering rides the
+    SAME partition sort the flat pipeline already pays for (the
+    zero-added-routing-cost contract of docs/ROOFLINE.md §9).
+    Incompatible with ``order_within`` (the ragged varwidth wire and
+    the segmented layout are disjoint modes by contract)."""
+    if sub_buckets > 1 and order_within is not None:
+        raise ValueError(
+            "sub_buckets and order_within are mutually exclusive: the "
+            "within-bucket order slot is either the segment id or the "
+            "varwidth length, never both")
+    b = bucket_ids([table.columns[c] for c in key_cols], n_buckets,
+                   sub_buckets=sub_buckets)
+    n_buckets = n_buckets * max(int(sub_buckets), 1)
     # Padding rows get bucket n_buckets so they sort after every real bucket.
     b = jnp.where(table.valid, b, jnp.int32(n_buckets))
     # One stable 32-bit sort (bucket id key + int32 row index) — NOT

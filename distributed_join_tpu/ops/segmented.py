@@ -1,0 +1,373 @@
+"""Segmented-sort local join: batched short-run sorts over the
+shuffle's free bucketing.
+
+docs/ROOFLINE.md §6 measured that ``lax.sort`` cost is run-length, not
+element, dominated — the identical 20M x (i64, i8, i64) operands sort
+in 166 ms flat but 24-45 ms as independent runs — and §8 refuted every
+LOCAL route into that regime: routing rows into B buckets costs more
+than the sort it would save, because the v5e has no fast binned write.
+The closing sentence of §8 is the design here: "the run-length effect
+pays only when data ARRIVES pre-bucketed — which is exactly what the
+cross-rank shuffle provides."
+
+The segmented pipeline (``make_join_step(sort_mode="segmented")``,
+docs/ROOFLINE.md §9) cashes that sentence:
+
+- the SENDER partitions at fine granularity — ``s`` sub-buckets per
+  (batch, destination) bucket, the sub-bucket drawn from the hash bits
+  above the routing modulus (ops/hashing.bucket_ids) — as extra key
+  bits of the partition sort it already pays for. §8's refuted local
+  radix problem never arises: there is no second routing pass.
+- the WIRE pads each fine bucket to a static per-segment capacity
+  (parallel/shuffle.shuffle_segmented), so the receiver holds
+  statically-bounded (src, segment) blocks and a fine count matrix.
+- the RECEIVER reshapes the blocks into a ``(segments, run)`` batch —
+  segment j's run concatenates every source's segment-j slots — and
+  sorts ALL runs in one batched ``lax.sort`` (sorting along the last
+  axis, independent per segment): the §6 fast regime, entered for
+  free.
+- segments are DISJOINT HASH CLASSES (equal keys share the hash,
+  hence the segment), so matches cannot cross segments and the whole
+  scan/compact/expand pipeline runs batched per segment with the same
+  capacity contract the over-decomposition batches already use: each
+  segment owns an ``out_capacity`` output block, any segment
+  overflowing it raises the shared flag, and the ladder's out-factor
+  escalation grows every block.
+
+:func:`batched_sort_merge_inner_join` is the XLA formulation of
+ops/join.py's sort-merge pipeline with a leading segment axis on every
+operand — same three sorts (batched), same scans (axis 1), same
+one-small-scatter expansion (flattened across segments with per-segment
+slot offsets), same packed per-dtype gathers (``take_along_axis``).
+The output is the same multiset of rows the flat pipeline produces
+(graded bit-exact against it and the pandas oracle in
+tests/test_sortpath.py); only the row ORDER differs (segment-major
+instead of globally key-major), which no contract in this repo
+observes — results are validity-masked multisets everywhere.
+
+:func:`resolve_sort_segments` is THE one owner of the segment-count
+resolution, shared by ``make_join_step``, ``planning.build_plan`` and
+the stage profiler so a plan and the program it predicts can never
+disagree on the segmentation (the ``resolve_join_ladder`` discipline).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_join_tpu.ops.join import (
+    _dtype_sentinel_max,
+    _holds_i32_exactly,
+    _I32_MAX,
+)
+from distributed_join_tpu.table import Table
+
+# ROOFLINE §6: the batched-run speedup holds for runs up to ~32K
+# elements ((512, 32768): 38 ms vs 166 flat); beyond it the sort is
+# back in the superlinear regime. The resolver halves run length until
+# it fits — or until fine buckets would drop under MIN_SEGMENT_CAPACITY
+# rows, where per-bucket pad overhead (round-to-8 plus headroom slack)
+# starts dominating the wire.
+SEGMENT_TARGET_RUN = 32768
+MIN_SEGMENT_CAPACITY = 64
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def segment_capacity(rows_local: int, n_ranks: int, k: int,
+                     segments: int, factor: float) -> int:
+    """Static per-(sender, destination, segment) fine-bucket capacity:
+    the flat per-bucket arithmetic of ``make_join_step`` one level
+    down (float order preserved — the exact wire gate depends on it).
+    ``segments == 1`` reproduces the flat per-bucket capacity."""
+    return _round_up(
+        int(math.ceil(rows_local / (n_ranks * k * segments) * factor)),
+        8)
+
+
+def segmented_out_capacity(p_local: int, k: int, segments: int,
+                           out_factor: float,
+                           out_rows_per_rank: Optional[int]) -> int:
+    """Static per-(batch, segment) output block: the over-decomposition
+    batches' out-capacity contract, one level down."""
+    if out_rows_per_rank is not None:
+        return _round_up(
+            int(math.ceil(int(out_rows_per_rank) / (k * segments))), 8)
+    return _round_up(
+        int(math.ceil(p_local / (k * segments) * out_factor)), 8)
+
+
+def resolve_sort_segments(sort_segments: Optional[int],
+                          rows_local: int, n_ranks: int, k: int,
+                          factor: float) -> int:
+    """THE segment-count resolution (one owner; module docstring).
+
+    Explicit ``sort_segments`` wins verbatim (>= 1; it need not divide
+    anything — capacities round per fine bucket). Auto (None): double
+    the segment count until the receive run ``n_ranks *
+    segment_capacity`` fits SEGMENT_TARGET_RUN, stopping early when
+    the next doubling would shrink fine buckets below
+    MIN_SEGMENT_CAPACITY. Deterministic host arithmetic over the same
+    inputs the plan holds, so plan and program always agree."""
+    if sort_segments is not None:
+        s = int(sort_segments)
+        if s < 1:
+            raise ValueError("sort_segments must be >= 1")
+        return s
+    s = 1
+    while (n_ranks * segment_capacity(rows_local, n_ranks, k, s,
+                                      factor) > SEGMENT_TARGET_RUN
+           and segment_capacity(rows_local, n_ranks, k, 2 * s,
+                                factor) >= MIN_SEGMENT_CAPACITY):
+        s *= 2
+    return s
+
+
+def runs_from_blocks(recv_cols: dict, recv_counts: jax.Array):
+    """Reshape one side's received ``(n_src, segments, seg_cap, ...)``
+    blocks + ``(n_src, segments)`` fine counts into the
+    ``(segments, run)`` batch the batched join consumes: segment j's
+    run concatenates every source's segment-j slots (sources are
+    interchangeable within a hash class — the join masks validity).
+    Returns ``(cols, valid)`` with cols ``(segments, n_src * seg_cap,
+    ...)``."""
+    n, s, cap = next(iter(recv_cols.values())).shape[:3]
+    cols = {
+        name: c.swapaxes(0, 1).reshape((s, n * cap) + c.shape[3:])
+        for name, c in recv_cols.items()
+    }
+    lane = jnp.arange(cap, dtype=jnp.int32)
+    valid = (lane[None, None, :] < recv_counts[:, :, None]) \
+        .swapaxes(0, 1).reshape(s, n * cap)
+    return cols, valid
+
+
+def _grouped_take(cols: dict, idx: jax.Array) -> dict:
+    """Batched mirror of ops/join._grouped_row_gather: gather rows
+    ``idx[seg, j]`` from every (segments, R) column, one packed
+    take_along_axis per dtype group."""
+    groups: dict = {}
+    for name, c in cols.items():
+        groups.setdefault(c.dtype, []).append(name)
+    out = {}
+    for dt, names in groups.items():
+        if len(names) == 1:
+            c = cols[names[0]]
+            out[names[0]] = jnp.take_along_axis(c, idx, axis=1)
+        else:
+            pack = jnp.stack([cols[n] for n in names], axis=2)
+            rows = jnp.take_along_axis(pack, idx[:, :, None], axis=1)
+            for j, n in enumerate(names):
+                out[n] = rows[:, :, j]
+    return out
+
+
+def batched_sort_merge_inner_join(
+    bcols: dict, bvalid: jax.Array,
+    pcols: dict, pvalid: jax.Array,
+    keys: Sequence[str], out_capacity: int,
+    build_payload: Optional[Sequence[str]] = None,
+    probe_payload: Optional[Sequence[str]] = None,
+    _internal: Sequence[str] = (),
+):
+    """Inner-join ``segments`` disjoint (build, probe) run pairs in one
+    batched pipeline; see the module docstring for the scheme.
+
+    ``bcols``/``pcols`` map names to ``(segments, R[, trailing])``
+    arrays with ``bvalid``/``pvalid`` the (segments, R) masks;
+    ``out_capacity`` is PER SEGMENT. Returns ``(table, total,
+    overflow)`` — the table flattened segment-major to ``segments *
+    out_capacity`` masked rows (keys, build payloads, probe payloads,
+    the flat join's column order), ``total`` the int64 global match
+    count, ``overflow`` True iff any segment's matches exceed its
+    block (the caller folds it into the shared ladder flag).
+    """
+    keys = list(keys)
+    if build_payload is None:
+        build_payload = [n for n in bcols if n not in keys]
+    if probe_payload is None:
+        probe_payload = [n for n in pcols if n not in keys]
+    clash = set(build_payload) & set(probe_payload)
+    if clash:
+        raise ValueError(f"payload name collision: {sorted(clash)}")
+    reserved = [
+        nm for nm in (*keys, *build_payload, *probe_payload)
+        if nm.startswith("__") and nm not in _internal
+    ]
+    if reserved:
+        raise ValueError(
+            "column names starting with '__' are reserved for "
+            f"internal join lanes: {sorted(set(reserved))}")
+
+    b1d = [n for n in build_payload if bcols[n].ndim == 2]
+    b2d = [n for n in build_payload if bcols[n].ndim > 2]
+    p1d = [n for n in probe_payload if pcols[n].ndim == 2]
+    p2d = [n for n in probe_payload if pcols[n].ndim > 2]
+
+    s, nb = bvalid.shape
+    npr = pvalid.shape[1]
+    n = nb + npr
+    assert s * out_capacity < _I32_MAX, (s, out_capacity)
+
+    # -- 1. build-side sort (batched): keys + tag + 1-D payloads
+    #    (+ per-segment row index for 2-D columns), sorted along the
+    #    run axis — the §6 short-run regime.
+    b_ops = []
+    for kname in keys:
+        c = bcols[kname]
+        b_ops.append(jnp.where(bvalid, c, _dtype_sentinel_max(c.dtype)))
+    btag = jnp.where(bvalid, jnp.int8(0), jnp.int8(1))
+    b_vals = [bcols[nm] for nm in b1d]
+    if b2d:
+        b_vals.append(lax.broadcasted_iota(jnp.int32, (s, nb), 1))
+    sorted_b = lax.sort(
+        (*b_ops, btag, *b_vals), num_keys=len(keys) + 1
+    )
+    sb_payload = dict(zip(b1d, sorted_b[len(keys) + 1:]))
+    sb_rowidx = sorted_b[-1] if b2d else None
+
+    # -- 2. merged sort (batched): keys + side tag, probe 1-D values
+    #    riding. Segment runs never interact — lax.sort batches over
+    #    the leading axis.
+    m_ops = []
+    for kname in keys:
+        b, p = bcols[kname], pcols[kname]
+        sentinel = _dtype_sentinel_max(b.dtype)
+        m_ops.append(jnp.concatenate([
+            jnp.where(bvalid, b, sentinel),
+            jnp.where(pvalid, p, sentinel),
+        ], axis=1))
+    tag = jnp.concatenate([
+        jnp.where(bvalid, jnp.int8(0), jnp.int8(2)),
+        jnp.where(pvalid, jnp.int8(1), jnp.int8(2)),
+    ], axis=1)
+    m_vals = []
+    for nm in p1d:
+        c = pcols[nm]
+        m_vals.append(jnp.concatenate(
+            [jnp.zeros((s, nb), dtype=c.dtype), c], axis=1))
+    if p2d:
+        m_vals.append(lax.broadcasted_iota(jnp.int32, (s, n), 1))
+    sorted_m = lax.sort(
+        (*m_ops, tag, *m_vals), num_keys=len(keys) + 1
+    )
+    skeys = sorted_m[:len(keys)]
+    stag = sorted_m[len(keys)]
+    sp_payload = dict(zip(p1d, sorted_m[len(keys) + 1:]))
+    sp_rowidx = sorted_m[-1] if p2d else None
+
+    # -- 3. scans, per segment (axis 1): identical algebra to the flat
+    #    path — run starts additionally break at segment starts by the
+    #    iota == 0 clause, so the batched cummax never leaks a run
+    #    across segments.
+    is_build = stag == jnp.int8(0)
+    is_probe = stag == jnp.int8(1)
+    f_incl = jnp.cumsum(is_build.astype(jnp.int32), axis=1)
+    b_before = f_incl - is_build.astype(jnp.int32)
+    iota = lax.broadcasted_iota(jnp.int32, (s, n), 1)
+    changed = jnp.zeros((s, n), dtype=bool)
+    for sk in skeys:
+        prev = jnp.concatenate([sk[:, :1], sk[:, :-1]], axis=1)
+        changed = changed | (sk != prev)
+    first = changed | (iota == 0)
+    lo = lax.cummax(jnp.where(first, b_before, 0), axis=1)
+    cnt = jnp.where(is_probe, b_before - lo, 0)
+
+    csum = jnp.cumsum(cnt, axis=1)
+    total = jnp.sum(cnt.astype(jnp.int64))
+    # Per-segment totals in int64: the flat pipeline's overflow
+    # contract (ops/join.py) — a duplicate-heavy segment past 2^31
+    # matches must FIRE the flag, not wrap negative and return
+    # truncated rows as success. The cumsum itself stays int32 (the
+    # flat path's measured 64-bit-cumsum VMEM blowup); if it wraps,
+    # these totals exceed out_capacity and every row is flagged.
+    total_seg = jnp.sum(cnt.astype(jnp.int64), axis=1)
+    start_out = csum - cnt               # segment-local output slots
+
+    # -- 4. run-record compaction sort (batched): one record per
+    #    matching probe, keyed by its segment-local first output slot.
+    is_rec = is_probe & (cnt > 0)
+    rkey = jnp.where(is_rec, start_out, _I32_MAX)
+    kdt = skeys[0].dtype
+    geom_dt = kdt if _holds_i32_exactly(kdt) else jnp.int32
+    rec_cols = {f"__key{i}": sk for i, sk in enumerate(skeys)}
+    for nm in p1d:
+        rec_cols[nm] = sp_payload[nm]
+    rec_cols["__lo"] = lo.astype(geom_dt)
+    if p2d:
+        rec_cols["__prow"] = sp_rowidx
+    rec_names = list(rec_cols)
+    sorted_r = lax.sort(
+        (rkey, *[rec_cols[nm] for nm in rec_names]), num_keys=1
+    )
+
+    def _prefix(a, fill):
+        if n >= out_capacity:
+            return a[:, :out_capacity]
+        pad = jnp.full((s, out_capacity - n), fill, dtype=a.dtype)
+        return jnp.concatenate([a, pad], axis=1)
+
+    S = _prefix(sorted_r[0], _I32_MAX)
+    recs = {
+        nm: _prefix(c, jnp.zeros((), c.dtype))
+        for nm, c in zip(rec_names, sorted_r[1:])
+    }
+
+    # -- 5. expansion: the flat path's ONE small int32 scatter, with
+    #    per-segment slot offsets folded into the flat target (records
+    #    past a segment's block — and the I32_MAX sentinels — land out
+    #    of bounds and drop, exactly the flat overflow discipline);
+    #    cummax + packed gathers run batched along axis 1.
+    j = lax.broadcasted_iota(jnp.int32, (s, out_capacity), 1)
+    seg_off = (jnp.arange(s, dtype=jnp.int32)
+               * jnp.int32(out_capacity))[:, None]
+    slot = jnp.where(S < out_capacity, seg_off + S, jnp.int32(_I32_MAX))
+    raw = jnp.zeros((s * out_capacity,), jnp.int32).at[
+        slot.reshape(-1)
+    ].set((j + 1).reshape(-1), mode="drop",
+          unique_indices=True).reshape(s, out_capacity)
+    ridx = jnp.maximum(lax.cummax(raw, axis=1) - 1, 0)
+    out_vals = _grouped_take(recs, ridx)
+    start_b = lax.cummax(jnp.where(raw > 0, j, 0), axis=1)
+
+    lo_b = out_vals.pop("__lo").astype(jnp.int32)
+    build_rank = lo_b + (j - start_b)
+    safe_rank = jnp.clip(build_rank, 0, max(nb - 1, 0))
+    build_vals = _grouped_take(sb_payload, safe_rank)
+    if b2d:
+        build_vals["__browidx"] = jnp.take_along_axis(
+            sb_rowidx, safe_rank, axis=1)
+
+    out_cols = {}
+    for i, kname in enumerate(keys):
+        out_cols[kname] = out_vals.pop(f"__key{i}")
+    for nm in b1d:
+        out_cols[nm] = build_vals[nm]
+    if b2d:
+        bidx = build_vals["__browidx"]
+        for nm in b2d:
+            out_cols[nm] = jnp.take_along_axis(
+                bcols[nm], bidx[:, :, None], axis=1)
+    for nm in p1d:
+        out_cols[nm] = out_vals.pop(nm)
+    if p2d:
+        p = jnp.clip(out_vals.pop("__prow") - nb, 0, max(npr - 1, 0))
+        for nm in p2d:
+            out_cols[nm] = jnp.take_along_axis(
+                pcols[nm], p[:, :, None], axis=1)
+
+    out_valid = j.astype(jnp.int64) < total_seg[:, None]
+    flat_cols = {
+        nm: out_cols[nm].reshape((s * out_capacity,)
+                                 + out_cols[nm].shape[2:])
+        for nm in [*keys, *build_payload, *probe_payload]
+    }
+    overflow = jnp.any(total_seg > out_capacity)
+    return (Table(flat_cols, out_valid.reshape(-1)), total, overflow)

@@ -54,6 +54,7 @@ DEFAULT_OUT_CAPACITY_FACTOR = 1.2
 DEFAULT_HH_SLOTS = 64
 HH_BUILD_SLOTS_PER_HH = 32  # default hh_build_capacity = slots * this
 SHUFFLE_MODES = ("padded", "ragged", "ppermute", "hierarchical")
+SORT_MODES = ("flat", "segmented")
 # Residual width the hierarchical DCN codec starts at when the caller
 # set dcn_codec on/auto but no compression_bits — the flat driver's
 # own --compression-bits default; the ladder widens it on overflow.
@@ -135,6 +136,35 @@ def _batch_shuffle(comm, pt, batch: int, n_ranks: int, capacity: int,
     return table, overflow
 
 
+def _batch_shuffle_segmented(comm, pt, batch: int, n_ranks: int,
+                             segments: int, seg_cap: int, mode: str,
+                             tape=None, digest_tape=None):
+    """One batch of the segmented-sort exchange: the fine-partitioned
+    table pads per (destination, segment) fine bucket and rides the
+    wire as one block per destination (parallel/shuffle.
+    shuffle_segmented). Returns ``(recv_cols (n, s, seg_cap, ...),
+    recv_fine_counts (n, s), overflow)`` — overflow fires when any
+    fine bucket exceeds ``seg_cap``, the flat capacity contract one
+    level down (the same ladder escalation relieves it)."""
+    from distributed_join_tpu.parallel.shuffle import shuffle_segmented
+
+    padded, counts, overflow, _ = pt.to_padded(
+        seg_cap, bucket_start=batch * n_ranks * segments,
+        n_buckets=n_ranks * segments,
+    )
+    if mode == "hierarchical" and comm.n_slices == 1:
+        # Degenerate hierarchy: one slice = the flat padded route,
+        # exactly like _batch_shuffle's degenerate branch.
+        mode = "padded"
+    via = {"padded": "all_to_all", "ppermute": "ppermute",
+           "hierarchical": "hierarchical"}[mode]
+    recv_cols, recv_counts = shuffle_segmented(
+        comm, padded, counts, seg_cap, segments, via=via,
+        tape=tape, digest_tape=digest_tape,
+    )
+    return recv_cols, recv_counts, overflow
+
+
 def make_join_step(
     comm: Communicator,
     key: str = "key",
@@ -152,6 +182,8 @@ def make_join_step(
     shuffle: str = "padded",
     compression_bits: Optional[int] = None,
     dcn_codec: str = "auto",
+    sort_mode: str = "flat",
+    sort_segments: Optional[int] = None,
     aggregate=None,
     kernel_config=None,
     with_metrics: bool = False,
@@ -159,6 +191,29 @@ def make_join_step(
     metrics_static: Optional[dict] = None,
 ):
     """The raw per-rank join step (partition -> shuffle -> local join).
+
+    ``sort_mode`` ("flat"/"segmented"): "flat" is the exact existing
+    pipeline, byte-for-byte. "segmented" is the segmented-sort path
+    (ops/segmented.py, docs/ROOFLINE.md §9): sub-bucket hash bits ride
+    the sender's existing partition sort as extra key bits, the padded
+    wire carries static per-(source, segment) fine blocks, and the
+    receiver sorts all segments as one batched short-run ``lax.sort``
+    (the §6 run-length regime) with the scan/compact/expand stages
+    batched per segment — each segment owns its share of the output
+    capacity and the shared ladder relieves any segment overflow.
+    ``sort_segments`` overrides the segment count per (batch, rank)
+    receive (default: ``ops.segmented.resolve_sort_segments`` from the
+    table shapes — THE shared resolution the plan mirrors). The result
+    is the same row multiset as the flat path (graded bit-exact in
+    tests/test_sortpath.py); row order is segment-major.
+    Unsupported combinations refuse loudly, never fall back: the
+    ragged exchange (dynamic boundaries — no static segments), the
+    compressed wire and the hierarchical DCN codec (the codec's
+    per-destination frame streams assume one valid prefix per block),
+    aggregate pushdown (the fused reduction rides the flat sorts),
+    and ``kernel_config`` (it tunes the flat Pallas pipeline the
+    batched XLA formulation never runs). A one-segment resolution or
+    a single-bucket (n*k == 1) mesh lowers to the flat path.
 
     ``aggregate`` (an :class:`~..ops.aggregate.AggregateSpec`, or
     None): the FUSED join+aggregate pipeline (docs/AGGREGATION.md).
@@ -323,6 +378,51 @@ def make_join_step(
                 "(or a flat 1-D communicator)")
     nb = k * n
 
+    if sort_mode not in SORT_MODES:
+        raise ValueError(
+            f"unknown sort_mode {sort_mode!r}; pick one of {SORT_MODES}")
+    if sort_segments is not None and int(sort_segments) < 1:
+        raise ValueError("sort_segments must be >= 1")
+    if sort_mode == "flat" and sort_segments is not None:
+        raise ValueError(
+            "sort_segments applies to sort_mode='segmented' only — "
+            "the flat pipeline never reads it, and silently ignoring "
+            "it would cache one byte-identical program per value "
+            "(the signature binds every keyword); drop the knob or "
+            "pass sort_mode='segmented'")
+    if sort_mode == "segmented":
+        if shuffle == "ragged":
+            raise ValueError(
+                "sort_mode='segmented' needs static per-(source, "
+                "segment) receive boundaries; the ragged exchange "
+                "packs exact-size blocks whose boundaries only exist "
+                "at run time — use shuffle='padded'/'ppermute' (or "
+                "sort_mode='flat')")
+        if compression_bits is not None:
+            raise ValueError(
+                "sort_mode='segmented' does not combine with the "
+                "compressed wire: the codec's per-destination frame "
+                "streams assume one valid prefix per block, which "
+                "the fine-bucket layout breaks — drop "
+                "compression_bits (or use sort_mode='flat')")
+        if (shuffle == "hierarchical" and dcn_on
+                and getattr(comm, "n_slices", 1) > 1):
+            # Topology-gated like resolve_dcn_bits: one slice has no
+            # cross-slice payload, so the degenerate hierarchy is
+            # codec-free and segments fine.
+            raise ValueError(
+                "sort_mode='segmented' does not combine with the "
+                "hierarchical DCN codec (same per-block framing "
+                "problem as compression_bits) — pass dcn_codec='off' "
+                "(or sort_mode='flat')")
+        if kernel_config is not None:
+            raise ValueError(
+                "sort_mode='segmented' ignores kernel_config (the "
+                "knob tunes the flat Pallas expand/compact pipeline; "
+                "the segmented path is the batched XLA formulation) "
+                "— drop the knob (silently ignoring it would cache "
+                "one byte-identical program per value)")
+
     keys = [key] if isinstance(key, str) else list(key)
 
     if aggregate is not None:
@@ -333,6 +433,12 @@ def make_join_step(
                 "aggregate must be an ops.aggregate.AggregateSpec "
                 f"(got {type(aggregate).__name__}); build one with "
                 "AggregateSpec.of(group_by, aggs, ...)")
+        if sort_mode == "segmented":
+            raise agg_ops.AggregatePushdownUnsupported(
+                "aggregate pushdown unsupported under "
+                "sort_mode='segmented': the fused reduction rides "
+                "the flat pipeline's own sorts — run aggregates with "
+                "sort_mode='flat'")
         if skew_threshold is not None:
             raise agg_ops.AggregatePushdownUnsupported(
                 "aggregate pushdown unsupported: the skew sidecar "
@@ -474,6 +580,16 @@ def make_join_step(
                 probe_local = Table(probe_local.columns,
                                     probe_local.valid & ~is_hh_p)
 
+        seg = 1
+        if sort_mode == "segmented" and nb > 1:
+            from distributed_join_tpu.ops import segmented as seg_ops
+
+            # THE shared resolution (plan mirrors it): one segment
+            # count for both sides — segments must be the same hash
+            # classes on build and probe or matches would cross them.
+            seg = seg_ops.resolve_sort_segments(
+                sort_segments, max(b_rows, p_rows), n, k,
+                shuffle_capacity_factor)
         if nb == 1:
             # Single rank, single batch: the partition is one all-rows
             # bucket and the shuffle is an identity — both pure row
@@ -490,6 +606,69 @@ def make_join_step(
             parts.append(res.table)
             total = total + res.total.astype(jnp.int64)
             overflow = overflow | res.overflow
+        elif seg > 1:
+            # The segmented-sort pipeline (ops/segmented.py,
+            # docs/ROOFLINE.md §9): fine partition (sub-bucket bits on
+            # the SAME partition sort), per-segment padded wire,
+            # batched short-run sorts + per-segment merge at the
+            # receiver. One resolution level below the flat contract:
+            # capacities are per fine bucket / per segment, and every
+            # overflow folds into the same shared flag the ladder
+            # relieves.
+            b_cap_s = seg_ops.segment_capacity(
+                b_rows, n, k, seg, shuffle_capacity_factor)
+            p_cap_s = seg_ops.segment_capacity(
+                p_rows, n, k, seg, shuffle_capacity_factor)
+            out_cap_s = seg_ops.segmented_out_capacity(
+                p_rows, k, seg, out_capacity_factor, out_rows_per_rank)
+            with telemetry.span("partition"):
+                ptb = radix_hash_partition(build_local, keys_eff, nb,
+                                           sub_buckets=seg)
+                ptp = radix_hash_partition(probe_local, keys_eff, nb,
+                                           sub_buckets=seg)
+            tb = tape.scoped("build") if tape is not None else None
+            tp = tape.scoped("probe") if tape is not None else None
+            dtb = tape.scoped("build.integrity") if with_integrity \
+                else None
+            dtp = tape.scoped("probe.integrity") if with_integrity \
+                else None
+            if tape is not None:
+                # The static segmentation rides the metrics block so
+                # EXPLAIN's segment-count prediction grades against a
+                # device-reported value, like the wire bytes.
+                tape.add("sort_segments", seg)
+                for t, pt, cap in ((tb, ptb, b_cap_s),
+                                   (tp, ptp, p_cap_s)):
+                    t.add("rows_partitioned",
+                          jnp.sum(pt.counts.astype(jnp.int64)))
+                    # Headroom under the FINE capacity contract.
+                    t.record_min(
+                        "overflow_margin_min",
+                        jnp.int64(cap)
+                        - jnp.max(pt.counts).astype(jnp.int64))
+            for b in range(k):
+                with telemetry.span("shuffle", batch=b):
+                    rb_cols, rb_counts, ovf_b = \
+                        _batch_shuffle_segmented(
+                            comm, ptb, b, n, seg, b_cap_s, shuffle,
+                            tape=tb, digest_tape=dtb)
+                    rp_cols, rp_counts, ovf_p = \
+                        _batch_shuffle_segmented(
+                            comm, ptp, b, n, seg, p_cap_s, shuffle,
+                            tape=tp, digest_tape=dtp)
+                with telemetry.span("join", batch=b):
+                    bcols, bval = seg_ops.runs_from_blocks(
+                        rb_cols, rb_counts)
+                    pcols, pval = seg_ops.runs_from_blocks(
+                        rp_cols, rp_counts)
+                    table, t_batch, ovf_j = \
+                        seg_ops.batched_sort_merge_inner_join(
+                            bcols, bval, pcols, pval, keys_eff,
+                            out_cap_s, build_payload=bpay,
+                            probe_payload=ppay, _internal=sk_names)
+                parts.append(table)
+                total = total + t_batch
+                overflow = overflow | ovf_b | ovf_p | ovf_j
         else:
             # Byte-exact string wire (ragged mode): order each bucket
             # by the FIRST string column's length desc so its u32
@@ -784,6 +963,7 @@ def make_probe_join_step(
     probe_payload: Optional[Sequence[str]] = None,
     shuffle: str = "padded",
     compression_bits: Optional[int] = None,
+    sort_mode: str = "flat",
     aggregate=None,
     kernel_config=None,
     with_metrics: bool = False,
@@ -792,6 +972,13 @@ def make_probe_join_step(
 ):
     """The PROBE-ONLY join step against a resident build image
     (service/resident.py; ROADMAP item 4).
+
+    ``sort_mode``: "flat" only. The segmented-sort path needs BOTH
+    sides segmented into the same hash classes, and a resident image
+    is one flat key-sorted run registered before any probe's segment
+    count exists — segment-aligned resident images are a named
+    leftover, so "segmented" refuses loudly here instead of silently
+    serving the flat program.
 
     ``aggregate`` (an :class:`~..ops.aggregate.AggregateSpec`, or
     None): the fused join+aggregate pipeline on the probe-only
@@ -839,6 +1026,17 @@ def make_probe_join_step(
         raise ValueError("over_decomposition must be >= 1")
     if shuffle not in ("padded", "ragged", "ppermute"):
         raise ValueError(f"unknown shuffle mode {shuffle!r}")
+    if sort_mode not in SORT_MODES:
+        raise ValueError(
+            f"unknown sort_mode {sort_mode!r}; pick one of {SORT_MODES}")
+    if sort_mode != "flat":
+        raise ValueError(
+            "sort_mode='segmented' is not part of the probe-only "
+            "program: the resident build image is one flat key-sorted "
+            "run registered before the probe's segment count is known, "
+            "and segments must be the SAME hash classes on both sides "
+            "— segment-aligned resident images are unimplemented; "
+            "serve resident joins with sort_mode='flat'")
     if compression_bits is not None and shuffle == "ragged":
         raise ValueError(
             "compression applies to the padded/ppermute shuffles; the "

@@ -184,6 +184,22 @@ def padded_block_digests(columns: dict, counts: jax.Array) -> jax.Array:
     return fold63(jnp.sum(jnp.where(valid, rd, jnp.uint64(0)), axis=1))
 
 
+def masked_block_digests(columns: dict, row_valid: jax.Array
+                         ) -> jax.Array:
+    """(n,) int64 digests of an (n, capacity, ...) block layout under
+    an EXPLICIT (n, capacity) validity mask — the segmented-sort
+    shuffle's layout, where each peer block interleaves per-(segment)
+    valid prefixes so a single per-block count cannot describe it
+    (parallel/shuffle.shuffle_segmented). Same order-invariant sum as
+    :func:`padded_block_digests`, same verify_digests contract."""
+    n, capacity = next(iter(columns.values())).shape[:2]
+    flat = {name: c.reshape((n * capacity,) + c.shape[2:])
+            for name, c in columns.items()}
+    rd = row_digests(flat).reshape(n, capacity)
+    return fold63(jnp.sum(jnp.where(row_valid, rd, jnp.uint64(0)),
+                          axis=1))
+
+
 def segment_digests(digests: jax.Array, starts: jax.Array,
                     sizes: jax.Array) -> jax.Array:
     """(n,) int64 digests of n row segments ``[starts[j], starts[j] +

@@ -200,6 +200,96 @@ def shuffle_padded_compressed(
     return unpad(recv_cols, recv_counts, capacity), recv_counts, c_ovf
 
 
+def shuffle_segmented(
+    comm: Communicator, padded_fine, fine_counts: jax.Array,
+    seg_cap: int, segments: int, via: str = "all_to_all",
+    tape=None, digest_tape=None,
+):
+    """Padded shuffle of a FINE-partitioned block for the
+    segmented-sort pipeline (ops/segmented.py, docs/ROOFLINE.md §9):
+    ``padded_fine`` holds ``(n_ranks * segments, seg_cap, ...)``
+    blocks (destination-major, segment-minor — the contiguous layout
+    ``radix_hash_partition(sub_buckets=)``'s fine ordering yields) and
+    ``fine_counts`` the matching ``(n_ranks * segments,)`` int32
+    counts.
+
+    Each destination's ``segments * seg_cap`` slots ride the wire as
+    ONE block — the same collectives, byte-for-byte, as a flat padded
+    shuffle of capacity ``segments * seg_cap`` — plus the fine count
+    matrix as the (unbilled) metadata exchange, so the receiver can
+    mask every (source, segment) prefix. ``via`` selects all_to_all /
+    ppermute / hierarchical routing; the hierarchical route moves both
+    phases RAW (the DCN codec's pad-fill framing assumes one valid
+    prefix per destination block, which the fine layout breaks — the
+    caller refuses that combination loudly).
+
+    Returns ``(recv_cols, recv_counts)``: column arrays shaped
+    ``(n_src, segments, seg_cap, ...)`` and the received fine counts
+    ``(n_src, segments)``. Overflow stays the caller's ``to_padded``
+    verdict (a fine bucket exceeding ``seg_cap``).
+
+    ``tape`` billing mirrors :func:`shuffle_padded`: ``wire_bytes`` is
+    the full static block (pad included — that IS what rides),
+    rows are the fine-count sums. ``digest_tape`` records the same
+    per-(src, dst) pair digests as the flat shuffles, computed under
+    the fine-count mask (integrity.masked_block_digests) — coarse
+    per-peer channels, so ``verify_digests`` reads them unchanged.
+    """
+    n = comm.n_ranks
+    s = segments
+    hier = via == "hierarchical" and comm.n_slices > 1
+    if hier:
+        def route(x):
+            return _hier_route(comm, x)
+
+        route_meta = route
+    else:
+        route = (comm.ppermute_all_to_all if via == "ppermute"
+                 else comm.all_to_all)
+        route_meta = comm.all_to_all
+    recv_counts = route_meta(fine_counts.reshape(n, s))
+    recv_cols = {}
+    block_bytes = 0
+    for name, col in padded_fine.items():
+        block = col.reshape((n, s * seg_cap) + col.shape[2:])
+        block_bytes += block.size * block.dtype.itemsize
+        recv = route(block)
+        recv_cols[name] = recv.reshape((n, s, seg_cap)
+                                       + col.shape[2:])
+    # Hierarchical routing moves every block TWICE (intra-slice ICI
+    # hop, then the raw cross-slice DCN hop) — bill both tiers, with
+    # the per-tier counters the exact wire gate and the DCN telemetry
+    # read, exactly like shuffle_hierarchical's raw path.
+    wire_bytes = 2 * block_bytes if hier else block_bytes
+    if digest_tape is not None:
+        from distributed_join_tpu.parallel import integrity
+
+        lane = jnp.arange(seg_cap, dtype=jnp.int32)
+        sent_mask = (lane[None, :]
+                     < fine_counts[:, None]).reshape(n, s * seg_cap)
+        recv_mask = (lane[None, None, :]
+                     < recv_counts[:, :, None]).reshape(n, s * seg_cap)
+        integrity.record_pair_digests(
+            digest_tape,
+            integrity.masked_block_digests(
+                {nm: c.reshape((n, s * seg_cap) + c.shape[2:])
+                 for nm, c in padded_fine.items()}, sent_mask),
+            integrity.masked_block_digests(
+                {nm: c.reshape((n, s * seg_cap) + c.shape[3:])
+                 for nm, c in recv_cols.items()}, recv_mask),
+        )
+    if tape is not None:
+        tape.add("rows_shuffled",
+                 jnp.sum(fine_counts.astype(jnp.int64)))
+        tape.add("rows_received",
+                 jnp.sum(recv_counts.astype(jnp.int64)))
+        tape.add("wire_bytes", wire_bytes)
+        if hier:
+            tape.add("wire_bytes_ici", block_bytes)
+            tape.add("wire_bytes_dcn", block_bytes)
+    return recv_cols, recv_counts
+
+
 def _hier_route(comm: Communicator, x: jax.Array) -> jax.Array:
     """Two-level routing of an ``(n_ranks, ...)`` destination-major
     block: intra-slice all-to-all over ICI, then cross-slice exchange

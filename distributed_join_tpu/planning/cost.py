@@ -59,6 +59,14 @@ class CostModel:
 
     # lax.sort, 20M x (i64 key + small lanes): 139-168 ms (§1, §6).
     sort_ns_per_elem: float = 7.0
+    # batched short-run lax.sort — the §6 run-length regime the
+    # segmented-sort pipeline rides (ops/segmented.py, §9): the same
+    # 20M x (i64, i8, i64) operands sort in 24-45 ms as independent
+    # runs ((8192, 2048): 24 ms; (512, 32768): 38 ms) => ~1.2-2.2
+    # ns/elem; the conservative midpoint ships until the first
+    # real-chip segmented stage profile refits it
+    # (calibrate_from_stage_profile; relay_session_r6 step 10).
+    sort_run_ns_per_elem: float = 1.9
     # each extra i64 value lane on a 139 ms sort: +6 ms (§1).
     sort_lane_ns_per_elem: float = 0.3
     # cumsum/cummax 20M i32: 30-43 ms (§1).
@@ -107,7 +115,8 @@ class CostModel:
     def provenance(self) -> dict:
         return {
             "measured": [
-                "sort_ns_per_elem", "sort_lane_ns_per_elem",
+                "sort_ns_per_elem", "sort_run_ns_per_elem",
+                "sort_lane_ns_per_elem",
                 "scan_ns_per_elem", "gather_ns_per_elem",
                 "row_gather_ns_per_row", "compact_ns_per_elem",
                 "expand_ns_per_out_row", "hbm_bytes_per_s",
@@ -327,8 +336,16 @@ def predict(plan, model: Optional[CostModel] = None) -> dict:
         # the segmented scans and the groups-sized compaction ride the
         # scan/compact constants over the merged domain.
         out_total = 0
+    # Segmented-sort mode (capacities["sort_segments"] > 1): the
+    # merged + record sorts run as batched short runs at the §6
+    # run-length rate instead of the flat superlinear rate — the whole
+    # point of the pipeline (ROOFLINE §9); scans/compaction/expand
+    # costs are unchanged (same total elements, batched).
+    sort_c = (m.sort_run_ns_per_elem
+              if (plan.capacities.get("sort_segments") or 1) > 1
+              else m.sort_ns_per_elem)
     join_s = batches * ns * (
-        merged * (m.sort_ns_per_elem
+        merged * (sort_c
                   + m.sort_lane_ns_per_elem * 2
                   + m.scan_ns_per_elem
                   + m.compact_ns_per_elem)
@@ -478,8 +495,9 @@ STAGE_CONSTANTS = {
                       "codec_bytes_per_s"),
     },
     "join": {
-        "time": ("sort_lane_ns_per_elem", "scan_ns_per_elem",
-                 "compact_ns_per_elem", "expand_ns_per_out_row"),
+        "time": ("sort_run_ns_per_elem", "sort_lane_ns_per_elem",
+                 "scan_ns_per_elem", "compact_ns_per_elem",
+                 "expand_ns_per_out_row"),
         "bandwidth": (),
     },
 }
@@ -517,6 +535,7 @@ def calibrate_from_stage_profile(profiles,
         profiles = [profiles]
     ratios: dict = {}
     dcn_ratios: list = []
+    sort_run_ratios: list = []
     eligible = 0
     for p in profiles or []:
         if not isinstance(p, dict) or p.get("kind") != "stageprofile":
@@ -547,6 +566,14 @@ def calibrate_from_stage_profile(profiles,
                             f"{s}.wire_bytes_dcn")
                         for s in ("build", "probe")):
                     dcn_ratios.append(r)
+                elif stage == "join" and (
+                        p.get("sort_segments") or 1) > 1:
+                    # Same discipline for the sort modes: a SEGMENTED
+                    # profile's join wall is dominated by the batched
+                    # short-run sort, so its ratio refits ONLY
+                    # sort_run_ns_per_elem — and a flat profile (no
+                    # batched sort ever ran) must never touch it.
+                    sort_run_ratios.append(r)
                 else:
                     ratios.setdefault(stage, []).append(r)
                 counted = True
@@ -572,7 +599,13 @@ def calibrate_from_stage_profile(profiles,
         scale = round(rs[len(rs) // 2], 6)
         scales[stage] = scale
         owned = STAGE_CONSTANTS[stage]
-        for k in owned["time"]:
+        fit_time = list(owned["time"])
+        if stage == "join":
+            # sort_run_ns_per_elem refits ONLY from segmented
+            # profiles (their own median below) — a flat join ratio
+            # carries zero batched-short-run-sort evidence.
+            fit_time.remove("sort_run_ns_per_elem")
+        for k in fit_time:
             fields[k] = getattr(base, k) * scale
         fit_bw = list(owned["bandwidth"])
         if stage == "shuffle":
@@ -585,7 +618,16 @@ def calibrate_from_stage_profile(profiles,
             fit_bw.remove("dcn_bytes_per_s")
         for k in fit_bw:
             fields[k] = getattr(base, k) / scale
-        refit[stage] = list(owned["time"]) + fit_bw
+        refit[stage] = fit_time + fit_bw
+    sort_run_scale = None
+    if sort_run_ratios:
+        sort_run_ratios.sort()
+        sort_run_scale = round(
+            sort_run_ratios[len(sort_run_ratios) // 2], 6)
+        fields["sort_run_ns_per_elem"] = \
+            base.sort_run_ns_per_elem * sort_run_scale
+        refit.setdefault("join", []).append("sort_run_ns_per_elem")
+        scales.setdefault("join", sort_run_scale)
     dcn_scale = None
     if dcn_ratios:
         dcn_ratios.sort()
@@ -600,6 +642,7 @@ def calibrate_from_stage_profile(profiles,
         calibrated=True,
         stage_scales=scales,
         dcn_scale=dcn_scale,
+        sort_run_scale=sort_run_scale,
         refit=refit,
         # the stage the shipped model mispredicts hardest (log scale:
         # x4 optimistic and x0.25 pessimistic are equally wrong)

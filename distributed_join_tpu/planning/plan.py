@@ -492,6 +492,19 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
             "ragged exchange already sends exact rows (combining the "
             "two is unimplemented)"
         )
+    sort_mode = resolved.get("sort_mode") or "flat"
+    sort_segments = resolved.get("sort_segments")
+    from distributed_join_tpu.parallel.distributed_join import (
+        SORT_MODES,
+    )
+
+    if sort_mode not in SORT_MODES:
+        raise ValueError(
+            f"unknown sort_mode {sort_mode!r}; pick one of {SORT_MODES}")
+    if sort_mode == "flat" and sort_segments is not None:
+        raise ValueError(
+            "sort_segments applies to sort_mode='segmented' only — "
+            "drop the knob or pass sort_mode='segmented'")
     dcn_knob = resolved.get("dcn_codec") or "auto"
     if shuffle == "hierarchical":
         if comp_bits is not None and dcn_knob == "off":
@@ -509,6 +522,32 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
                 "over a multi-slice mesh, dragging intra-slice "
                 "traffic across DCN — use shuffle='hierarchical' "
                 "(or a flat 1-D communicator)")
+    if sort_mode == "segmented":
+        # Mirror make_join_step's refusal matrix — an EXPLAIN of a
+        # config the step would reject must be the same loud error.
+        if shuffle == "ragged":
+            raise ValueError(
+                "sort_mode='segmented' needs static per-(source, "
+                "segment) receive boundaries; the ragged exchange "
+                "packs exact-size blocks whose boundaries only exist "
+                "at run time — use shuffle='padded'/'ppermute' (or "
+                "sort_mode='flat')")
+        if comp_bits is not None:
+            raise ValueError(
+                "sort_mode='segmented' does not combine with the "
+                "compressed wire: the codec's per-destination frame "
+                "streams assume one valid prefix per block — drop "
+                "compression_bits (or use sort_mode='flat')")
+        if shuffle == "hierarchical" and dcn_on and n_slices > 1:
+            raise ValueError(
+                "sort_mode='segmented' does not combine with the "
+                "hierarchical DCN codec (same per-block framing "
+                "problem as compression_bits) — pass dcn_codec='off' "
+                "(or sort_mode='flat')")
+        if resolved.get("kernel_config") is not None:
+            raise ValueError(
+                "sort_mode='segmented' ignores kernel_config — drop "
+                "the knob")
     shuffle_f = float(resolved["shuffle_capacity_factor"])
     out_f = float(resolved["out_capacity_factor"])
     out_rows = resolved.get("out_rows_per_rank")
@@ -530,6 +569,12 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
     if agg_spec is not None:
         from distributed_join_tpu.ops import aggregate as agg_ops
 
+        if sort_mode == "segmented":
+            raise agg_ops.AggregatePushdownUnsupported(
+                "aggregate pushdown unsupported under "
+                "sort_mode='segmented': the fused reduction rides "
+                "the flat pipeline's own sorts — run aggregates with "
+                "sort_mode='flat'")
         if resolved.get("skew_threshold") is not None:
             raise agg_ops.AggregatePushdownUnsupported(
                 "aggregate pushdown unsupported: the skew sidecar is "
@@ -571,13 +616,38 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
     )
 
     # Capacity arithmetic, verbatim from make_join_step (float order
-    # included — the exact-gate depends on it).
-    b_cap = _round_up(int(math.ceil(b_local / nb * shuffle_f)), 8)
-    p_cap = _round_up(int(math.ceil(p_local / nb * shuffle_f)), 8)
-    if out_rows is not None:
-        out_cap = _round_up(int(math.ceil(int(out_rows) / k)), 8)
+    # included — the exact-gate depends on it). The segmented-sort
+    # path resolves ONE level down through the shared owners in
+    # ops/segmented.py: per-fine-bucket capacities and per-segment
+    # output blocks, with the per-bucket keys carrying the EFFECTIVE
+    # wire block (segments x per-segment capacity) so the wire/memory
+    # accounting below reads them unchanged.
+    seg = 1
+    if sort_mode == "segmented" and nb > 1:
+        from distributed_join_tpu.ops.segmented import (
+            resolve_sort_segments,
+            segment_capacity,
+            segmented_out_capacity,
+        )
+
+        seg = resolve_sort_segments(sort_segments,
+                                    max(b_local, p_local), n, k,
+                                    shuffle_f)
+    if seg > 1:
+        b_cap_seg = segment_capacity(b_local, n, k, seg, shuffle_f)
+        p_cap_seg = segment_capacity(p_local, n, k, seg, shuffle_f)
+        out_cap_seg = segmented_out_capacity(p_local, k, seg, out_f,
+                                             out_rows)
+        b_cap = seg * b_cap_seg
+        p_cap = seg * p_cap_seg
+        out_cap = seg * out_cap_seg
     else:
-        out_cap = _round_up(int(math.ceil(p_local / k * out_f)), 8)
+        b_cap = _round_up(int(math.ceil(b_local / nb * shuffle_f)), 8)
+        p_cap = _round_up(int(math.ceil(p_local / nb * shuffle_f)), 8)
+        if out_rows is not None:
+            out_cap = _round_up(int(math.ceil(int(out_rows) / k)), 8)
+        else:
+            out_cap = _round_up(int(math.ceil(p_local / k * out_f)), 8)
     capacities = {
         "shuffle_build_per_bucket": b_cap,
         "shuffle_probe_per_bucket": p_cap,
@@ -586,6 +656,13 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
         "out_capacity_factor": out_f,
         "out_rows_per_rank": out_rows,
     }
+    if seg > 1:
+        capacities.update(
+            sort_segments=seg,
+            shuffle_build_per_segment=b_cap_seg,
+            shuffle_probe_per_segment=p_cap_seg,
+            out_rows_per_segment=out_cap_seg,
+        )
 
     skew = None
     if resolved.get("skew_threshold") is not None:

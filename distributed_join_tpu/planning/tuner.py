@@ -66,7 +66,15 @@ SIZING_KNOBS = (
     "hh_build_capacity", "hh_probe_capacity", "hh_out_capacity",
 )
 # Program-shape knobs: filled only when the caller left them unset.
-STRUCTURAL_KNOBS = ("shuffle", "skew_threshold", "dcn_codec")
+STRUCTURAL_KNOBS = ("shuffle", "skew_threshold", "dcn_codec",
+                    "sort_mode")
+
+# The local sort dominates a workload's join stage when the measured
+# join-stage wall crosses this fraction of the summed stage walls —
+# the per-stage-history evidence bar for flipping the segmented-sort
+# path on (docs/ROOFLINE.md §9; the stage walls come from
+# --stage-profile runs recorded in the history store).
+SORT_STAGE_SHARE_WARN = 0.5
 
 # The DCN tier dominates a hierarchical run's wire when the measured
 # cross-slice share of the bytes crosses this fraction — the evidence
@@ -375,6 +383,70 @@ class JoinTuner:
                     "dcn_share": share[0],
                     "warn": DCN_SHARE_WARN,
                     "codec_was_on": share[1]}
+
+        # 7. sort mode: per-stage history (--stage-profile runs land a
+        # stages block on the signature's entries) showing the JOIN
+        # stage — where the merged sort lives — dominating the summed
+        # stage walls flips the segmented-sort path on
+        # (docs/ROOFLINE.md §9) when the caller didn't choose and the
+        # combination supports it (never over ragged/compressed/
+        # aggregate programs — the step refuses those loudly, and a
+        # history-filled knob must not turn a working workload into
+        # an error). Gated on the resolver actually segmenting at
+        # this shape: a one-segment resolution is flat parity and a
+        # pointless signature fork.
+        # A hierarchical workload with the DCN codec armed (explicit,
+        # step-6-filled, or "auto" resolving on over a multi-slice
+        # mesh) refuses segmented — the fill must not produce it.
+        shuffle_eff = cfg.structural.get("shuffle",
+                                         user_opts.get("shuffle"))
+        dcn_knob = cfg.structural.get(
+            "dcn_codec", user_opts.get("dcn_codec", "auto")) or "auto"
+        from distributed_join_tpu.planning.cost import (
+            DCN_CODEC_KNOBS,
+            resolve_dcn_codec,
+        )
+
+        hier_codec_armed = (
+            shuffle_eff == "hierarchical"
+            and ((side_geometry or {}).get("n_slices") or 1) > 1
+            # An invalid knob is the join's loud error, not the
+            # tuner's — treat it as armed (conservative: no fill).
+            and (dcn_knob not in DCN_CODEC_KNOBS
+                 or resolve_dcn_codec(dcn_knob)))
+        if ("sort_mode" not in user_opts
+                and shuffle_eff != "ragged"
+                and not hier_codec_armed
+                and user_opts.get("compression_bits") is None
+                and "compression_bits" not in cfg.sizing
+                and user_opts.get("aggregate") is None
+                and user_opts.get("kernel_config") is None
+                and side_geometry):
+            share = self._join_stage_share(trend.stages_last)
+            if share is not None and share > SORT_STAGE_SHARE_WARN:
+                from distributed_join_tpu.ops.segmented import (
+                    resolve_sort_segments,
+                )
+
+                n_ranks = int(side_geometry.get("n_ranks") or 1)
+                nb = int(side_geometry.get("nb") or n_ranks)
+                factor = float(
+                    (trend.resolved_knobs_last or {}).get(
+                        "shuffle_capacity_factor")
+                    or user_opts.get("shuffle_capacity_factor")
+                    or _static_defaults()["shuffle_capacity_factor"])
+                segs = resolve_sort_segments(
+                    user_opts.get("sort_segments"),
+                    max(side_geometry.get("b_local") or 0,
+                        side_geometry.get("p_local") or 0),
+                    n_ranks, max(nb // max(n_ranks, 1), 1), factor)
+                if segs > 1:
+                    cfg.structural["sort_mode"] = "segmented"
+                    cfg.source = "history"
+                    cfg.basis["sort_mode"] = {
+                        "join_stage_share": round(share, 4),
+                        "warn": SORT_STAGE_SHARE_WARN,
+                        "segments": segs}
         if cfg.source == "history":
             self.history_hits += 1
         return cfg
@@ -446,6 +518,19 @@ class JoinTuner:
         return cfg
 
     # -- policy helpers ------------------------------------------------
+
+    @staticmethod
+    def _join_stage_share(stages_last):
+        """Join-stage fraction of the summed per-stage walls from the
+        signature's latest stages block (``history.stages_block``
+        shape: {"wall_s": {stage: seconds}}), or None without
+        stage-profiled evidence."""
+        walls = ((stages_last or {}).get("wall_s") or {})
+        join_w = walls.get("join")
+        total = sum(v for v in walls.values() if v)
+        if not join_w or total <= 0:
+            return None
+        return float(join_w) / float(total)
 
     @staticmethod
     def _worst_gini(indicators):

@@ -1028,7 +1028,7 @@ class JoinService:
 _WIRE_JOIN_OPTS = (
     "shuffle", "over_decomposition", "shuffle_capacity_factor",
     "out_capacity_factor", "compression_bits", "skew_threshold",
-    "dcn_codec", "aggregate",
+    "dcn_codec", "aggregate", "sort_mode", "sort_segments",
 )
 
 

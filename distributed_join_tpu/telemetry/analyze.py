@@ -1063,6 +1063,24 @@ def check_file(path: str) -> list:
         elif "counter_signature" in doc:
             problems.append("counter_signature is not an object")
         return problems
+    elif name.startswith("sortpath_smoke") or \
+            doc.get("kind") == "sort_ab":
+        # The join driver's --sort-ab sub-record (segmented vs flat
+        # local sort; docs/ROOFLINE.md §9): carries the deterministic
+        # segmented counter signature the perfgate lane gates against
+        # results/baselines/sortpath_smoke.json.
+        for key in ("kind", "n_ranks", "counter_signature",
+                    "sort_segments"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        sig = doc.get("counter_signature")
+        if isinstance(sig, dict):
+            if not isinstance(sig.get("counters"), dict):
+                problems.append("counter_signature missing "
+                                "'counters'")
+        elif "counter_signature" in doc:
+            problems.append("counter_signature is not an object")
+        return problems
     elif name == "flightrecorder.json" or \
             doc.get("kind") == "flightrecorder":
         # The daemon's postmortem ring (telemetry/live.py).

@@ -73,6 +73,7 @@ WORKLOAD_KEYS = (
     "key_columns", "over_decomposition_factor", "zipf_alpha",
     "skew_threshold", "string_payload_bytes", "string_key_bytes",
     "scale_factor", "nbytes", "slices", "dcn_codec", "agg",
+    "sort_mode", "sort_segments",
 )
 
 

@@ -89,6 +89,9 @@ class StageProfile:
     stages: dict                 # name -> stage dict (see _stage_entry)
     monolithic_walls_s: list
     cost: dict                   # the plan's cost prediction (model incl.)
+    # Segmented-sort mode (docs/ROOFLINE.md §9): the plan-resolved
+    # static segment count the profiled programs ran with (1 = flat).
+    sort_segments: int = 1
 
     @property
     def monolithic_wall_s(self) -> float:
@@ -138,6 +141,7 @@ class StageProfile:
             "repeats": self.repeats,
             "platform": self.platform,
             "overflow": self.overflow,
+            "sort_segments": self.sort_segments,
             "stages": {k: dict(v) for k, v in self.stages.items()},
             "sum_of_stages_s": _round_s(self.sum_of_stages_s),
             "sum_of_stages_min_s": _round_s(self.sum_of_stages_min_s),
@@ -364,7 +368,114 @@ def profile_join_stages(comm, build, probe, key="key", repeats: int = 3,
             plan.resolved_options.get("dcn_codec") or "auto",
             comp_bits, n_slices=getattr(comm, "n_slices", 1))
 
+    # Segmented-sort mode (sort_mode="segmented", docs/ROOFLINE.md
+    # §9): the plan's shared resolution says how many sub-buckets the
+    # partition sorts and what the fine capacities are — the three
+    # stage programs below then mirror the monolithic segmented step
+    # exactly (fine partition / per-segment padded wire / batched
+    # short-run join), so the per-stage wire counters still gate
+    # EXACTLY and the join-stage wall attributes the sort-mode delta.
+    sort_seg = int(plan.capacities.get("sort_segments") or 1)
+    seg_b_cap = plan.capacities.get("shuffle_build_per_segment")
+    seg_p_cap = plan.capacities.get("shuffle_probe_per_segment")
+    seg_out_cap = plan.capacities.get("out_rows_per_segment")
+
     # -- segment programs ---------------------------------------------
+
+    def seg_partition_segmented(build_local, probe_local):
+        tape = telemetry.MetricsTape()
+        ptb = radix_hash_partition(build_local, keys, nb,
+                                   sub_buckets=sort_seg)
+        ptp = radix_hash_partition(probe_local, keys, nb,
+                                   sub_buckets=sort_seg)
+        tape.add("sort_segments", sort_seg)
+        for scope, pt, cap in (("build", ptb, seg_b_cap),
+                               ("probe", ptp, seg_p_cap)):
+            t = tape.scoped(scope)
+            t.add("rows_partitioned",
+                  jnp.sum(pt.counts.astype(jnp.int64)))
+            t.record_min("overflow_margin_min",
+                         jnp.int64(cap)
+                         - jnp.max(pt.counts).astype(jnp.int64))
+        out = {}
+        overflow = jnp.bool_(False)
+        for side, pt, cap in (("build", ptb, seg_b_cap),
+                              ("probe", ptp, seg_p_cap)):
+            for b in range(k):
+                padded, counts, ovf, _ = pt.to_padded(
+                    cap, bucket_start=b * n * sort_seg,
+                    n_buckets=n * sort_seg)
+                out[f"{side}.b{b}.counts"] = counts
+                for cname, c in padded.items():
+                    out[f"{side}.b{b}.col.{cname}"] = c
+                overflow = overflow | ovf
+        overflow = comm.psum(overflow.astype(jnp.int32)) > 0
+        return out, overflow, tape.gathered(comm)
+
+    def seg_shuffle_segmented(payload):
+        from distributed_join_tpu.parallel.shuffle import (
+            shuffle_segmented,
+        )
+
+        tape = telemetry.MetricsTape()
+        out = {}
+        seg_via = ("hierarchical" if hier
+                   else ("ppermute" if mode == "ppermute"
+                         else "all_to_all"))
+        for side, cap in (("build", seg_b_cap), ("probe", seg_p_cap)):
+            t = tape.scoped(side)
+            for b in range(k):
+                prefix = f"{side}.b{b}.col."
+                padded = {cname[len(prefix):]: c
+                          for cname, c in payload.items()
+                          if cname.startswith(prefix)}
+                counts = payload[f"{side}.b{b}.counts"]
+                recv_cols, recv_counts = shuffle_segmented(
+                    comm, padded, counts, cap, sort_seg, via=seg_via,
+                    tape=t)
+                out[f"{side}.b{b}.counts"] = recv_counts
+                for cname, c in recv_cols.items():
+                    out[f"{side}.b{b}.col.{cname}"] = c
+        overflow = comm.psum(jnp.int32(0)) > 0
+        return out, overflow, tape.gathered(comm)
+
+    def seg_join_segmented(payload):
+        from distributed_join_tpu.ops.segmented import (
+            batched_sort_merge_inner_join,
+            runs_from_blocks,
+        )
+
+        tape = telemetry.MetricsTape()
+        parts = []
+        total = jnp.int64(0)
+        overflow = jnp.bool_(False)
+        for b in range(k):
+            seg_tables = []
+            for side in ("build", "probe"):
+                prefix = f"{side}.b{b}.col."
+                cols = {cname[len(prefix):]: c
+                        for cname, c in payload.items()
+                        if cname.startswith(prefix)}
+                seg_tables.append(runs_from_blocks(
+                    cols, payload[f"{side}.b{b}.counts"]))
+            (bcols, bval), (pcols, pval) = seg_tables
+            table, t_batch, ovf = batched_sort_merge_inner_join(
+                bcols, bval, pcols, pval, keys, seg_out_cap,
+                build_payload=bpay, probe_payload=ppay)
+            parts.append(table)
+            total = total + t_batch
+            overflow = overflow | ovf
+        out = Table(
+            {name: jnp.concatenate([t.columns[name] for t in parts])
+             for name in parts[0].column_names},
+            jnp.concatenate([t.valid for t in parts]),
+        )
+        tape.add("matches", total)
+        metrics = tape.gathered(comm)
+        total = comm.psum(total)
+        overflow = comm.psum(overflow.astype(jnp.int32)) > 0
+        return ({"col." + nm: c for nm, c in out.columns.items()}
+                | {"valid": out.valid}, total, overflow, metrics)
 
     def seg_partition(build_local, probe_local):
         tape = telemetry.MetricsTape()
@@ -517,9 +628,14 @@ def profile_join_stages(comm, build, probe, key="key", repeats: int = 3,
         seg_metrics["join"] = j_out[3].to_dict()["reduced"]
         chain = [("join", fn_join, (build, probe), 1)]
     else:
-        fn_part = comm.spmd(seg_partition, sharded_out=aux_out)
-        fn_shuf = comm.spmd(seg_shuffle, sharded_out=aux_out)
-        fn_join = comm.spmd(seg_join,
+        part_fn = (seg_partition_segmented if sort_seg > 1
+                   else seg_partition)
+        shuf_fn = (seg_shuffle_segmented if sort_seg > 1
+                   else seg_shuffle)
+        join_fn = seg_join_segmented if sort_seg > 1 else seg_join
+        fn_part = comm.spmd(part_fn, sharded_out=aux_out)
+        fn_shuf = comm.spmd(shuf_fn, sharded_out=aux_out)
+        fn_join = comm.spmd(join_fn,
                             sharded_out=(False, True, True, True))
         a_out = fn_part(build, probe)
         fetch_one_scalar(a_out[1])
@@ -602,6 +718,7 @@ def profile_join_stages(comm, build, probe, key="key", repeats: int = 3,
         stages=stages,
         monolithic_walls_s=mono_walls,
         cost=plan.cost,
+        sort_segments=sort_seg,
     )
 
 

@@ -76,6 +76,19 @@
 #                                   # agg_smoke.json — plus the tpch
 #                                   # driver's --agg mode (oracle-
 #                                   # graded in-driver)
+#   scripts/run_tier1.sh sortpath   # segmented-sort join pipeline:
+#                                   # -m sortpath suite + a
+#                                   # deterministic CPU-mesh
+#                                   # segmented-vs-flat driver smoke —
+#                                   # pandas-oracle equality on BOTH
+#                                   # modes, full-content multiset
+#                                   # equality, zero warm traces, the
+#                                   # exact segmented wire-byte
+#                                   # prediction (analyze explain
+#                                   # --gate-wire-bytes), and the
+#                                   # counter signature gated vs
+#                                   # results/baselines/
+#                                   # sortpath_smoke.json
 #   scripts/run_tier1.sh tuner      # autotuner: -m tuner suite + a
 #                                   # cold/warm driver A/B (warm run
 #                                   # must start at the escalated
@@ -241,6 +254,28 @@ json.dump(ab, open(f"{sys.argv[1]}/agg_smoke.json", "w"), indent=1)
 PY
     python -m distributed_join_tpu.telemetry.analyze compare \
       "$tmp/agg_smoke.json" --baseline agg_smoke
+    # The segmented-sort A/B's counter signature is part of the same
+    # gate (docs/ROOFLINE.md §9): a deterministic segmented join's
+    # device counters (fine-bucket wire bytes, segment stamp,
+    # matches) — a changed sub-bucket router, fine padding, or
+    # batched join seam moves them. The strict oracle/trace gates
+    # live in the sortpath lane.
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.benchmarks.distributed_join \
+      --platform cpu --n-ranks 8 \
+      --build-table-nrows 8000 --probe-table-nrows 8000 \
+      --iterations 1 --out-capacity-factor 3.0 \
+      --sort-ab 1 --sort-segments 8 \
+      --json-output "$tmp/sort_record.json"
+    python - "$tmp" <<'PY'
+import json, sys
+ab = json.load(open(f"{sys.argv[1]}/sort_record.json"))["sort_ab"]
+json.dump(ab, open(f"{sys.argv[1]}/sortpath_smoke.json", "w"),
+          indent=1)
+PY
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/sortpath_smoke.json" --baseline sortpath_smoke
     exit $?
     ;;
   agg)
@@ -300,6 +335,65 @@ assert rec["agg"] and agg["oracle_equal"], rec
 print(f"tpch --agg: {agg['groups']} groups oracle-exact, "
       f"{rec['matches_per_join']} would-be join rows fused away")
 PY
+    ;;
+  sortpath)
+    # Segmented-sort join pipeline (docs/ROOFLINE.md §9). 1. the
+    # -m sortpath unit suite (segmented-vs-flat-vs-oracle multiset
+    # exactness across shuffle modes/k/skew/string keys, segment
+    # edge cases, refusal contract, plan==program digest + wire
+    # exactness, the 2^24 kernel-path guard, expand window
+    # decoupling, chunked fallback gather, tuner policy); 2. a
+    # deterministic CPU-mesh driver smoke: the SEGMENTED program is
+    # the timed mode, its padded wire-byte prediction gated EXACTLY
+    # (analyze explain --gate-wire-bytes), and the --sort-ab record
+    # must be oracle-clean on both modes, multiset-equal, zero warm
+    # traces, wire-exact — its counter signature is the
+    # sortpath_smoke baseline the perfgate lane also gates. Wall
+    # time is never gated on the CPU mesh (emulation, not perf —
+    # the real segmented-vs-flat number rides relay step 10).
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m sortpath --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    tmp="$(mktemp -d /tmp/djtpu_sortpath.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.benchmarks.distributed_join \
+      --platform cpu --n-ranks 8 \
+      --build-table-nrows 8000 --probe-table-nrows 8000 \
+      --iterations 1 --out-capacity-factor 3.0 \
+      --sort-mode segmented --sort-segments 8 \
+      --telemetry "$tmp/tel" --explain --sort-ab 2 \
+      --json-output "$tmp/record.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/tel/explain.json"
+    # The hard gate: the SEGMENTED program's predicted wire bytes
+    # must EXACTLY equal the measured device counters.
+    python -m distributed_join_tpu.telemetry.analyze explain \
+      "$tmp/tel/explain.json" --record "$tmp/record.json" \
+      --gate-wire-bytes
+    python - "$tmp" <<'PY'
+import json, sys
+ab = json.load(open(f"{sys.argv[1]}/record.json"))["sort_ab"]
+json.dump(ab, open(f"{sys.argv[1]}/sortpath_smoke.json", "w"),
+          indent=1)
+assert ab.get("skipped") is None, ab
+assert ab["oracle_equal_flat"] and ab["oracle_equal_segmented"], ab
+assert ab["multiset_equal"], ab
+assert ab["warm_new_traces"] == 0, ab
+assert ab["wire_exact"], ab
+print(f"sort A/B: {ab['sort_segments']} segments, "
+      f"{ab['matches']} matches, oracle-exact both modes, "
+      f"0 warm traces, wire exact "
+      f"(segmented x{ab['segmented_speedup']:.2f} on the CPU mesh — "
+      "not a perf gate)")
+PY
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/sortpath_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/sortpath_smoke.json" --baseline sortpath_smoke
+    exit $?
     ;;
   lint)
     # Static analysis (docs/STATIC_ANALYSIS.md): level-1 AST rules
