@@ -41,6 +41,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import random
 import sys
 import time
@@ -749,6 +750,303 @@ def tuner_slice(seed: int, trials: int, n_ranks: int = 8,
     }
 
 
+# -- the fleet slice (kill/hang/corrupt one replica mid-soak) ---------
+
+
+def _fleet_trial_spec(seed: int, trial: int) -> dict:
+    """One trial's wire join spec, deterministic in (seed, trial).
+    Small discrete shape sets so the fleet shares few compiled
+    programs (the shared persist dir absorbs repeats); rand_max stays
+    >= 256 for the usual organic-overflow reason."""
+    trng = _trial_rng(seed, 555_100 + trial)
+    return {
+        "op": "join",
+        "build_nrows": trng.choice((512, 1024)),
+        "probe_nrows": 1024,
+        "rand_max": 512,
+        "selectivity": trng.choice((0.3, 0.5)),
+        "seed": trng.randrange(1 << 16),
+        "out_capacity_factor": 3.0,
+    }
+
+
+def fleet_slice(seed: int, trials: int, *, replica_ranks: int = 2,
+                fault: Optional[str] = None,
+                repro_out: Optional[str] = None) -> dict:
+    """The ``--fleet`` soak (docs/FLEET.md): a 2-replica subprocess
+    fleet behind the signature-affinity router, N seeded join trials
+    through the router's TCP wire, ONE replica faulted mid-soak —
+    ``kill`` (SIGKILL at the midpoint trial), ``hang``
+    (``FaultPlan.dispatch_delay_s`` armed after a few dispatches via
+    ``--fault-plan``, turning into a replica-side HangError + poison),
+    or ``corrupt`` (a corruption-mode plan + ``--verify-integrity
+    --auto-retry 0``, so the integrity rung refuses loudly through
+    the router; the victim is excluded from the shared persist dir so
+    its corrupted trace can never enter the distribution tier).
+
+    Gates (the ISSUE 15 acceptance bar):
+
+    - every non-refused answer grades pandas-oracle-clean (a wrong
+      match count through the router is ``FAILED:wrong_result`` —
+      the one unforgivable outcome);
+    - a refusal is only a PASS when it is structured AND the schedule
+      injected something (kill/hang failovers absorb transparently;
+      the corrupt victim's IntegrityError passes through);
+    - for kill/hang: the faulted replica is DRAINED within one probe
+      interval (+ scheduling slack) of the fault surfacing and
+      REPLACED (healthy at a higher generation), and the
+      post-replacement repeat of a PRE-FAULT workload signature
+      dispatches with ZERO new traces (the shared persist dir is the
+      distribution tier);
+    - no request is lost: served + structured refusals == trials
+      (the router answers everything; the failover budget bounds the
+      retries behind each answer).
+    """
+    import tempfile
+
+    from distributed_join_tpu.service import fleet as fleet_mod
+    from distributed_join_tpu.service.server import (
+        ServiceClient,
+        _tables_from_spec,
+    )
+
+    rng = _trial_rng(seed, 555_000)
+    fault = fault or rng.choice(("kill", "hang", "corrupt"))
+    # The victim is the replica AFFINE to trial 0's workload (the
+    # router's routing is deterministic given the spec), so the armed
+    # fault is guaranteed to face traffic — an rng-drawn index could
+    # land on a replica the whole soak never routes to.
+    trial0 = _fleet_trial_spec(seed, 0)
+    victim = fleet_mod.affine_replica(trial0, replica_ranks, 2)
+    workdir = tempfile.mkdtemp(prefix="djtpu_fleet_soak_")
+    cfg = fleet_mod.FleetConfig(
+        n_replicas=2,
+        replica_ranks=replica_ranks,
+        persist_dir=os.path.join(workdir, "programs"),
+        history_dir=os.path.join(workdir, "history"),
+        probe_interval_s=0.5,
+        suspect_strikes=2,
+        retry_budget=2,
+        request_deadline_s=120.0,
+    )
+    # Per-INDEX flight-recorder paths in the soak's workdir: a shared
+    # path would let the sibling's stop-time dump clobber the
+    # victim's postmortem (respawned generations default to the
+    # persist dir — still inside the workdir, never the cwd).
+    overrides: dict = {
+        i: {"extra_args": ["--flight-recorder-path",
+                           os.path.join(workdir,
+                                        f"replica{i}_fr.json")]}
+        for i in (0, 1)
+    }
+    if fault == "hang":
+        overrides[victim]["fault_plan"] = {
+            "seed": seed % (1 << 16),
+            "dispatch_delay_s": 30.0,
+            "delay_after_dispatches": 3}
+        # The per-request watchdog must cover the victim's cold
+        # compiles (its first dispatches are delay-free) but trip
+        # well inside the 30s injected stall.
+        overrides[victim]["extra_args"] += ["--guard-deadline-s",
+                                            "10.0"]
+    elif fault == "corrupt":
+        overrides[victim]["fault_plan"] = {
+            "seed": seed % (1 << 16),
+            "corrupt_mode": rng.choice(CORRUPTION_MODES),
+            "corrupt_collectives": 1}
+        overrides[victim]["extra_args"] += ["--verify-integrity",
+                                            "--auto-retry", "0"]
+        overrides[victim]["persist"] = False
+    router = fleet_mod.FleetRouter(
+        fleet_mod.process_fleet_factory(
+            cfg, platform="cpu", replica_overrides=overrides), cfg)
+    router.start()
+    server, port = fleet_mod.start_router_daemon(router)
+    client = ServiceClient("127.0.0.1", port)
+    kill_at = trials // 2
+
+    def refusal_injected(k: int, err: str) -> bool:
+        """Whether a structured refusal is attributable to THE armed
+        fault — anything else (a spurious shed, a bogus overflow
+        refusal from the healthy sibling) must grade FAILED even on a
+        faulted soak, or the acceptance gate would mask regressions.
+        corrupt injects exactly an IntegrityError; hang surfaces as
+        HangError/poisoned (raw, or folded into the router's
+        failover-exhausted FleetError message); a post-kill refusal
+        must chain from the dead replica's connection (a spurious
+        shed from the healthy sibling is a failure even then)."""
+        if fault == "kill":
+            return k >= kill_at and ("connection" in err
+                                     or "FleetError" in err)
+        if fault == "corrupt":
+            return "IntegrityError" in err
+        return any(tag in err for tag in ("Hang", "hang", "poisoned"))
+
+    records, failures = [], []
+    pre_fault_spec = None
+    fault_seen_at: Optional[float] = None
+
+    def grade(resp, expected, k: int) -> TrialOutcome:
+        if resp.get("ok"):
+            if resp.get("overflow"):
+                return TrialOutcome("FAILED:overflow",
+                                    expected_total=expected)
+            got = resp.get("matches")
+            failovers = (resp.get("fleet") or {}).get("failovers", 0)
+            if got == expected:
+                return TrialOutcome(
+                    "recovered" if failovers else "ok",
+                    expected_total=expected, got_total=got,
+                    retries=failovers)
+            return TrialOutcome(
+                "FAILED:wrong_result", expected_total=expected,
+                got_total=got, retries=failovers)
+        err = f"{resp.get('error')}: {resp.get('message')}"
+        return TrialOutcome(
+            "detected" if refusal_injected(k, err)
+            else "FAILED:refused",
+            error=err, expected_total=expected)
+
+    try:
+        for k in range(trials):
+            spec = _fleet_trial_spec(seed, k)
+            if pre_fault_spec is None:
+                pre_fault_spec = dict(spec)
+            if fault == "kill" and k == kill_at:
+                router.replicas[victim].backend.kill()
+                fault_seen_at = time.monotonic()
+            build, probe = _tables_from_spec(spec)
+            expected = len(_oracle_frame(build, probe))
+            t_send = time.monotonic()
+            t0 = time.perf_counter()
+            try:
+                resp = client.send(spec)
+            except (OSError, ValueError) as exc:
+                # The ROUTER must never die under a replica fault.
+                resp = {"ok": False, "error": "RouterLost",
+                        "message": f"{type(exc).__name__}: {exc}"}
+            t_resp = time.monotonic()
+            out = grade(resp, expected, k)
+            rep = router.replicas[victim]
+            if fault in ("hang", "corrupt") \
+                    and fault_seen_at is None \
+                    and (rep.drained_at or 0) >= t_send:
+                # The armed fault surfaced during THIS trial: it
+                # became OBSERVABLE when the replica's HangError
+                # answer (its own watchdog deadline — the in-flight
+                # request 'deadlines out' by design) reached the
+                # router, which is no later than our response. The
+                # drain-latency gate measures from there.
+                fault_seen_at = min(t_resp, rep.drained_at)
+            rec = {"trial": k, "spec": spec, "fault": fault,
+                   **dataclasses.asdict(out),
+                   "verdict": out.verdict,
+                   "elapsed_s": round(time.perf_counter() - t0, 3)}
+            records.append(rec)
+            print(f"fleet trial {k:3d} fault={fault:7s} -> "
+                  f"{rec['verdict']} ({rec['elapsed_s']}s)",
+                  flush=True)
+            if out.failed:
+                failures.append(rec)
+                if repro_out:
+                    path = f"{repro_out}_fleet_{seed}_{k}.json"
+                    with open(path, "w") as f:
+                        json.dump({**rec, "harness_seed": seed,
+                                   "replay": "python -m distributed_"
+                                   "join_tpu.parallel.chaos --fleet "
+                                   f"{trials} --seed {seed}"},
+                                  f, indent=2)
+                    print(f"  repro written: {path}", flush=True)
+
+        drain_replace = {"required": fault in ("kill", "hang")}
+        post_replacement_new_traces = None
+        if fault in ("kill", "hang"):
+            rep = router.replicas[victim]
+            replaced = router.wait_replaced(
+                victim, timeout_s=cfg.spawn_timeout_s)
+            drained_after_s = (
+                (rep.drained_at - fault_seen_at)
+                if rep.drained_at is not None
+                and fault_seen_at is not None else None)
+            within = (drained_after_s is not None
+                      and drained_after_s
+                      <= 3 * cfg.probe_interval_s + 5.0)
+            drain_replace.update(
+                drained=rep.drained_at is not None,
+                drained_after_s=(round(drained_after_s, 3)
+                                 if drained_after_s is not None
+                                 else None),
+                drained_within_probe_interval=within,
+                replaced=replaced,
+                generation=rep.generation)
+            if not (replaced and within):
+                failures.append({"gate": "drain_replace",
+                                 **drain_replace})
+            # Zero-trace warm repeat of a PRE-FAULT signature on the
+            # replacement (the shared persist dir at work) — only
+            # when a replacement is actually up; dialing the dead
+            # backend's old port would crash the harness instead of
+            # recording the gate failure above.
+            if replaced:
+                try:
+                    direct = ServiceClient(*rep.addr(),
+                                           timeout_s=120.0)
+                    try:
+                        replay = direct.send(dict(pre_fault_spec))
+                    finally:
+                        direct.close()
+                except (OSError, ValueError) as exc:
+                    replay = {"ok": False, "error": "RouterLost",
+                              "message":
+                                  f"{type(exc).__name__}: {exc}"}
+                post_replacement_new_traces = replay.get(
+                    "new_traces")
+                if not replay.get("ok") \
+                        or replay.get("new_traces") != 0:
+                    failures.append({
+                        "gate": "post_replacement_warm",
+                        "response": {kk: replay.get(kk) for kk in
+                                     ("ok", "error", "message",
+                                      "new_traces", "matches")}})
+    finally:
+        client.close()
+        server.shutdown()
+        server.server_close()
+        router.stop()
+
+    verdicts: dict = {}
+    for rec in records:
+        verdicts[rec["verdict"]] = verdicts.get(rec["verdict"], 0) + 1
+    answered = sum(1 for r in records
+                   if not r["verdict"].startswith("FAILED"))
+    if failures:
+        # Keep the workdir: the per-replica flight dumps and the
+        # shared program dir ARE the postmortem.
+        print(f"fleet soak artifacts kept at {workdir}", flush=True)
+    else:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {
+        "kind": "fleet_soak",
+        "schema_version": 1,
+        "harness_seed": seed,
+        "slice": "fleet",
+        "fault": fault,
+        "victim": victim,
+        "replica_ranks": replica_ranks,
+        "trials": len(records),
+        "verdicts": verdicts,
+        "answered": answered,
+        "failures": len(failures),
+        "failure_records": failures,
+        "drain_replace": drain_replace,
+        "post_replacement_new_traces": post_replacement_new_traces,
+        "fleet_stats": router.stats(),
+        "records": records,
+    }
+
+
 # -- the soak loop ----------------------------------------------------
 
 
@@ -818,6 +1116,21 @@ def parse_args(argv=None):
                         "a faked multi-slice mesh, fault schedules "
                         "including the cross-slice DCN exchange seam, "
                         "pandas-oracle graded with wire digests on)")
+    p.add_argument("--fleet", type=int, default=None, metavar="N",
+                   help="instead of the main soak: N join trials "
+                        "through a 2-replica subprocess fleet behind "
+                        "the signature-affinity router "
+                        "(service/fleet.py), ONE replica killed/"
+                        "hung/corrupted mid-soak — every non-refused "
+                        "answer pandas-oracle-graded, drain+replace "
+                        "and the zero-trace warm replacement gated "
+                        "(docs/FLEET.md)")
+    p.add_argument("--fleet-fault", default=None,
+                   choices=("kill", "hang", "corrupt"),
+                   help="pin the fleet soak's fault (default: drawn "
+                        "from the harness seed)")
+    p.add_argument("--replica-ranks", type=int, default=2,
+                   help="mesh size of each fleet replica")
     p.add_argument("--tuner-slice", type=int, default=None,
                    metavar="N",
                    help="instead of the main soak: N poisoned-history "
@@ -855,7 +1168,12 @@ def main(argv=None) -> int:
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       0.5)
 
-    if args.hier_slice:
+    if args.fleet:
+        summary = fleet_slice(args.seed, args.fleet,
+                              replica_ranks=args.replica_ranks,
+                              fault=args.fleet_fault,
+                              repro_out=args.repro_out)
+    elif args.hier_slice:
         summary = hier_slice(args.seed, args.hier_slice,
                              n_ranks=args.n_ranks,
                              deadline_s=(args.trial_deadline_s

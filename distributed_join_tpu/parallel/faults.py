@@ -92,6 +92,11 @@ class FaultPlan:
       out-of-core resume tests.
     - ``dispatch_delay_s``: sleep before each dispatch (a slow/
       congested interconnect; drives deadline paths).
+      ``delay_after_dispatches`` defers the delay: the first N
+      dispatches run at full speed and every LATER one sleeps — a
+      replica that serves healthily and then wedges mid-soak, the
+      scripted hang the fleet chaos slice injects (None/0 = delay
+      from the first dispatch, the historical behavior).
     - ``corrupt_plan_gathers``: the first N 1-D int32 all-gathers (the
       ragged plan's count exchange) come back rank-INCONSISTENTLY
       perturbed: each rank adds its own rank index to row
@@ -130,10 +135,26 @@ class FaultPlan:
     fail_dispatches: int = 0
     fail_after_dispatches: Optional[int] = None
     dispatch_delay_s: float = 0.0
+    delay_after_dispatches: Optional[int] = None
     corrupt_plan_gathers: int = 0
     corrupt_mode: Optional[str] = None
     corrupt_collectives: int = 0
     corrupt_rank: Optional[int] = None
+
+
+def plan_from_record(record: dict) -> FaultPlan:
+    """Rebuild a :class:`FaultPlan` from its JSON-shaped record (the
+    inverse of ``dataclasses.asdict``, unknown keys refused loudly) —
+    the seam behind the daemon's ``--fault-plan`` flag, which lets the
+    fleet chaos harness script a replica's outage from the command
+    line instead of patching code."""
+    known = {f.name for f in dataclasses.fields(FaultPlan)}
+    unknown = set(record) - known
+    if unknown:
+        raise ValueError(
+            f"unknown FaultPlan field(s) {sorted(unknown)}; "
+            f"known: {sorted(known)}")
+    return FaultPlan(**record)
 
 
 CORRUPTION_MODES = ("bit_flip", "row_truncate", "row_duplicate",
@@ -385,7 +406,8 @@ class FaultInjectingCommunicator(Communicator):
 
         def dispatch(*args, **kwargs):
             self._dispatches += 1
-            if self.plan.dispatch_delay_s:
+            if self.plan.dispatch_delay_s and self._dispatches > (
+                    self.plan.delay_after_dispatches or 0):
                 time.sleep(self.plan.dispatch_delay_s)
             if self._dispatches <= self.plan.fail_dispatches:
                 raise FaultInjectedError(

@@ -22,15 +22,25 @@ SURVEY.md). This package makes the warm path run-only:
   stamp), served by probe-only programs and maintained LSM-style
   from streaming delta appends (ROADMAP item 4);
 - :mod:`.server` — :class:`~.server.JoinService` (admission, watchdog
-  deadlines, per-request telemetry spans, the retry ladder routed
-  through the cache) and the resident TCP daemon
+  deadlines, per-request telemetry spans, graceful drain, the retry
+  ladder routed through the cache) and the resident TCP daemon
   (``tpu-join-service`` / ``python -m
   distributed_join_tpu.service.server``) that keeps the mesh and the
-  cache warm between requests.
+  cache warm between requests;
+- :mod:`.fleet` — the fault-tolerant serving fleet
+  (``tpu-join-fleet``): a signature-affinity router over N daemon
+  replicas with health-probed drain/replace, bounded failover, load
+  shedding, and fleet-level observability (docs/FLEET.md) — the
+  failure domain becomes one replica, not the service.
 
-Contract doc: docs/SERVICE.md. CI: the ``service`` lane of
-``scripts/run_tier1.sh`` plus the ``service_smoke`` counter-signature
-baseline gated by the ``perfgate`` lane.
+Contract docs: docs/SERVICE.md, docs/FLEET.md. CI: the ``service``
+and ``fleet`` lanes of ``scripts/run_tier1.sh`` plus the
+``service_smoke``/``fleet_smoke`` counter-signature baselines gated
+by the ``perfgate`` lane.
+
+(server and fleet are deliberately NOT imported here: they are
+``python -m`` entry points, and importing them from the package
+__init__ would double-execute them under runpy.)
 """
 
 from distributed_join_tpu.service.programs import (

@@ -1,0 +1,1530 @@
+"""Fault-tolerant serving fleet: a signature-affinity router over N
+``tpu-join-service`` replicas (docs/FLEET.md; ROADMAP item 6).
+
+One daemon = one mesh = one SPMD program at a time, and the
+deliberately conservative hang semantics of docs/FAILURE_SEMANTICS.md
+mean a poisoned daemon refuses traffic until a human restarts it. The
+fleet tier makes the failure domain ONE REPLICA instead of the
+service:
+
+- **Routing is signature-affine.** The router hashes the SAME
+  canonical workload-signature digest the program cache and tuner key
+  on (:func:`~..planning.tuner.workload_signature` over abstract
+  tables built from the wire spec — zero traces, zero devices), so a
+  repeat workload lands where its executable is already resident;
+  table-management ops hash the table name, so ``register`` and the
+  probe-only ``join`` of one handle co-locate. A shared AOT
+  ``--persist-dir`` is the compiled-program distribution tier: any
+  replica (including a cold replacement) loads a sibling's programs
+  with zero traces.
+- **Replica lifecycle.** Periodic ``stats`` health probes plus
+  per-request outcome accounting drive a state machine
+  (healthy -> suspect -> drained). A replica that reports
+  ``poisoned``, times out, or drops its connection is DRAINED (no new
+  requests; in-flight completes or deadlines out under the replica's
+  own watchdog) and REPLACED (respawned on its device subset, warm
+  via the shared persist dir) — never left refusing traffic forever.
+- **Failover.** A request whose replica dies mid-flight retries on
+  the next affine replica under a bounded
+  :func:`~..parallel.faults.retry_with_backoff` budget with a
+  per-request deadline — idempotent because the wire carries query
+  SPECS, not table bytes. Duplicate dispatch is fenced by request id:
+  a second arrival of an id still in flight is refused, and a
+  superseded attempt's connection is abandoned (its late answer is
+  never read).
+- **Load shedding.** Admission at the router is driven by per-replica
+  inflight counters plus the ``qps_60s``/p95 figures of the replicas'
+  own :class:`~..telemetry.live.LiveMetrics` snapshots (taken by the
+  health probe): when no replica is admittable the router answers a
+  structured ``AdmissionError`` instead of queueing unboundedly.
+- **Observability.** The router keeps its own
+  :class:`~..telemetry.live.LiveMetrics` /
+  :class:`~..telemetry.live.FlightRecorder` /
+  :class:`~..telemetry.history.WorkloadHistory` (entries stamped with
+  the serving replica), and exposes fleet-level Prometheus gauges
+  ``djtpu_fleet_{replicas,healthy,suspect,drained,failovers_total,
+  shed_total,replaced_total}`` next to the usual request counters.
+
+``python -m distributed_join_tpu.service.fleet`` (``tpu-join-fleet``)
+serves the same line-JSON wire protocol as one daemon — clients do not
+change. ``--smoke`` runs the CI acceptance protocol (the ``fleet``
+lane of ``scripts/run_tier1.sh``): a 2-replica CPU-mesh fleet, warm
+affinity discipline, ONE SCRIPTED REPLICA KILL mid-traffic, and gates
+on oracle equality, drain+replace observed, bounded retry count, and
+a zero-trace warm repeat on the replacement.
+
+The chaos soak lives in ``parallel/chaos.py --fleet`` (kill / hang /
+corrupt one replica mid-soak, every non-refused answer graded against
+the pandas oracle).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+from distributed_join_tpu import telemetry
+from distributed_join_tpu.service.server import (
+    AdmissionError,
+    ServiceClient,
+    _join_opts_from_spec,
+)
+from distributed_join_tpu.telemetry import history as tel_history
+from distributed_join_tpu.telemetry import live as tel_live
+
+
+class FleetError(RuntimeError):
+    """A fleet-level structured failure (failover budget exhausted,
+    duplicate in-flight request id) — answered on the wire, never an
+    unstructured crash of the router."""
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Router policy knobs (docs/FLEET.md).
+
+    ``replica_ranks`` is each replica's mesh size (the affinity hash
+    binds it — the workload signature covers ``n_ranks``);
+    ``persist_dir`` is the SHARED compiled-program distribution tier
+    (a replacement replica cold-loads its predecessor's programs with
+    zero traces). ``probe_interval_s`` paces the health prober;
+    ``suspect_strikes`` is how many consecutive probe/request
+    failures turn suspicion into a drain. ``retry_budget`` bounds
+    failover attempts per request (total attempts = budget + 1) and
+    ``request_deadline_s`` bounds the whole request across attempts.
+    ``max_inflight_per_replica`` plus the optional ``shed_p95_s`` /
+    ``shed_qps`` bounds (read from the replicas' probed LiveMetrics
+    snapshots) drive admission — beyond them the router sheds with a
+    structured ``AdmissionError``.
+    """
+
+    n_replicas: int = 2
+    replica_ranks: int = 2
+    persist_dir: Optional[str] = None
+    probe_interval_s: float = 1.0
+    probe_timeout_s: float = 5.0
+    spawn_timeout_s: float = 180.0
+    drain_settle_s: float = 10.0
+    suspect_strikes: int = 2
+    retry_budget: int = 2
+    retry_backoff_s: float = 0.1
+    request_deadline_s: float = 300.0
+    max_inflight_per_replica: int = 4
+    shed_p95_s: Optional[float] = None
+    shed_qps: Optional[float] = None
+    respawn: bool = True
+    history_dir: Optional[str] = None
+    flight_records: int = 256
+    flight_recorder_path: Optional[str] = None
+
+
+# -- replica backends --------------------------------------------------
+
+
+class ProcessReplica:
+    """One ``tpu-join-service`` subprocess (the production backend:
+    disjoint hosts on hardware, per-process virtual CPU meshes in
+    tests). The constructor blocks until the daemon's ``listening``
+    line names its port."""
+
+    def __init__(self, argv: list, spawn_timeout_s: float = 180.0):
+        self.argv = list(argv)
+        self.proc = subprocess.Popen(
+            self.argv, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        # One reader thread owns stdout for the process's lifetime:
+        # it resolves the 'listening' line AND keeps the pipe from
+        # filling afterwards (a bare readline-with-timeout would race
+        # Python's stream buffering).
+        self._listening = threading.Event()
+        self._addr: Optional[tuple] = None
+        self._reader = threading.Thread(target=self._read_stdout,
+                                        daemon=True)
+        self._reader.start()
+        if not self._listening.wait(spawn_timeout_s) \
+                or self._addr is None:
+            rc = self.proc.poll()
+            self.proc.kill()
+            raise FleetError(
+                f"replica did not report a listening port within "
+                f"{spawn_timeout_s}s (rc={rc}): "
+                f"{' '.join(self.argv)}")
+        self.host, self.port = self._addr
+
+    def _read_stdout(self):
+        try:
+            for line in self.proc.stdout:
+                if self._addr is None and "listening on " in line:
+                    addr = line.rsplit("listening on ",
+                                       1)[1].strip()
+                    host, port = addr.rsplit(":", 1)
+                    self._addr = (host, int(port))
+                    self._listening.set()
+        except (OSError, ValueError):  # pragma: no cover - teardown
+            pass
+        finally:
+            # EOF before the listening line = the replica died at
+            # spawn; release the constructor immediately.
+            self._listening.set()
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        """Hard stop (SIGKILL) — the chaos harness's scripted death."""
+        if self.alive():
+            self.proc.kill()
+        self.proc.wait(timeout=30.0)
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        """Reap after a graceful drain attempt: SIGTERM (the daemon's
+        handler drains and exits 0), bounded wait, then SIGKILL."""
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=30.0)
+
+
+class InProcessReplica:
+    """One in-process :class:`~.server.JoinService` behind a real TCP
+    daemon — the fleet TEST backend: replicas over DISJOINT device
+    subsets of the one CPU mesh, spawn/kill in milliseconds, no
+    subprocess bootstrap. ``kill`` closes the listening socket
+    (connection-refused to the router, exactly what a dead process
+    looks like on the wire)."""
+
+    def __init__(self, service):
+        from distributed_join_tpu.service.server import start_daemon
+
+        self.service = service
+        self.server, self.port = start_daemon(service)
+        self.host = "127.0.0.1"
+        self._dead = False
+
+    def alive(self) -> bool:
+        return not self._dead
+
+    def kill(self) -> None:
+        self._dead = True
+        self.server.shutdown()
+        self.server.server_close()
+
+    def stop(self, timeout_s: float = 10.0) -> None:  # noqa: ARG002
+        if not self._dead:
+            self.kill()
+
+
+def in_process_fleet_factory(n_replicas: int, ranks_per_replica: int,
+                             service_config=None,
+                             comm_wrap: Optional[Callable] = None,
+                             persist_dir: Optional[str] = None):
+    """A replica factory over DISJOINT CPU-mesh device subsets:
+    replica ``i`` serves devices ``[i*k, (i+1)*k)`` — the test-side
+    realization of 'the failure domain is one replica'. ``comm_wrap``
+    (index, generation, comm) -> comm lets tests arm one replica with
+    a :class:`~..parallel.faults.FaultInjectingCommunicator`.
+
+    ``persist_dir`` arms PER-SLOT persist subdirs (``r0/``, ``r1/``,
+    ...): an AOT blob binds its device assignment, so two in-process
+    replicas on DIFFERENT subsets of one runtime cannot share blobs —
+    but a replacement respawned on the SAME subset restarts warm,
+    which is what the in-process tests lock. Cross-replica sharing is
+    real only across processes (each subprocess sees its own
+    ``cpu:0..k-1``) and is locked by the subprocess smoke."""
+    import jax
+
+    from distributed_join_tpu.parallel.communicator import (
+        TpuCommunicator,
+    )
+    from distributed_join_tpu.parallel.mesh import make_mesh
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+
+    devices = jax.devices()
+    need = n_replicas * ranks_per_replica
+    if need > len(devices):
+        raise ValueError(
+            f"{n_replicas} replicas x {ranks_per_replica} ranks needs "
+            f"{need} devices, have {len(devices)}")
+
+    def factory(index: int, generation: int) -> InProcessReplica:
+        subset = devices[index * ranks_per_replica:
+                         (index + 1) * ranks_per_replica]
+        comm = TpuCommunicator(mesh=make_mesh(devices=subset))
+        if comm_wrap is not None:
+            comm = comm_wrap(index, generation, comm)
+        cfg = service_config or ServiceConfig()
+        if persist_dir is not None:
+            cfg = dataclasses.replace(
+                cfg, persist_dir=os.path.join(persist_dir,
+                                              f"r{index}"))
+        return InProcessReplica(JoinService(comm, cfg))
+
+    return factory
+
+
+def process_fleet_factory(config: FleetConfig,
+                          platform: str = "cpu",
+                          extra_args: Optional[list] = None,
+                          replica_overrides: Optional[dict] = None):
+    """The default replica factory: one ``tpu-join-service``
+    subprocess per replica, sharing ``config.persist_dir``.
+
+    ``replica_overrides`` maps a replica index to a dict applied ONLY
+    on generation 0 (the chaos harness's scripted outage; every
+    replacement respawns clean): ``"fault_plan"`` (a FaultPlan JSON
+    record, forwarded as ``--fault-plan``), ``"extra_args"`` (extra
+    argv tokens — e.g. the hang scenario's ``--guard-deadline-s``),
+    and ``"persist": False`` (exclude the victim from the shared
+    persist dir, so a corruption-armed replica must TRACE — a
+    corrupted trace must never enter the fleet's distribution
+    tier)."""
+
+    def factory(index: int, generation: int) -> ProcessReplica:
+        override = ((replica_overrides or {}).get(index) or {}
+                    if generation == 0 else {})
+        argv = [
+            sys.executable, "-m",
+            "distributed_join_tpu.service.server",
+            "--host", "127.0.0.1", "--port", "0",
+            "--platform", platform,
+            "--n-ranks", str(config.replica_ranks),
+        ]
+        if config.persist_dir and override.get("persist", True):
+            argv += ["--persist-dir", config.persist_dir]
+        plan = override.get("fault_plan")
+        if plan is not None:
+            argv += ["--fault-plan", json.dumps(plan)]
+        argv += list(extra_args or [])
+        argv += list(override.get("extra_args") or [])
+        return ProcessReplica(argv,
+                              spawn_timeout_s=config.spawn_timeout_s)
+
+    return factory
+
+
+# -- the router --------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Replica:
+    """Router-side replica bookkeeping (the state machine's subject).
+    ``state``: starting -> healthy -> suspect -> drained (-> healthy
+    again after replacement; ``failed`` when a respawn itself died)."""
+
+    index: int
+    backend: object
+    generation: int = 0
+    state: str = "healthy"
+    strikes: int = 0
+    inflight: int = 0
+    last_stats: Optional[dict] = None
+    drained_reason: Optional[str] = None
+    drained_at: Optional[float] = None
+    replaced_at: Optional[float] = None
+
+    def addr(self):
+        return self.backend.host, self.backend.port
+
+
+class _AffinityStub:
+    """The n_ranks/n_slices view :func:`workload_signature` needs —
+    the router hashes signatures without a mesh or devices."""
+
+    def __init__(self, n_ranks: int, n_slices: int = 1):
+        self.n_ranks = n_ranks
+        self.n_slices = n_slices
+
+
+def affinity_key(req: dict, replica_ranks: int) -> str:
+    """THE canonical routing digest of one wire request (module-level
+    so harnesses can predict routing before a fleet exists). Join and
+    explain specs hash through the workload-signature function the
+    program cache and tuner key on (abstract tables from the spec's
+    shapes — no data, no devices); table-management ops hash the
+    table name so one handle's traffic co-locates; anything else
+    hashes its canonical JSON."""
+    op = req.get("op")
+    table = req.get("table") or (
+        req.get("name") if op in ("register", "append", "drop")
+        else None)
+    if table is not None:
+        return hashlib.sha256(
+            f"table:{table}".encode()).hexdigest()[:16]
+    if op in ("join", "explain") and req.get("build_nrows"):
+        try:
+            from distributed_join_tpu.planning import abstract_tables
+            from distributed_join_tpu.planning.tuner import (
+                workload_signature,
+            )
+
+            build, probe = abstract_tables(
+                int(req["build_nrows"]), int(req["probe_nrows"]))
+            stub = _AffinityStub(replica_ranks)
+            return workload_signature(
+                stub, build, probe, with_metrics=False,
+                **_join_opts_from_spec(req))
+        except Exception as exc:  # noqa: BLE001 - fall to JSON hash
+            # Loud: routing by the JSON fallback still works, but it
+            # no longer matches the replicas' program-cache digests —
+            # warm repeats would scatter. A silent fallback here made
+            # that near-undiagnosable.
+            telemetry.event(
+                "fleet_affinity_fallback", op=op,
+                error=f"{type(exc).__name__}: {exc}")
+    basis = json.dumps(
+        {k: repr(v) for k, v in req.items()
+         if k not in ("request_id",)}, sort_keys=True)
+    return hashlib.sha256(basis.encode()).hexdigest()[:16]
+
+
+def affine_replica(req: dict, replica_ranks: int,
+                   n_replicas: int) -> int:
+    """The ring-walk START slot for ``req`` — the replica a healthy
+    fleet serves it from (the chaos harness arms its victim here)."""
+    key = affinity_key(req, replica_ranks)
+    return int(key[:8], 16) % max(n_replicas, 1)
+
+
+class FleetRouter:
+    """The thin line-JSON TCP router fronting N replicas. Owns the
+    replica set (spawn, probe, drain, replace), the affinity routing,
+    the bounded failover loop, admission/shedding, and the fleet-level
+    observability surfaces."""
+
+    def __init__(self, replica_factory: Callable,
+                 config: Optional[FleetConfig] = None):
+        self.config = config or FleetConfig()
+        self.factory = replica_factory
+        self._lock = threading.Lock()
+        self.replicas: list = []
+        self.live = tel_live.LiveMetrics()
+        self.recorder = tel_live.FlightRecorder(
+            self.config.flight_records)
+        self.history = (tel_history.WorkloadHistory(os.path.join(
+            self.config.history_dir, tel_history.HISTORY_FILENAME))
+            if self.config.history_dir else None)
+        self.failovers_total = 0
+        self.shed_total = 0
+        self.replaced_total = 0
+        self.drains_total = 0
+        self.served = 0
+        self.failed = 0
+        self.rejected = 0
+        self._request_seq = 0
+        self._id_stamp = os.urandom(3).hex()
+        self._inflight_ids: set = set()
+        self._stop = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+        self._replace_threads: list = []
+        # Set by the wire `shutdown` op; the serving loop (main) and
+        # embedding harnesses watch it to tear the fleet down.
+        self.shutdown_requested = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn the replica set and start the health prober."""
+        for i in range(self.config.n_replicas):
+            self.replicas.append(
+                _Replica(index=i, backend=self.factory(i, 0)))
+        telemetry.event("fleet_started",
+                        replicas=len(self.replicas))
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        daemon=True,
+                                        name="fleet-prober")
+        self._prober.start()
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop probing, settle any in-flight replacement, and reap
+        every replica (graceful drain op first when ``drain``)."""
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=self.config.probe_interval_s
+                              + self.config.probe_timeout_s + 5.0)
+        # A _replace thread may be mid-spawn: join it (bounded) so
+        # the freshly spawned backend lands in self.replicas and is
+        # reaped below instead of leaking past shutdown.
+        for t in self._replace_threads:
+            t.join(timeout=self.config.spawn_timeout_s
+                   + self.config.drain_settle_s + 10.0)
+        for rep in self.replicas:
+            if drain and rep.backend.alive():
+                self._send_drain(rep)
+            rep.backend.stop()
+        if self.history is not None:
+            self.history.close()
+        if self.config.flight_recorder_path:
+            self.dump_flight_recorder("fleet stopped")
+
+    # -- affinity -----------------------------------------------------
+
+    def affinity_key(self, req: dict) -> str:
+        """The canonical routing digest of one wire request — see the
+        module-level :func:`affinity_key`."""
+        return affinity_key(req, self.config.replica_ranks)
+
+    def _admittable(self, rep: _Replica) -> bool:
+        """Admission policy: state + inflight bound + the optional
+        p95/QPS bounds read from the replica's probed LiveMetrics
+        snapshot (stale by at most one probe interval — shedding is a
+        pressure valve, not an exact gate)."""
+        if rep.state not in ("healthy", "suspect"):
+            return False
+        if rep.inflight >= self.config.max_inflight_per_replica:
+            return False
+        st = rep.last_stats or {}
+        if self.config.shed_p95_s is not None:
+            p95 = (st.get("latency") or {}).get("p95_s")
+            if p95 is not None and p95 > self.config.shed_p95_s:
+                return False
+        if self.config.shed_qps is not None:
+            qps = st.get("qps_60s")
+            if qps is not None and qps > self.config.shed_qps:
+                return False
+        return True
+
+    # -- the health prober + state machine ----------------------------
+
+    def _probe_loop(self):
+        while not self._stop.wait(self.config.probe_interval_s):
+            for rep in list(self.replicas):
+                if self._stop.is_set():
+                    return
+                # drained = replacement in progress; failed = respawn
+                # itself died and the fleet serves on with n-1 — the
+                # prober leaves both alone (re-striking a failed slot
+                # would churn doomed respawns forever).
+                if rep.state in ("drained", "failed"):
+                    continue
+                self._probe_one(rep)
+
+    def _probe_one(self, rep: _Replica):
+        try:
+            client = ServiceClient(
+                *rep.addr(), timeout_s=self.config.probe_timeout_s)
+            try:
+                st = client.send({"op": "stats"})
+            finally:
+                client.close()
+        except (OSError, ValueError) as exc:
+            self._strike(rep, f"probe: {type(exc).__name__}: {exc}")
+            return
+        rep.last_stats = st
+        if st.get("poisoned"):
+            self._drain(rep, f"probe saw poisoned: {st['poisoned']}")
+        elif st.get("draining"):
+            # Someone (an operator, SIGTERM) is draining it out from
+            # under the fleet: stop routing to it and replace.
+            self._drain(rep, "probe saw draining")
+        else:
+            with self._lock:
+                rep.strikes = 0
+                if rep.state == "suspect":
+                    rep.state = "healthy"
+                    telemetry.event("fleet_replica_recovered",
+                                    replica=rep.index)
+
+    def _strike(self, rep: _Replica, reason: str):
+        """One probe/request failure: healthy -> suspect; strikes
+        beyond the bound (or a dead process) -> drained."""
+        with self._lock:
+            rep.strikes += 1
+            strikes = rep.strikes
+            if rep.state == "healthy":
+                rep.state = "suspect"
+                telemetry.event("fleet_replica_suspect",
+                                replica=rep.index, reason=reason)
+        if strikes >= self.config.suspect_strikes \
+                or not rep.backend.alive():
+            self._drain(rep, reason)
+
+    def _drain(self, rep: _Replica, reason: str):
+        """drained + replacement kick-off; idempotent per incident
+        (a failed slot is terminal until an operator intervenes)."""
+        with self._lock:
+            if rep.state in ("drained", "failed"):
+                return
+            rep.state = "drained"
+            rep.drained_reason = reason
+            rep.drained_at = time.monotonic()
+            self.drains_total += 1
+        telemetry.event("fleet_replica_drained", replica=rep.index,
+                        reason=reason)
+        self.recorder.record(
+            request_id=f"fleet-replica-{rep.index}",
+            op="drain_replica", signature=None, outcome="drained",
+            reason=reason,
+            replica={"index": rep.index,
+                     "generation": rep.generation})
+        if self.config.respawn and not self._stop.is_set():
+            t = threading.Thread(target=self._replace, args=(rep,),
+                                 daemon=True,
+                                 name=f"fleet-replace-{rep.index}")
+            with self._lock:
+                self._replace_threads = [x for x in
+                                         self._replace_threads
+                                         if x.is_alive()] + [t]
+            t.start()
+
+    def _send_drain(self, rep: _Replica):
+        """Best-effort graceful drain wire op (in-flight completes or
+        deadlines out replica-side before the reap)."""
+        try:
+            client = ServiceClient(
+                *rep.addr(), timeout_s=self.config.drain_settle_s
+                + self.config.probe_timeout_s)
+            try:
+                client.send({"op": "drain",
+                             "reason": "fleet drain",
+                             "settle_timeout_s":
+                                 self.config.drain_settle_s})
+            finally:
+                client.close()
+        except (OSError, ValueError):
+            pass
+
+    def _replace(self, rep: _Replica):
+        """Reap the drained replica and respawn its slot (same index,
+        same device subset, next generation) — warm via the shared
+        persist dir. A replacement failure marks the slot ``failed``
+        (the fleet serves on with n-1; the prober leaves it alone)."""
+        if rep.backend.alive():
+            self._send_drain(rep)
+        try:
+            rep.backend.stop()
+        except Exception as exc:  # noqa: BLE001 - reap boundary
+            telemetry.event("fleet_replica_reap_error",
+                            replica=rep.index, error=str(exc))
+        if self._stop.is_set():
+            # Teardown in progress: the old backend is reaped, but a
+            # fresh spawn would only leak past stop() — leave the
+            # slot drained.
+            return
+        try:
+            backend = self.factory(rep.index, rep.generation + 1)
+        except Exception as exc:  # noqa: BLE001 - respawn boundary
+            with self._lock:
+                rep.state = "failed"
+            telemetry.event("fleet_replica_respawn_failed",
+                            replica=rep.index,
+                            error=f"{type(exc).__name__}: {exc}")
+            return
+        with self._lock:
+            rep.backend = backend
+            rep.generation += 1
+            rep.state = "healthy"
+            rep.strikes = 0
+            rep.inflight = 0
+            rep.last_stats = None
+            rep.replaced_at = time.monotonic()
+            self.replaced_total += 1
+        telemetry.event("fleet_replica_replaced", replica=rep.index,
+                        generation=rep.generation)
+
+    # -- dispatch -----------------------------------------------------
+
+    def _mint_request_id(self, request_id) -> str:
+        with self._lock:
+            self._request_seq += 1
+            seq = self._request_seq
+        if request_id:
+            rid = str(request_id)
+            if len(rid) > 64:
+                # Cap length WITHOUT aliasing (the JoinService
+                # scheme): two long ids sharing a 64-char prefix must
+                # stay distinct — the duplicate-dispatch fence keys
+                # on rid.
+                rid = (rid[:48] + "-"
+                       + hashlib.sha256(
+                           rid.encode()).hexdigest()[:15])
+            return rid
+        return f"flt-{self._id_stamp}-{seq:06d}"
+
+    def dispatch(self, req: dict) -> dict:
+        """Route one wire request: affinity ring walk, admission,
+        bounded failover, duplicate-id fencing, and the observability
+        fan-out. Always returns a response dict (structured errors
+        included) — the router never crashes a client."""
+        from distributed_join_tpu.parallel.faults import (
+            retry_with_backoff,
+        )
+
+        op = req.get("op", "?")
+        rid = self._mint_request_id(req.get("request_id"))
+        key = self.affinity_key(req)
+        t0 = time.perf_counter()
+        # The duplicate-dispatch fence: one id, one in-flight dispatch
+        # at a time. A resend that arrives while the original is still
+        # running PARKS until the original settles (the documented
+        # reconnect-and-resend client pattern — its first answer was
+        # lost with the torn connection, so the resend must be served,
+        # idempotently, not refused), bounded by the request deadline.
+        fence_deadline = time.monotonic() \
+            + self.config.request_deadline_s
+        while True:
+            with self._lock:
+                if rid not in self._inflight_ids:
+                    self._inflight_ids.add(rid)
+                    break
+            if time.monotonic() >= fence_deadline:
+                with self._lock:
+                    self.rejected += 1
+                self.live.record_request(op, "rejected")
+                # Every refusal lands in the postmortem ring — this
+                # is the one path that bypasses _observe's fan-out.
+                self.recorder.record(
+                    request_id=rid, op=op, signature=key,
+                    outcome="rejected", reason="duplicate_fence")
+                return {"ok": False, "error": "FleetError",
+                        "message": f"request id {rid!r} still in "
+                                   "flight past the request deadline "
+                                   "(duplicate fenced)",
+                        "request_id": rid}
+            time.sleep(0.05)
+        state = {"attempts": 0, "failovers": 0, "replica": None}
+        outcome = "failed"
+        resp = None
+        try:
+            resp = self._dispatch_attempts(
+                req, rid, key, state, retry_with_backoff)
+            outcome = "served" if resp.get("ok") else "failed"
+            return resp
+        except AdmissionError as exc:
+            outcome = "rejected"
+            with self._lock:
+                self.shed_total += 1
+                self.rejected += 1
+            resp = {"ok": False, "error": "AdmissionError",
+                    "message": str(exc), "shed": True,
+                    "request_id": rid,
+                    "fleet": {"attempts": state["attempts"]}}
+            return resp
+        except FleetError as exc:
+            resp = {"ok": False, "error": "FleetError",
+                    "message": str(exc), "request_id": rid,
+                    "fleet": {"attempts": state["attempts"],
+                              "failovers": state["failovers"]}}
+            return resp
+        finally:
+            with self._lock:
+                self._inflight_ids.discard(rid)
+            self._observe(rid, op, key, outcome, state,
+                          time.perf_counter() - t0, resp)
+
+    def _dispatch_attempts(self, req, rid, key, state,
+                           retry_with_backoff):
+        deadline = time.monotonic() + self.config.request_deadline_s
+        # index -> generation at HARD-failure time (dead connection,
+        # hang, poison): a later attempt may return to the slot only
+        # once it has been REPLACED. Transient busy/draining refusals
+        # go in `soft_failed` instead — skipped on the first ring
+        # pass but re-eligible on the fallback pass (the replica-side
+        # pending bound drains between backoffs; fencing it on
+        # generation would starve a small fleet into shedding).
+        last_failed: dict = {}
+        soft_failed: set = set()
+
+        def attempt_once():
+            state["attempts"] += 1
+            rep = self._pick(key, last_failed, soft_failed)
+            if rep is None:
+                raise AdmissionError(
+                    "fleet admission: no admittable replica "
+                    f"(inflight bound "
+                    f"{self.config.max_inflight_per_replica}"
+                    " or p95/QPS shed policy); retry with backoff")
+            state["replica"] = rep
+            gen0 = rep.generation
+            try:
+                remaining = max(deadline - time.monotonic(), 0.1)
+                client = ServiceClient(*rep.addr(),
+                                       timeout_s=remaining)
+                try:
+                    resp = client.send(
+                        {**req, "request_id": rid})
+                finally:
+                    # Superseded attempts are abandoned with their
+                    # connection — a late answer is never read.
+                    client.close()
+            except (OSError, ValueError) as exc:
+                self._strike(
+                    rep, f"request {rid}: "
+                         f"{type(exc).__name__}: {exc}")
+                last_failed[rep.index] = gen0
+                raise _AttemptFailed(
+                    f"replica {rep.index} connection failed: "
+                    f"{type(exc).__name__}: {exc}") from exc
+            finally:
+                with self._lock:
+                    if rep.generation == gen0:
+                        rep.inflight = max(rep.inflight - 1, 0)
+            fault = self._replica_fault(resp)
+            if fault is not None:
+                if fault in ("hang", "poisoned"):
+                    self._drain(rep, f"request {rid}: {fault}")
+                    last_failed[rep.index] = gen0
+                else:
+                    # busy/draining: transient — steer the next
+                    # attempt elsewhere, but stay re-eligible on the
+                    # fallback pass.
+                    soft_failed.add(rep.index)
+                raise _AttemptFailed(
+                    f"replica {rep.index} {fault}: "
+                    f"{resp.get('message') or resp.get('error')}")
+            return self._augment(resp, rep, state)
+
+        try:
+            resp, attempts = retry_with_backoff(
+                attempt_once,
+                max_attempts=self.config.retry_budget + 1,
+                backoff_s=self.config.retry_backoff_s,
+                deadline_s=self.config.request_deadline_s,
+                retry_on=(_AttemptFailed,),
+            )
+            state["failovers"] = len(attempts) - 1
+            with self._lock:
+                self.failovers_total += state["failovers"]
+            return resp
+        except _AttemptFailed as exc:
+            trail = getattr(exc, "_retry_attempts", [])
+            state["failovers"] = max(len(trail) - 1, 0)
+            with self._lock:
+                self.failovers_total += state["failovers"]
+            raise FleetError(
+                f"request {rid} failed after {len(trail)} attempt(s) "
+                f"(retry_budget={self.config.retry_budget}): "
+                f"{exc}") from exc
+
+    def _pick(self, key: str, exclude: dict,
+              soft: Optional[set] = None) -> Optional[_Replica]:
+        """Pick AND reserve (inflight slot taken under the one lock,
+        so two concurrent dispatches can never both pass the
+        admission bound). The caller releases the slot in its
+        dispatch finally. ``exclude`` maps a HARD-failed replica's
+        index to its generation AT failure: that slot becomes
+        eligible again only once REPLACED (generation moved) — never
+        handed the same request back while still the known-bad
+        incarnation. ``soft`` holds transiently-refusing (busy/
+        draining) indices: preferred-against on the first pass,
+        re-eligible on the fallback pass."""
+        with self._lock:
+            n = len(self.replicas)
+            if not n:
+                return None
+            start = int(key[:8], 16) % n
+            order = [self.replicas[(start + k) % n]
+                     for k in range(n)]
+            for second_pass in (False, True):
+                for rep in order:
+                    if rep.index in exclude:
+                        if not second_pass:
+                            continue
+                        if rep.generation <= exclude[rep.index]:
+                            continue
+                    if not second_pass and soft \
+                            and rep.index in soft:
+                        continue
+                    if self._admittable(rep):
+                        rep.inflight += 1
+                        return rep
+        return None
+
+    @staticmethod
+    def _replica_fault(resp: dict) -> Optional[str]:
+        """Classify an error response: replica-fatal (failover-able)
+        vs a client error passed through untouched. HangError and
+        poisoned refusals mean the REPLICA is gone for serving
+        purposes; draining/pending refusals mean try a sibling; any
+        other error (ValueError, IntegrityError, ...) is the CLIENT's
+        answer — a refusal the fleet must not mask."""
+        if resp.get("ok"):
+            return None
+        err = resp.get("error")
+        msg = str(resp.get("message", ""))
+        if err == "HangError":
+            return "hang"
+        if err in ("AdmissionError", "DrainingError"):
+            if "poisoned" in msg:
+                return "poisoned"
+            if "draining" in msg or err == "DrainingError":
+                return "draining"
+            return "busy"
+        return None
+
+    def _augment(self, resp: dict, rep: _Replica, state) -> dict:
+        resp = dict(resp)
+        resp["fleet"] = {
+            "replica": rep.index,
+            "generation": rep.generation,
+            "attempts": state["attempts"],
+            "failovers": state["attempts"] - 1,
+        }
+        return resp
+
+    def _observe(self, rid, op, key, outcome, state, elapsed_s,
+                 resp):
+        """Fleet-side accounting fan-out (live metrics, flight ring,
+        history line stamped with the serving replica). Never fails a
+        request."""
+        try:
+            rep = state.get("replica")
+            stamp = ({"index": rep.index,
+                      "generation": rep.generation,
+                      "port": rep.backend.port}
+                     if rep is not None else None)
+            with self._lock:
+                if outcome == "served":
+                    self.served += 1
+                elif outcome == "failed":
+                    self.failed += 1
+            self.live.record_request(
+                op, outcome,
+                latency_s=elapsed_s if outcome == "served" else None,
+                signature=key,
+                new_traces=int((resp or {}).get("new_traces") or 0))
+            self.recorder.record(
+                request_id=rid, op=op, signature=key,
+                outcome=outcome, elapsed_s=round(elapsed_s, 6),
+                matches=(resp or {}).get("matches"),
+                new_traces=(resp or {}).get("new_traces"),
+                failovers=state.get("failovers", 0),
+                replica=stamp,
+                error=(None if (resp or {}).get("ok")
+                       else (resp or {}).get("message")))
+            if self.history is not None and op not in ("ping",
+                                                       "stats",
+                                                       "metrics"):
+                self.history.append(tel_history.request_entry(
+                    request_id=rid, op=op, signature=key,
+                    outcome=outcome, wall_s=elapsed_s,
+                    new_traces=int((resp or {}).get("new_traces")
+                                   or 0),
+                    matches=(resp or {}).get("matches"),
+                    error=(None if (resp or {}).get("ok")
+                           else str((resp or {}).get("message"))),
+                    replica=stamp))
+        except Exception as exc:  # noqa: BLE001 - bookkeeping boundary
+            telemetry.event("fleet_observability_error",
+                            request_id=rid,
+                            error=f"{type(exc).__name__}: {exc}")
+
+    # -- operator surfaces --------------------------------------------
+
+    def dump_flight_recorder(self, reason: str) -> Optional[str]:
+        """Dump the router's request ring (the daemon's postmortem
+        contract, fleet-side): to ``flight_recorder_path``, else
+        ``history_dir``. Called at stop when a path is configured;
+        safe to call any time for a live snapshot."""
+        path = self.config.flight_recorder_path
+        if path is None:
+            if self.config.history_dir is None:
+                return None
+            path = os.path.join(self.config.history_dir,
+                                tel_live.FLIGHT_RECORDER_FILENAME)
+        try:
+            path = self.recorder.dump(path, reason)
+        except OSError as exc:
+            telemetry.event("fleet_flightrecorder_dump_failed",
+                            path=path,
+                            error=f"{type(exc).__name__}: {exc}")
+            return None
+        telemetry.event("fleet_flightrecorder_dumped", path=path,
+                        reason=reason)
+        return path
+
+    def stats(self) -> dict:
+        with self._lock:
+            reps = [{
+                "index": r.index,
+                "generation": r.generation,
+                "state": r.state,
+                "port": getattr(r.backend, "port", None),
+                "inflight": r.inflight,
+                "strikes": r.strikes,
+                "qps_60s": (r.last_stats or {}).get("qps_60s"),
+                "p95_s": ((r.last_stats or {}).get("latency")
+                          or {}).get("p95_s"),
+                "poisoned": (r.last_stats or {}).get("poisoned"),
+            } for r in self.replicas]
+            counts: dict = {}
+            for r in self.replicas:
+                counts[r.state] = counts.get(r.state, 0) + 1
+            return {
+                "role": "fleet",
+                "replicas": len(self.replicas),
+                "healthy": counts.get("healthy", 0),
+                "suspect": counts.get("suspect", 0),
+                "drained": counts.get("drained", 0),
+                "failed": counts.get("failed", 0),
+                "failovers_total": self.failovers_total,
+                "shed_total": self.shed_total,
+                "replaced_total": self.replaced_total,
+                "drains_total": self.drains_total,
+                "served": self.served,
+                "failed_requests": self.failed,
+                "rejected": self.rejected,
+                "qps_60s": round(self.live.qps(), 3),
+                "uptime_s": round(self.live.uptime_s(), 3),
+                "latency": self.live.overall_latency(),
+                "replica_detail": reps,
+            }
+
+    def prometheus_metrics(self) -> str:
+        st = self.stats()
+        return self.live.to_prometheus(gauges={
+            "fleet_replicas": st["replicas"],
+            "fleet_healthy": st["healthy"],
+            "fleet_suspect": st["suspect"],
+            "fleet_drained": st["drained"],
+            "fleet_failovers_total": st["failovers_total"],
+            "fleet_shed_total": st["shed_total"],
+            "fleet_replaced_total": st["replaced_total"],
+            "fleet_drains_total": st["drains_total"],
+        })
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.live.snapshot()
+        snap["stats"] = self.stats()
+        snap["flight_records"] = len(self.recorder)
+        snap["history_path"] = (self.history.path
+                                if self.history is not None else None)
+        return snap
+
+    def drain_replica(self, index: int,
+                      reason: str = "operator drain") -> dict:
+        """The operator op: drain (and replace) one replica by
+        index."""
+        with self._lock:
+            if not 0 <= index < len(self.replicas):
+                raise ValueError(f"no replica {index}")
+            rep = self.replicas[index]
+        self._drain(rep, reason)
+        return {"replica": index, "state": rep.state,
+                "reason": reason}
+
+    def wait_replaced(self, index: int, timeout_s: float = 60.0
+                      ) -> bool:
+        """Block until replica ``index`` is healthy at a HIGHER
+        generation than when it was last drained (test/smoke
+        helper)."""
+        rep = self.replicas[index]
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if rep.state == "healthy" \
+                        and rep.replaced_at is not None \
+                        and (rep.drained_at is None
+                             or rep.replaced_at >= rep.drained_at):
+                    return True
+            time.sleep(0.05)
+        return False
+
+
+class _AttemptFailed(RuntimeError):
+    """One failover-able dispatch attempt failed (connection death or
+    a replica-fatal response) — retried by the bounded
+    retry_with_backoff loop, never surfaced raw."""
+
+
+# -- the router TCP daemon ---------------------------------------------
+
+
+def _route(router: FleetRouter, req: dict) -> dict:
+    op = req.get("op")
+    if op == "ping":
+        return {"ok": True, "op": "ping", "role": "fleet"}
+    if op == "stats":
+        return {"ok": True, **router.stats()}
+    if op == "metrics":
+        if req.get("format") == "prometheus":
+            return {"ok": True, "op": "metrics",
+                    "format": "prometheus",
+                    "prometheus": router.prometheus_metrics()}
+        return {"ok": True, "op": "metrics",
+                "metrics": router.metrics_snapshot()}
+    if op == "drain":
+        if req.get("replica") is None:
+            # Refuse rather than proxy: routing a bare drain to an
+            # affinity-chosen replica would silently recycle one warm
+            # replica while telling the operator the service drained.
+            raise ValueError(
+                "fleet drain needs \"replica\": <index> (drain one "
+                "slot, which is then replaced); to stop the whole "
+                "fleet use {\"op\": \"shutdown\"}")
+        rec = router.drain_replica(
+            int(req["replica"]),
+            reason=str(req.get("reason", "operator drain")))
+        return {"ok": True, "op": "drain", **rec}
+    if op == "shutdown":
+        # FLEET-level shutdown: stop the router (replicas drained and
+        # reaped by the serving loop) — never routed to a replica,
+        # which would just kill one daemon and watch it be replaced.
+        router.shutdown_requested.set()
+        return {"ok": True, "op": "shutdown", "role": "fleet"}
+    return router.dispatch(req)
+
+
+def start_router_daemon(router: FleetRouter, host: str = "127.0.0.1",
+                        port: int = 0):
+    """Bind + serve the fleet wire on a background thread; returns
+    ``(server, port)``. Same line-JSON protocol as one daemon."""
+    import socketserver
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            for raw in self.rfile:
+                line = raw.decode("utf-8").strip()
+                if not line:
+                    continue
+                req = None
+                try:
+                    req = json.loads(line)
+                    resp = _route(router, req)
+                except Exception as exc:  # noqa: BLE001 - wire edge
+                    resp = {"ok": False,
+                            "error": type(exc).__name__,
+                            "message": str(exc)}
+                self.wfile.write(
+                    (json.dumps(resp) + "\n").encode("utf-8"))
+                self.wfile.flush()
+                if isinstance(req, dict) \
+                        and req.get("op") == "shutdown":
+                    return
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    server = Server((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever,
+                              daemon=True)
+    thread.start()
+    return server, server.server_address[1]
+
+
+# -- the CI smoke ------------------------------------------------------
+
+
+def run_fleet_smoke(args) -> dict:
+    """The ``fleet`` lane's acceptance protocol (docs/FLEET.md), end
+    to end through real subprocess replicas and the router TCP loop:
+
+    1. 2 replicas share one persist dir; a cold query Q compiles on
+       its affine replica, the warm repeat must land on the SAME
+       replica with zero new traces;
+    2. ONE SCRIPTED KILL (SIGKILL) of that replica; the immediate
+       repeat of Q must fail over to the sibling within the bounded
+       retry budget and answer with the SAME match count (graded
+       against the pandas oracle);
+    3. the killed replica must be drained and a replacement spawned
+       (healthy at generation 1) — observed, with the drain stamped
+       within one probe interval of the kill;
+    4. the post-replacement repeat of Q must dispatch on the
+       replacement with ZERO new traces (the shared persist dir is
+       the distribution tier);
+    5. a concurrent burst at inflight bound 1 must shed >= 1 request
+       with a structured AdmissionError (and never queue unboundedly);
+    6. fleet Prometheus gauges and the flight/history stamps are
+       emitted for ``analyze check``.
+
+    Returns the JSON record (kind ``fleet_smoke``) whose deterministic
+    counter signature the perfgate lane gates against
+    ``results/baselines/fleet_smoke.json``.
+    """
+    import tempfile
+
+    violations: list = []
+    workdir_owned = args.persist_dir is None
+    workdir = args.persist_dir or tempfile.mkdtemp(
+        prefix="djtpu_fleet_smoke_")
+    cfg = FleetConfig(
+        n_replicas=2,
+        replica_ranks=args.replica_ranks,
+        persist_dir=os.path.join(workdir, "programs"),
+        history_dir=(args.history_dir
+                     or os.path.join(workdir, "history")),
+        # The failover gate (failovers_total >= 1) needs the REQUEST
+        # path to discover the scripted kill: a sub-second prober
+        # could drain the victim first and serve the repeat on
+        # attempt 1, tripping the gate spuriously. Drain latency is
+        # gated from the kill either way (the strike path drains in
+        # milliseconds).
+        probe_interval_s=max(args.probe_interval_s, 5.0),
+        retry_budget=2,
+        max_inflight_per_replica=args.max_inflight,
+        flight_recorder_path=args.flight_recorder_path,
+        spawn_timeout_s=args.spawn_timeout_s,
+    )
+    router = FleetRouter(
+        process_fleet_factory(cfg, platform=args.platform or "cpu"),
+        cfg)
+    router.start()
+    server, port = start_router_daemon(router)
+    client = ServiceClient("127.0.0.1", port, retries=2)
+
+    q = {"op": "join", "build_nrows": 2048, "probe_nrows": 2048,
+         "seed": 17, "selectivity": 0.4, "rand_max": 1024,
+         "out_capacity_factor": 3.0}
+
+    def oracle_matches():
+        from distributed_join_tpu.service.server import (
+            _tables_from_spec,
+        )
+
+        build, probe = _tables_from_spec(q)
+        return len(build.to_pandas().merge(probe.to_pandas(),
+                                           on="key"))
+
+    try:
+        expected = oracle_matches()
+        cold = client.send(q)
+        if not cold.get("ok"):
+            raise RuntimeError(f"cold query failed: {cold}")
+        warm = client.send(q)
+        if not warm.get("ok"):
+            raise RuntimeError(f"warm query failed: {warm}")
+        if warm["fleet"]["replica"] != cold["fleet"]["replica"]:
+            violations.append(
+                "affinity broke: warm repeat routed to replica "
+                f"{warm['fleet']['replica']}, cold ran on "
+                f"{cold['fleet']['replica']}")
+        if warm["new_traces"] != 0:
+            violations.append(
+                f"warm repeat traced {warm['new_traces']} new "
+                "program(s)")
+        for name, resp in (("cold", cold), ("warm", warm)):
+            if resp["matches"] != expected:
+                violations.append(
+                    f"{name} matches {resp['matches']} != pandas "
+                    f"oracle {expected}")
+
+        # THE scripted kill: SIGKILL the affine replica mid-traffic.
+        victim = router.replicas[cold["fleet"]["replica"]]
+        victim_index = victim.index
+        t_kill = time.monotonic()
+        victim.backend.kill()
+        failover = client.send(q)
+        if not failover.get("ok"):
+            violations.append(
+                f"failover repeat was not served: {failover}")
+        else:
+            if failover["matches"] != expected:
+                violations.append(
+                    f"failover matches {failover['matches']} != "
+                    f"oracle {expected}")
+            if failover["fleet"]["replica"] == victim_index:
+                violations.append(
+                    "failover answered from the killed replica")
+            if failover["fleet"]["attempts"] > cfg.retry_budget + 1:
+                violations.append(
+                    f"failover took {failover['fleet']['attempts']} "
+                    f"attempts > budget {cfg.retry_budget + 1}")
+
+        # Drain observed within one probe interval (+ scheduling
+        # slack), replacement healthy at generation 1.
+        replaced = router.wait_replaced(victim_index,
+                                        timeout_s=cfg.spawn_timeout_s)
+        if not replaced:
+            violations.append(
+                f"killed replica {victim_index} was not replaced "
+                f"within {cfg.spawn_timeout_s}s")
+        drained_after_s = ((victim.drained_at or time.monotonic())
+                           - t_kill)
+        if victim.drained_at is None or drained_after_s > \
+                3 * cfg.probe_interval_s + 5.0:
+            violations.append(
+                f"kill -> drained took {drained_after_s:.2f}s "
+                f"(> probe interval {cfg.probe_interval_s}s + slack)")
+
+        # Post-replacement repeat: the replacement must serve the
+        # pre-fault signature WARM (zero traces via the shared
+        # persist dir). Route directly at the replacement to pin the
+        # assertion on it — only when a replacement is actually up
+        # (dialing the SIGKILLed backend's old port would crash the
+        # harness instead of reporting the violation above).
+        replay: dict = {}
+        if replaced:
+            try:
+                direct = ServiceClient(
+                    *router.replicas[victim_index].addr(),
+                    timeout_s=120.0)
+                try:
+                    replay = direct.send(
+                        {**q, "request_id": "smoke-replay"})
+                finally:
+                    direct.close()
+            except (OSError, ValueError) as exc:
+                violations.append(
+                    "replacement replica unreachable for the "
+                    f"replay: {type(exc).__name__}: {exc}")
+            if replay and not replay.get("ok"):
+                violations.append(
+                    f"replacement replica refused the replay: "
+                    f"{replay}")
+            elif replay:
+                if replay["matches"] != expected:
+                    violations.append(
+                        f"replacement matches {replay['matches']} "
+                        f"!= oracle {expected}")
+                if replay["new_traces"] != 0:
+                    violations.append(
+                        "replacement was not warm: "
+                        f"{replay['new_traces']} new trace(s) — the "
+                        "shared persist dir must hand it the "
+                        "compiled program")
+
+        # Synthetic overload: a concurrent burst at inflight bound 1
+        # must shed with structured errors, never queue unboundedly.
+        router.config.max_inflight_per_replica = 1
+        burst_n = 8
+        results = [None] * burst_n
+
+        def fire(i):
+            c = ServiceClient("127.0.0.1", port)
+            try:
+                results[i] = c.send(
+                    {**q, "request_id": f"burst-{i}"})
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(burst_n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+        shed = [r for r in results
+                if r is not None and r.get("shed")]
+        served = [r for r in results
+                  if r is not None and r.get("ok")]
+        if not shed:
+            violations.append(
+                f"burst of {burst_n} at inflight bound 1 shed "
+                "nothing — admission is queueing unboundedly")
+        for r in served:
+            if r["matches"] != expected:
+                violations.append(
+                    f"burst answer {r['matches']} != oracle "
+                    f"{expected}")
+        if len(shed) + len(served) != burst_n:
+            violations.append(
+                f"burst lost requests: {len(served)} served + "
+                f"{len(shed)} shed != {burst_n}")
+        router.config.max_inflight_per_replica = args.max_inflight
+
+        prom = router.prometheus_metrics()
+        for gauge in ("djtpu_fleet_replicas", "djtpu_fleet_healthy",
+                      "djtpu_fleet_drained",
+                      "djtpu_fleet_failovers_total",
+                      "djtpu_fleet_shed_total"):
+            if gauge not in prom:
+                violations.append(
+                    f"prometheus exposition missing {gauge}")
+
+        stats = router.stats()
+        if stats["replaced_total"] < 1:
+            violations.append("no replacement counted")
+        if stats["failovers_total"] < 1:
+            violations.append("no failover counted")
+        if stats["healthy"] != 2:
+            violations.append(
+                f"fleet did not return to 2 healthy replicas: "
+                f"{stats}")
+    finally:
+        client.close()
+        server.shutdown()
+        server.server_close()
+        router.stop()
+
+    record = {
+        "kind": "fleet_smoke",
+        "benchmark": "fleet_smoke",
+        "n_ranks": cfg.replica_ranks,
+        "replicas": cfg.n_replicas,
+        "matches_expected": expected,
+        "killed_replica": victim_index,
+        "drained_after_s": round(drained_after_s, 3),
+        "failover_attempts": (failover.get("fleet", {})
+                              .get("attempts")),
+        "burst_served": len(served),
+        "burst_shed": len(shed),
+        "stats": stats,
+        "history_path": (router.history.path
+                         if router.history is not None else None),
+        "violations": violations,
+        # The deterministic gate body (integer counters only; shed/
+        # failover TIMINGS and counts beyond the gates above are
+        # load-dependent and stay outside the signature).
+        "counter_signature": {
+            "signature_version": 1,
+            "n_ranks": cfg.replica_ranks,
+            "counters": {
+                "replicas": cfg.n_replicas,
+                "matches_cold": cold["matches"],
+                "matches_warm": warm["matches"],
+                "matches_failover": failover.get("matches", -1),
+                "matches_replacement": replay.get("matches", -1),
+                "warm_new_traces": warm["new_traces"],
+                "replacement_new_traces": replay.get("new_traces",
+                                                     -1),
+                "requests_lost": burst_n - len(served) - len(shed),
+            },
+        },
+    }
+    if violations:
+        # Keep the workdir (program blobs, history, flight dumps) —
+        # it IS the postmortem of a failed smoke.
+        record["workdir"] = workdir
+        raise FleetSmokeError(
+            "fleet smoke violations: " + "; ".join(violations),
+            record)
+    if workdir_owned:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return record
+
+
+class FleetSmokeError(RuntimeError):
+    def __init__(self, msg, record):
+        super().__init__(msg)
+        self.record = record
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="router TCP port (0 = ephemeral; printed on "
+                        "the 'listening' line)")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--replica-ranks", type=int, default=2,
+                   help="mesh size of EACH replica (disjoint hosts "
+                        "on hardware; per-process virtual CPU meshes "
+                        "in tests)")
+    p.add_argument("--platform", default=None,
+                   help="forwarded to each replica (--platform cpu "
+                        "for the CPU-mesh fleet)")
+    p.add_argument("--persist-dir", default=None, metavar="DIR",
+                   help="SHARED compiled-program dir: the fleet's "
+                        "distribution tier (replacements restart "
+                        "warm)")
+    p.add_argument("--history-dir", default=None, metavar="DIR",
+                   help="fleet-level per-request history store "
+                        "(entries stamped with the serving replica)")
+    p.add_argument("--probe-interval-s", type=float, default=1.0)
+    p.add_argument("--probe-timeout-s", type=float, default=5.0)
+    p.add_argument("--spawn-timeout-s", type=float, default=180.0)
+    p.add_argument("--suspect-strikes", type=int, default=2)
+    p.add_argument("--retry-budget", type=int, default=2,
+                   help="bounded failover attempts per request "
+                        "beyond the first")
+    p.add_argument("--request-deadline-s", type=float, default=300.0)
+    p.add_argument("--max-inflight", type=int, default=4,
+                   help="per-replica inflight admission bound "
+                        "(beyond it the router sheds with a "
+                        "structured AdmissionError)")
+    p.add_argument("--shed-p95-s", type=float, default=None)
+    p.add_argument("--shed-qps", type=float, default=None)
+    p.add_argument("--no-respawn", action="store_true",
+                   help="drain faulted replicas but do not replace "
+                        "them (debugging)")
+    p.add_argument("--replica-arg", action="append", default=[],
+                   metavar="ARG",
+                   help="extra argv token forwarded to every "
+                        "replica (repeatable)")
+    p.add_argument("--flight-records", type=int, default=256)
+    p.add_argument("--flight-recorder-path", default=None)
+    p.add_argument("--smoke", action="store_true",
+                   help="run the CI acceptance protocol (2-replica "
+                        "CPU-mesh fleet, scripted replica kill, "
+                        "oracle/drain/replace/shed gates) instead of "
+                        "serving; JSON record on stdout")
+    p.add_argument("--json-output", default=None)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    from distributed_join_tpu.benchmarks import report
+
+    args = parse_args(argv)
+    if args.smoke:
+        try:
+            record = run_fleet_smoke(args)
+        except FleetSmokeError as exc:
+            report("fleet smoke FAILED", exc.record,
+                   args.json_output)
+            print(str(exc), file=sys.stderr)
+            return 1
+        report(
+            f"fleet smoke: {record['replicas']} replicas, kill -> "
+            f"drained in {record['drained_after_s']}s, failover in "
+            f"{record['failover_attempts']} attempt(s), replacement "
+            "warm with 0 traces, "
+            f"{record['burst_shed']} shed under synthetic overload",
+            record, args.json_output)
+        return 0
+
+    cfg = FleetConfig(
+        n_replicas=args.replicas,
+        replica_ranks=args.replica_ranks,
+        persist_dir=args.persist_dir,
+        history_dir=args.history_dir,
+        probe_interval_s=args.probe_interval_s,
+        probe_timeout_s=args.probe_timeout_s,
+        spawn_timeout_s=args.spawn_timeout_s,
+        suspect_strikes=args.suspect_strikes,
+        retry_budget=args.retry_budget,
+        request_deadline_s=args.request_deadline_s,
+        max_inflight_per_replica=args.max_inflight,
+        shed_p95_s=args.shed_p95_s,
+        shed_qps=args.shed_qps,
+        respawn=not args.no_respawn,
+        flight_records=args.flight_records,
+        flight_recorder_path=args.flight_recorder_path,
+    )
+    router = FleetRouter(
+        process_fleet_factory(cfg, platform=args.platform or "cpu",
+                              extra_args=args.replica_arg),
+        cfg)
+    router.start()
+    server, port = start_router_daemon(router, args.host, args.port)
+    print(f"join-fleet listening on {args.host}:{port} "
+          f"({cfg.n_replicas} replicas x {cfg.replica_ranks} ranks)",
+          flush=True)
+    try:
+        import signal
+
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        while not stop.wait(0.5):
+            if router.shutdown_requested.is_set():
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        server.server_close()
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,6 +78,14 @@ class AdmissionError(RuntimeError):
     refusal instead of an unbounded queue hiding an overloaded mesh."""
 
 
+class DrainingError(AdmissionError):
+    """The service is draining (the ``drain`` wire op or SIGTERM): new
+    admissions are refused while in-flight requests finish under their
+    watchdog deadlines. Structurally an :class:`AdmissionError` so
+    retry-with-backoff clients treat it as 'try another replica' — the
+    fleet router's replace path depends on this clean handoff."""
+
+
 def _backend_platform() -> Optional[str]:
     """The serving backend's platform name for history entries (the
     cost-model calibration seam trusts only real-hardware walls). The
@@ -239,6 +247,11 @@ class JoinService:
         # stats still answer) until an operator restarts the server —
         # the serving analog of the drivers' hard exit after HangError.
         self.poisoned: Optional[str] = None
+        # Set (to the drain reason) by drain()/SIGTERM: new admissions
+        # refuse with DrainingError while in-flight requests finish —
+        # the clean half of the fleet's drain-and-replace handoff
+        # (docs/FLEET.md; a poisoned replica is the unclean half).
+        self.draining: Optional[str] = None
 
     # -- admission -----------------------------------------------------
 
@@ -274,6 +287,19 @@ class JoinService:
                 raise AdmissionError(
                     "mesh poisoned by a hung request "
                     f"({self.poisoned}); restart the server"
+                )
+            if self.draining is not None:
+                self.rejected += 1
+                telemetry.event("request_rejected", reason="draining",
+                                request_id=rid)
+                self.live.record_request(op, "rejected")
+                self.recorder.record(request_id=rid, op=op,
+                                     signature=None,
+                                     outcome="rejected",
+                                     reason="draining")
+                raise DrainingError(
+                    f"service draining ({self.draining}); "
+                    "retry on another replica"
                 )
             if self._pending >= self.config.max_pending:
                 self.rejected += 1
@@ -936,6 +962,68 @@ class JoinService:
                         reason=reason)
         return path
 
+    # -- lifecycle (drain / quiesce) ----------------------------------
+
+    def quiesce(self, timeout_s: float = 30.0,
+                settle_admissions: bool = False) -> bool:
+        """Wait (bounded) until no request holds the exec lock —
+        i.e. nothing is dispatching on the mesh right now. The
+        ``shutdown`` wire op calls this so its ``{"ok": true}`` reply
+        can no longer race a still-dispatching join on another
+        connection; False = a request was still running (or the mesh
+        is poisoned-busy) when the bound expired.
+
+        ``settle_admissions`` additionally waits for the admission
+        count to reach zero FIRST — a join already admitted and
+        parked on the exec lock would otherwise dispatch right after
+        a momentarily-free lock was observed (callers close the
+        admission window via ``draining`` before asking for this)."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        if settle_admissions:
+            while True:
+                with self._admit_lock:
+                    pending = self._pending
+                if pending == 0:
+                    break
+                if time.monotonic() >= deadline:
+                    return False
+                time.sleep(0.05)
+        acquired = self._exec_lock.acquire(
+            timeout=max(deadline - time.monotonic(), 0.0))
+        if acquired:
+            self._exec_lock.release()
+        return acquired
+
+    def drain(self, reason: str = "drain requested",
+              settle_timeout_s: float = 60.0) -> dict:
+        """Graceful drain (the ``drain`` wire op and the daemon's
+        SIGTERM handler): refuse new admissions with
+        :class:`DrainingError`, let in-flight requests finish under
+        their watchdog deadlines (bounded wait), then flush the
+        history store and dump the flight recorder so nothing the
+        process learned is lost at exit. Idempotent; returns the
+        settle record the wire echoes."""
+        with self._admit_lock:
+            first = self.draining is None
+            if first:
+                self.draining = reason
+        if first:
+            telemetry.event("service_draining", reason=reason)
+        settled = self.quiesce(timeout_s=settle_timeout_s,
+                               settle_admissions=True)
+        if self.history is not None:
+            # Line-buffered writes already flushed per entry; closing
+            # releases the handle (a straggler append reopens it).
+            self.history.close()
+        path = self.dump_flight_recorder(f"drained: {reason}")
+        with self._admit_lock:
+            pending = self._pending
+        telemetry.event("service_drained", reason=reason,
+                        settled=settled, pending=pending)
+        return {"draining": True, "drained": settled,
+                "pending": pending, "reason": reason,
+                "flightrecorder": path}
+
     def stats(self) -> dict:
         with self._admit_lock:
             pending = self._pending
@@ -952,6 +1040,7 @@ class JoinService:
             "latency": self.live.overall_latency(),
             "latency_by_op": self.live.latency_by_op(),
             "poisoned": self.poisoned,
+            "draining": self.draining,
             "cache": self.cache.stats(),
             "resident": self.resident.stats(),
             "aggregate": {
@@ -983,6 +1072,7 @@ class JoinService:
             "pending": st["pending"],
             "pending_high_water": st["pending_hwm"],
             "poisoned": int(bool(st["poisoned"])),
+            "draining": int(bool(st["draining"])),
             "served_requests": st["served"],
             "failed_requests": st["failed"],
             "rejected_requests": st["rejected"],
@@ -1157,7 +1247,8 @@ class _Handler(socketserver.StreamRequestHandler):
             self.wfile.write(
                 (json.dumps(resp) + "\n").encode("utf-8"))
             self.wfile.flush()
-            if isinstance(req, dict) and req.get("op") == "shutdown" \
+            if isinstance(req, dict) \
+                    and req.get("op") in ("shutdown", "drain") \
                     and resp.get("ok"):
                 return
 
@@ -1176,11 +1267,36 @@ class _Handler(socketserver.StreamRequestHandler):
             return {"ok": True, "op": "metrics",
                     "metrics": service.metrics_snapshot()}
         if op == "shutdown":
-            # shutdown() must not run on the handler thread (it joins
-            # the serve_forever loop, which is waiting on us).
+            # Close the admission window FIRST (a join admitted after
+            # the quiesce would still race the exit), then wait
+            # (bounded) for any join still dispatching on OTHER
+            # connections before acknowledging: the old reply-first
+            # behavior let a smoke-driven shutdown race a live SPMD
+            # dispatch. shutdown() itself must not run on the handler
+            # thread (it joins the serve_forever loop, which is
+            # waiting on us).
+            with service._admit_lock:
+                if service.draining is None:
+                    service.draining = "shutdown"
+            quiesced = service.quiesce(
+                timeout_s=float(req.get("quiesce_timeout_s", 30.0)),
+                settle_admissions=True)
             threading.Thread(target=self.server.shutdown,
                              daemon=True).start()
-            return {"ok": True, "op": "shutdown"}
+            return {"ok": True, "op": "shutdown",
+                    "quiesced": quiesced}
+        if op == "drain":
+            # Graceful handoff (docs/FLEET.md): refuse new admissions
+            # with DrainingError, settle in-flight requests under
+            # their watchdog deadlines, flush history + flight
+            # recorder, then exit 0 exactly like shutdown.
+            rec = service.drain(
+                reason=str(req.get("reason", "drain wire op")),
+                settle_timeout_s=float(
+                    req.get("settle_timeout_s", 60.0)))
+            threading.Thread(target=self.server.shutdown,
+                             daemon=True).start()
+            return {"ok": True, "op": "drain", **rec}
         if op == "explain":
             # Admission-free dry run: the spec's shapes become
             # abstract tables (the generator schema — no data, no
@@ -1306,7 +1422,7 @@ class _Handler(socketserver.StreamRequestHandler):
             }
         raise ValueError(f"unknown op {op!r} (ops: ping, stats, "
                          "metrics, explain, join, batch, register, "
-                         "append, tables, drop, shutdown)")
+                         "append, tables, drop, drain, shutdown)")
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -1330,38 +1446,124 @@ def start_daemon(service: JoinService, host: str = "127.0.0.1",
 
 class ServiceClient:
     """Line-protocol client over one persistent connection (the smoke
-    protocol and tests; also a template for real callers)."""
+    protocol, the ``--watch`` console, the fleet router's replica
+    legs, and tests; also a template for real callers).
 
-    def __init__(self, host: str, port: int, timeout_s: float = 600.0):
-        self._sock = socket.create_connection((host, port), timeout_s)
+    ``retries`` arms bounded reconnect with jittered exponential
+    backoff: a torn connection, a half-written response line, or a
+    restarting daemon is reconnected and the payload RESENT — but
+    only for the IDEMPOTENT ops (:data:`RESENDABLE_OPS`: reads plus
+    join/batch, whose specs recompute the same answer). A mutating
+    op (``append``, ``register``, ...) whose connection tears after
+    the write may already have been APPLIED, so it fails loudly
+    instead of double-applying. The terminal
+    :class:`ConnectionError` surfaces the attempt count; the default
+    ``retries=0`` keeps the historical fail-on-first-tear
+    behavior."""
+
+    # Ops a retry-armed client may safely resend after a torn
+    # connection: reads, plus the query ops whose wire carries specs
+    # (same spec -> same deterministic answer). Everything else
+    # mutates server state (append would double-apply its delta).
+    RESENDABLE_OPS = frozenset(
+        ("ping", "stats", "metrics", "explain", "tables",
+         "join", "batch"))
+
+    def __init__(self, host: str, port: int, timeout_s: float = 600.0,
+                 *, retries: int = 0, backoff_s: float = 0.2):
+        self._addr = (host, port)
+        self._timeout_s = timeout_s
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self._sock = None
+        self._file = None
+        self._with_retries(self._connect, "connect to")
+
+    def _connect(self):
+        self.close()
+        self._sock = socket.create_connection(self._addr,
+                                              self._timeout_s)
         self._file = self._sock.makefile("rw", encoding="utf-8",
                                          newline="\n")
 
+    def _with_retries(self, fn, what: str):
+        import random
+
+        attempts = 0
+        delay = self.backoff_s
+        while True:
+            attempts += 1
+            try:
+                return fn()
+            except (OSError, ValueError) as exc:
+                # OSError covers refused/reset/timeout; ValueError is
+                # json.loads on a torn half-written response line.
+                # _no_resend marks the mutating-op tear guard —
+                # terminal by design, never retried.
+                self.close()
+                if attempts > self.retries \
+                        or getattr(exc, "_no_resend", False):
+                    raise ConnectionError(
+                        f"cannot {what} {self._addr[0]}:"
+                        f"{self._addr[1]} after {attempts} attempt(s)"
+                        f": {type(exc).__name__}: {exc}") from exc
+                time.sleep(delay * random.uniform(0.5, 1.5))
+                delay *= 2
+
     def send(self, payload: dict) -> dict:
-        self._file.write(json.dumps(payload) + "\n")
-        self._file.flush()
-        line = self._file.readline()
-        if not line:
-            raise ConnectionError("service closed the connection")
-        return json.loads(line)
+        resendable = payload.get("op") in self.RESENDABLE_OPS
+        wrote = {"flag": False}
+
+        def once():
+            if self._file is None:
+                self._connect()
+            if wrote["flag"] and not resendable:
+                # The earlier attempt's write may have been applied
+                # server-side; a mutating op must not go out twice.
+                err = ConnectionError(
+                    f"connection torn after sending mutating op "
+                    f"{payload.get('op')!r}; not resending (the op "
+                    "may already have been applied)")
+                err._no_resend = True
+                raise err
+            wrote["flag"] = True
+            self._file.write(json.dumps(payload) + "\n")
+            self._file.flush()
+            line = self._file.readline()
+            if not line:
+                raise ConnectionError(
+                    "service closed the connection")
+            return json.loads(line)
+
+        return self._with_retries(once, "reach")
 
     def close(self) -> None:
-        self._file.close()
-        self._sock.close()
+        for h in (self._file, self._sock):
+            try:
+                if h is not None:
+                    h.close()
+            except OSError:  # pragma: no cover - teardown boundary
+                pass
+        self._file = self._sock = None
 
 
 # -- the operator watch console ----------------------------------------
 
 
 def watch(host: str, port: int, interval_s: float = 2.0,
-          count: int = 0, out=None) -> int:
+          count: int = 0, out=None, retries: int = 3) -> int:
     """Poll a RUNNING daemon's ``metrics`` op and render one console
     line per poll — the operator's ``top`` for the join service. Read
     only: no mesh, no bootstrap, works from any machine that can reach
-    the port. ``count=0`` polls until interrupted."""
+    the port. ``count=0`` polls until interrupted. ``retries`` rides
+    the client's jittered-backoff reconnect, so a replica restarting
+    under the console (the fleet's replace path) resumes instead of
+    tearing the watch down; only a daemon gone past the budget yields
+    the one-line error (with the attempt count) and rc 1."""
     out = out or sys.stdout
     try:
-        client = ServiceClient(host, port, timeout_s=30.0)
+        client = ServiceClient(host, port, timeout_s=30.0,
+                               retries=retries)
     except OSError as exc:
         # An operator console answers with one line, not a traceback.
         print(f"cannot reach daemon at {host}:{port}: {exc}",
@@ -1526,6 +1728,14 @@ def parse_args(argv=None):
                         "but do not FAIL on them (the perfgate lane "
                         "gates counters only; the service lane keeps "
                         "the strict timing gate)")
+    p.add_argument("--fault-plan", default=None, metavar="JSON",
+                   help="wrap the communicator in a SCRIPTED "
+                        "FaultPlan (parallel/faults.py fields as one "
+                        "JSON object, e.g. '{\"dispatch_delay_s\": "
+                        "3.0}') — the fleet chaos harness's seam for "
+                        "arming one replica with a deterministic "
+                        "outage; --chaos-seed draws a random plan "
+                        "instead")
     p.add_argument("--json-output", default=None)
     add_platform_arg(p)
     add_telemetry_args(p)
@@ -1547,6 +1757,14 @@ def _service_from_args(args) -> JoinService:
         make_communicator(args.communicator, n_ranks=args.n_ranks,
                           n_slices=getattr(args, "slices", None)),
         args)
+    if getattr(args, "fault_plan", None):
+        from distributed_join_tpu.parallel.faults import (
+            FaultInjectingCommunicator,
+            plan_from_record,
+        )
+
+        comm = FaultInjectingCommunicator(
+            comm, plan_from_record(json.loads(args.fault_plan)))
     cfg = ServiceConfig(
         auto_retry=args.auto_retry,
         verify_integrity=args.verify_integrity,
@@ -1580,6 +1798,27 @@ def run(args) -> dict:
     else:
         server = _Server((args.host, args.port), service)
         port = server.server_address[1]
+
+        def _drain_and_stop():
+            service.drain(reason="SIGTERM")
+            server.shutdown()
+
+        def _on_sigterm(signum, frame):  # noqa: ARG001 - signal API
+            # Graceful drain off the signal frame: refuse new
+            # admissions, settle in-flight, flush artifacts, exit 0
+            # (the fleet's replace path SIGTERMs before SIGKILL).
+            threading.Thread(target=_drain_and_stop,
+                             daemon=True).start()
+
+        try:
+            import signal
+
+            # Installed BEFORE the listening line: a supervisor that
+            # SIGTERMs the instant the port is announced must hit
+            # the graceful path, not the default disposition.
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:  # pragma: no cover - non-main thread
+            pass
         print(f"join-service listening on {args.host}:{port}",
               flush=True)
         try:
@@ -1818,7 +2057,7 @@ def run_smoke(service: JoinService, args) -> dict:
     Raises RuntimeError on any violation (run_guarded turns it into a
     failure record with rc != 0)."""
     server, port = start_daemon(service, "127.0.0.1", 0)
-    client = ServiceClient("127.0.0.1", port)
+    client = ServiceClient("127.0.0.1", port, retries=2)
     violations = []
 
     def send_ok(payload, what):

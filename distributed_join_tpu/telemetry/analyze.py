@@ -960,6 +960,17 @@ def check_file(path: str) -> list:
                             problems.append(
                                 f"line {i}: aggregate stamp missing "
                                 "group_keys/aggs keys")
+                    # Fleet stamp (service/fleet.py): router-side
+                    # entries carry the serving replica's index and
+                    # generation (None = single-daemon traffic).
+                    rep_stamp = ev.get("replica")
+                    if rep_stamp is not None:
+                        if not isinstance(rep_stamp, dict) or not \
+                                {"index", "generation"} <= \
+                                set(rep_stamp):
+                            problems.append(
+                                f"line {i}: replica stamp missing "
+                                "index/generation keys")
                 elif kind not in ("event", "span"):
                     problems.append(f"line {i}: bad kind {kind!r}")
             # A torn FINAL line is the advertised killed-run artifact
@@ -1080,6 +1091,36 @@ def check_file(path: str) -> list:
                                 "'counters'")
         elif "counter_signature" in doc:
             problems.append("counter_signature is not an object")
+        return problems
+    elif name.startswith("fleet_smoke") or \
+            doc.get("kind") == "fleet_smoke":
+        # The fleet router's CI smoke record (service/fleet.py
+        # run_fleet_smoke): scripted-kill acceptance protocol whose
+        # deterministic counter signature the perfgate lane gates
+        # against results/baselines/fleet_smoke.json.
+        for key in ("kind", "n_ranks", "replicas",
+                    "counter_signature", "stats"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        sig = doc.get("counter_signature")
+        if isinstance(sig, dict):
+            if not isinstance(sig.get("counters"), dict):
+                problems.append("counter_signature missing "
+                                "'counters'")
+        elif "counter_signature" in doc:
+            problems.append("counter_signature is not an object")
+        return problems
+    elif name.startswith("fleet_soak") or \
+            doc.get("kind") == "fleet_soak":
+        # The fleet chaos soak summary (parallel/chaos.py --fleet):
+        # one replica killed/hung/corrupted mid-soak, every
+        # non-refused answer pandas-oracle-graded.
+        for key in ("kind", "harness_seed", "fault", "trials",
+                    "verdicts", "failures", "drain_replace"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        if not isinstance(doc.get("verdicts"), dict):
+            problems.append("verdicts is not an object")
         return problems
     elif name == "flightrecorder.json" or \
             doc.get("kind") == "flightrecorder":

@@ -335,6 +335,7 @@ def request_entry(*, request_id: str, op: str, signature: str,
                   stage_profile: Optional[dict] = None,
                   resident: Optional[dict] = None,
                   aggregate: Optional[dict] = None,
+                  replica: Optional[dict] = None,
                   error: Optional[str] = None) -> dict:
     """One serving request's history line (the JoinService write
     path). ``metrics`` is the request's ``Metrics.to_dict()`` block
@@ -376,6 +377,11 @@ def request_entry(*, request_id: str, op: str, signature: str,
         # (group_keys/aggs/...) plus the groups emitted; None = a
         # materializing join. `analyze check` validates the shape.
         "aggregate": aggregate,
+        # Fleet stamp (service/fleet.py): requests routed through the
+        # fleet router carry the serving replica's index/generation
+        # (None = a single-daemon request; `analyze check` validates
+        # the shape).
+        "replica": replica,
         "error": error,
     }
 

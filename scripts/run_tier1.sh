@@ -89,6 +89,25 @@
 #                                   # counter signature gated vs
 #                                   # results/baselines/
 #                                   # sortpath_smoke.json
+#   scripts/run_tier1.sh fleet      # fault-tolerant serving fleet:
+#                                   # -m fleet suite (affinity, state
+#                                   # machine, kill/hang/corrupt
+#                                   # matrix over disjoint-device
+#                                   # in-process replicas, shedding,
+#                                   # drain semantics) + the
+#                                   # deterministic 2-replica
+#                                   # subprocess fleet smoke with one
+#                                   # SCRIPTED replica kill (oracle
+#                                   # equality + drain/replace
+#                                   # observed + bounded retry count +
+#                                   # zero-trace warm replacement,
+#                                   # counter signature gated vs
+#                                   # results/baselines/
+#                                   # fleet_smoke.json) + the chaos
+#                                   # --fleet 20-trial soak (one
+#                                   # replica faulted mid-soak, every
+#                                   # non-refused answer pandas-
+#                                   # oracle-graded)
 #   scripts/run_tier1.sh tuner      # autotuner: -m tuner suite + a
 #                                   # cold/warm driver A/B (warm run
 #                                   # must start at the escalated
@@ -276,6 +295,20 @@ json.dump(ab, open(f"{sys.argv[1]}/sortpath_smoke.json", "w"),
 PY
     python -m distributed_join_tpu.telemetry.analyze compare \
       "$tmp/sortpath_smoke.json" --baseline sortpath_smoke
+    # The fleet smoke's counter signature is part of the same gate
+    # (docs/FLEET.md): the scripted-kill protocol's deterministic
+    # match + trace counters — a changed router, affinity hash,
+    # failover loop, or persist-dir distribution tier moves them.
+    # The drain-latency / shed gates live in the fleet lane.
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.service.fleet --smoke \
+      --platform cpu --replica-ranks 2 \
+      --json-output "$tmp/fleet_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/fleet_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/fleet_smoke.json" --baseline fleet_smoke
     exit $?
     ;;
   agg)
@@ -553,6 +586,52 @@ PY
       --hier-slice 6 --seed 42 \
       --repro-out /tmp/djtpu_hier_chaos_repro
     ;;
+  fleet)
+    # Fault-tolerant serving fleet (docs/FLEET.md). 1. the -m fleet
+    # unit suite (signature-affinity routing == the replica-side
+    # digest, replica state machine over fake wire replicas, the
+    # kill/hang/corrupt failure matrix over disjoint-device
+    # in-process replicas, structured shedding, duplicate-id fence);
+    # 2. the subprocess fleet smoke: 2 tpu-join-service replicas
+    # sharing one persist dir behind the router, one SCRIPTED SIGKILL
+    # mid-traffic — failover answers pandas-oracle-exact within the
+    # bounded retry budget, the killed replica is drained within one
+    # probe interval and replaced, the replacement serves the repeat
+    # signature with ZERO new traces, and a synthetic-overload burst
+    # sheds with structured errors; its counter signature is gated
+    # against results/baselines/fleet_smoke.json; the router-side
+    # history store (replica-stamped) is schema-checked; 3. the
+    # chaos --fleet soak: >= 20 trials, one replica faulted mid-soak.
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m fleet --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    tmp="$(mktemp -d /tmp/djtpu_fleet.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.service.fleet --smoke \
+      --platform cpu --replica-ranks 2 \
+      --history-dir "$tmp/history" \
+      --json-output "$tmp/fleet_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/fleet_smoke.json" "$tmp/history/history.jsonl"
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/fleet_smoke.json" --baseline fleet_smoke
+    # The acceptance soak (>= 20 trials, fixed seed): one replica
+    # killed/hung/corrupted mid-soak, every non-refused answer
+    # graded against the pandas oracle, drain+replace and the
+    # zero-trace warm replacement gated inside the harness.
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.parallel.chaos \
+      --fleet 20 --seed 42 \
+      --json-output "$tmp/fleet_soak.json" \
+      --repro-out /tmp/djtpu_fleet_repro
+    # no exec: the EXIT trap must still clean $tmp
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/fleet_soak.json"
+    ;;
   tuner)
     # History-driven autotuner (docs/OBSERVABILITY.md "Autotuner").
     # 1. the -m tuner unit suite (zero-trace warm locks via
@@ -694,7 +773,7 @@ PY
     exit $?
     ;;
   *)
-    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|stageprof|tuner|resident|hier|agg]" >&2
+    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|stageprof|tuner|resident|hier|agg|sortpath|fleet]" >&2
     exit 2
     ;;
 esac

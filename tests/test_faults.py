@@ -704,3 +704,55 @@ def test_manifest_atomic_roundtrip(tmp_path):
     m2 = JoinManifest(path, {"n_batches": 2})
     assert m2.completed == {0: {"total": 123, "overflow": False}}
     assert m2.failures[0]["batch"] == 1
+
+
+def test_plan_from_record_roundtrip_and_unknown_key_refusal():
+    """The --fault-plan wire/CLI seam: a FaultPlan round-trips through
+    its JSON record, and an unknown field refuses loudly (a typo'd
+    scripted outage must not silently arm nothing)."""
+    import dataclasses as dc
+
+    from distributed_join_tpu.parallel.faults import (
+        FaultPlan,
+        plan_from_record,
+    )
+
+    plan = FaultPlan(seed=7, dispatch_delay_s=1.5,
+                     delay_after_dispatches=3,
+                     corrupt_mode="bit_flip", corrupt_collectives=2)
+    assert plan_from_record(dc.asdict(plan)) == plan
+    with pytest.raises(ValueError, match="unknown FaultPlan field"):
+        plan_from_record({"dispatch_delay": 1.0})
+
+
+def test_dispatch_delay_defers_until_after_n_dispatches():
+    """``delay_after_dispatches``: the first N dispatches run at full
+    speed, every later one sleeps — the replica that serves healthily
+    and then wedges mid-soak (the fleet chaos hang scenario)."""
+    import time as _t
+
+    from distributed_join_tpu.parallel.faults import (
+        FaultInjectingCommunicator,
+        FaultPlan,
+    )
+
+    class StubComm:
+        n_ranks = 2
+        name = "stub"
+
+        def spmd(self, fn, *, sharded_out=None):
+            return fn
+
+    comm = FaultInjectingCommunicator(
+        StubComm(), FaultPlan(dispatch_delay_s=0.25,
+                              delay_after_dispatches=2))
+    prog = comm.spmd(lambda: 1)
+    for _ in range(2):
+        t0 = _t.perf_counter()
+        assert prog() == 1
+        assert _t.perf_counter() - t0 < 0.2, \
+            "dispatches within the grace budget must not sleep"
+    t0 = _t.perf_counter()
+    assert prog() == 1
+    assert _t.perf_counter() - t0 >= 0.25, \
+        "the dispatch after the budget must carry the delay"

@@ -778,3 +778,263 @@ def test_malformed_batch_is_counted_and_flight_recorded():
     (rec,) = service.recorder.snapshot()["records"]
     assert rec["outcome"] == "failed" and rec["op"] == "batch"
     assert rec["reason"] == "batch_combine" and rec["request_id"]
+
+
+# -- graceful drain / shutdown quiesce / client reconnect (ISSUE 15) --
+
+
+def test_draining_refuses_with_structured_error(tmp_path):
+    """drain(): new admissions refuse with DrainingError (an
+    AdmissionError subclass, so backoff clients treat it as 'try a
+    sibling'), in-flight settles, and the flight recorder is flushed
+    to disk — the clean half of the fleet's replace handoff."""
+    from distributed_join_tpu.service.server import (
+        DrainingError,
+        AdmissionError,
+        JoinService,
+        ServiceConfig,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    service = JoinService(comm, ServiceConfig(
+        flight_recorder_path=str(tmp_path / "fr.json")))
+    b, p = _request(0)
+    service.join(b, p, out_capacity_factor=4.0)
+    rec = service.drain(reason="test drain", settle_timeout_s=5.0)
+    assert rec["drained"] and rec["pending"] == 0
+    assert rec["flightrecorder"] == str(tmp_path / "fr.json")
+    assert (tmp_path / "fr.json").exists()
+    assert issubclass(DrainingError, AdmissionError)
+    with pytest.raises(DrainingError, match="draining"):
+        service.join(b, p, out_capacity_factor=4.0)
+    assert service.rejected == 1
+    assert service.stats()["draining"] == "test drain"
+    recs = service.recorder.snapshot()["records"]
+    assert any(r.get("reason") == "draining" for r in recs)
+
+
+def test_drain_wire_op_settles_inflight_then_exits(tmp_path):
+    """The drain wire op: an in-flight (fault-delayed) join on another
+    connection completes before the drain acknowledges, then the
+    daemon stops serving (the SIGTERM handler drives this same
+    path)."""
+    import threading
+    import time
+
+    from distributed_join_tpu.parallel.faults import (
+        FaultInjectingCommunicator,
+        FaultPlan,
+    )
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceClient,
+        ServiceConfig,
+        start_daemon,
+    )
+
+    comm = FaultInjectingCommunicator(
+        dj.make_communicator("tpu", n_ranks=8),
+        FaultPlan(dispatch_delay_s=1.0, delay_after_dispatches=1))
+    service = JoinService(comm, ServiceConfig(
+        flight_recorder_path=str(tmp_path / "fr.json")))
+    server, port = start_daemon(service)
+    c1 = ServiceClient("127.0.0.1", port)
+    c2 = ServiceClient("127.0.0.1", port)
+    q = {"op": "join", "build_nrows": 256, "probe_nrows": 256,
+         "seed": 7, "selectivity": 0.5, "out_capacity_factor": 4.0}
+    done = {}
+    try:
+        warm = c1.send(q)          # dispatch 1: no delay, compiles
+        assert warm["ok"]
+
+        def slow_join():
+            done["resp"] = c1.send(q)     # dispatch 2: sleeps 1s
+            done["t"] = time.monotonic()
+
+        t = threading.Thread(target=slow_join)
+        t.start()
+        time.sleep(0.3)           # in flight on the exec lock
+        resp = c2.send({"op": "drain", "reason": "test",
+                        "settle_timeout_s": 10.0})
+        t_drained = time.monotonic()
+        t.join(timeout=30.0)
+        assert resp["ok"] and resp["drained"]
+        assert resp["pending"] == 0
+        assert done["resp"]["ok"], \
+            "the in-flight join must complete, not be dropped"
+        assert t_drained >= done["t"], \
+            "drain acknowledged before the in-flight join settled"
+    finally:
+        c1.close()
+        c2.close()
+        server.server_close()
+    # No new work after drain: a fresh connection is either refused
+    # outright (the scheduled shutdown won the race) or answered with
+    # the structured DrainingError refusal — never served.
+    try:
+        c3 = ServiceClient("127.0.0.1", port, timeout_s=2.0)
+    except OSError:
+        pass
+    else:
+        try:
+            late = c3.send(q)
+            assert not late.get("ok")
+            assert late.get("error") == "DrainingError", late
+        except (OSError, ValueError):
+            pass  # connection torn by the shutdown mid-exchange
+        finally:
+            c3.close()
+    assert service.draining is not None
+
+
+def test_shutdown_waits_on_exec_lock_before_ack():
+    """The shutdown race fix: {"ok": true} must not race a join still
+    dispatching on another connection — the reply waits (bounded) on
+    the exec lock and reports quiesced."""
+    import threading
+    import time
+
+    from distributed_join_tpu.parallel.faults import (
+        FaultInjectingCommunicator,
+        FaultPlan,
+    )
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceClient,
+        ServiceConfig,
+        start_daemon,
+    )
+
+    comm = FaultInjectingCommunicator(
+        dj.make_communicator("tpu", n_ranks=8),
+        FaultPlan(dispatch_delay_s=1.0, delay_after_dispatches=1))
+    service = JoinService(comm, ServiceConfig())
+    server, port = start_daemon(service)
+    c1 = ServiceClient("127.0.0.1", port)
+    c2 = ServiceClient("127.0.0.1", port)
+    q = {"op": "join", "build_nrows": 256, "probe_nrows": 256,
+         "seed": 7, "selectivity": 0.5, "out_capacity_factor": 4.0}
+    done = {}
+    try:
+        assert c1.send(q)["ok"]
+
+        def slow_join():
+            done["resp"] = c1.send(q)
+            done["t"] = time.monotonic()
+
+        t = threading.Thread(target=slow_join)
+        t.start()
+        time.sleep(0.3)
+        t_sent = time.monotonic()
+        resp = c2.send({"op": "shutdown", "quiesce_timeout_s": 10.0})
+        t_ack = time.monotonic()
+        t.join(timeout=30.0)
+        assert resp["ok"] and resp["quiesced"] is True
+        assert done["resp"]["ok"]
+        # The ack had to wait out the join's remaining injected delay
+        # (>= ~0.7s of the 1s stall) on the exec lock — the old
+        # reply-first behavior acked in microseconds. (Comparing
+        # against the join CLIENT's receive time would race the two
+        # loopback response writes.)
+        assert t_ack - t_sent >= 0.4, \
+            "shutdown acknowledged while a join was still dispatching"
+    finally:
+        c1.close()
+        c2.close()
+        server.server_close()
+
+
+def test_client_reconnects_with_backoff_and_surfaces_attempts():
+    """ServiceClient(retries=): a torn connection is reconnected and
+    the payload resent (idempotent — the wire carries specs); a
+    daemon gone past the budget raises ConnectionError carrying the
+    attempt count (the --watch one-line error)."""
+    import json as _json
+    import socket
+    import socketserver
+    import threading
+
+    from distributed_join_tpu.service.server import ServiceClient
+
+    state = {"conns": 0}
+
+    class FlakyHandler(socketserver.StreamRequestHandler):
+        def handle(self):
+            state["conns"] += 1
+            if state["conns"] <= 2:
+                return  # tear the connection without answering
+            for raw in self.rfile:
+                line = raw.strip()
+                if not line:
+                    continue
+                req = _json.loads(line)
+                self.wfile.write((_json.dumps(
+                    {"ok": True, "op": req.get("op")}) + "\n")
+                    .encode())
+                self.wfile.flush()
+
+    class S(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    srv = S(("127.0.0.1", 0), FlakyHandler)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        client = ServiceClient("127.0.0.1", port, retries=3,
+                               backoff_s=0.01)
+        assert client.send({"op": "ping"})["ok"]
+        client.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    # A dead port: the terminal error surfaces the attempt count.
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    with pytest.raises(ConnectionError, match="after 2 attempt"):
+        ServiceClient("127.0.0.1", dead_port, retries=1,
+                      backoff_s=0.01)
+    with pytest.raises(ConnectionError, match="after 1 attempt"):
+        ServiceClient("127.0.0.1", dead_port)
+
+
+def test_sigterm_drains_daemon_and_exits_zero(tmp_path):
+    """SIGTERM on the serving daemon: graceful drain (refuse new,
+    settle in-flight, flush artifacts) and exit 0 — the fleet's
+    replace path terminates replicas this way before SIGKILL."""
+    import signal
+    import subprocess
+    import sys as _sys
+    import time
+
+    proc = subprocess.Popen(
+        [_sys.executable, "-m",
+         "distributed_join_tpu.service.server",
+         "--host", "127.0.0.1", "--port", "0",
+         "--platform", "cpu", "--n-ranks", "2",
+         "--flight-recorder-path", str(tmp_path / "fr.json")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        port = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise AssertionError(
+                    f"daemon exited early rc={proc.poll()}")
+            if "listening on " in line:
+                port = int(line.rsplit(":", 1)[1])
+                break
+        assert port is not None
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60.0)
+        assert rc == 0, f"SIGTERM exit was rc={rc}, not 0"
+        assert (tmp_path / "fr.json").exists(), \
+            "drain must flush the flight recorder on the way out"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()
