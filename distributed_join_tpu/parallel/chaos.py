@@ -1047,6 +1047,289 @@ def fleet_slice(seed: int, trials: int, *, replica_ranks: int = 2,
     }
 
 
+# -- the resident-kill fleet slice ------------------------------------
+
+
+def _resident_trial_spec(seed: int, trial: int, table: str) -> dict:
+    """One probe-only trial spec, deterministic in (seed, trial).
+    The table name pins the signature ring slot, so every trial
+    ring-starts at the table's primary holder — the victim faces
+    ALL the traffic."""
+    trng = _trial_rng(seed, 555_200 + trial)
+    return {
+        "op": "join",
+        "table": table,
+        "probe_nrows": trng.choice((512, 1024)),
+        "rand_max": 4096,
+        "selectivity": trng.choice((0.3, 0.5)),
+        "seed": trng.randrange(1 << 16),
+        "out_capacity_factor": 3.0,
+    }
+
+
+def fleet_resident_slice(seed: int, trials: int, *,
+                         replica_ranks: int = 2,
+                         repro_out: Optional[str] = None) -> dict:
+    """The ``--fleet-fault resident-kill`` soak (docs/FLEET.md
+    "Replication & HA"): a K=2 fleet holds ONE resident table
+    (register + one append, so generation fencing is live), N
+    probe-only trials ring-start at the table's PRIMARY holder, and
+    that holder is SIGKILLed at the midpoint.
+
+    Gates:
+
+    - **zero wrong rows from any holder** — every non-refused answer
+      must equal the pandas oracle over register + delta (stale or
+      rebuilt images either serve the full image or refuse; a wrong
+      match count is the unforgivable ``FAILED:wrong_result``);
+    - **failover within the retry budget** — post-kill probe-only
+      trials are answered by the surviving holder within
+      ``retry_budget + 1`` attempts (a refusal only passes when
+      attributable to the kill);
+    - **rebuild** — the replacement walks ``rebuilding -> serving``
+      at the directory generation by replaying the durable manifest,
+      and a generation-FENCED replay of a pre-fault probe-only
+      signature on it answers oracle-exact with ZERO new traces (the
+      shared persist dir hands the rebuilt holder its warm program).
+    """
+    import tempfile
+
+    import pandas as pd
+
+    from distributed_join_tpu.service import fleet as fleet_mod
+    from distributed_join_tpu.service.server import (
+        ServiceClient,
+        _build_from_spec,
+        _probe_from_spec,
+    )
+
+    table = "soak_residents"
+    reg = {"op": "register", "name": table, "rows": 2048,
+           "seed": seed % 9973 + 11, "rand_max": 4096,
+           "unique_keys": True}
+    delta = {"op": "append", "name": table, "rows": 256,
+             "seed": seed % 9973 + 13, "rand_max": 4096}
+    victim = fleet_mod.affine_replica({"op": "join", "table": table},
+                                      replica_ranks, 2)
+    workdir = tempfile.mkdtemp(prefix="djtpu_fleet_resident_soak_")
+    cfg = fleet_mod.FleetConfig(
+        n_replicas=2,
+        replica_ranks=replica_ranks,
+        persist_dir=os.path.join(workdir, "programs"),
+        history_dir=os.path.join(workdir, "history"),
+        coord_dir=os.path.join(workdir, "coord"),
+        table_replication=2,
+        probe_interval_s=0.5,
+        suspect_strikes=2,
+        retry_budget=2,
+        request_deadline_s=120.0,
+    )
+    overrides: dict = {
+        i: {"extra_args": ["--flight-recorder-path",
+                           os.path.join(workdir,
+                                        f"replica{i}_fr.json")]}
+        for i in (0, 1)
+    }
+    router = fleet_mod.FleetRouter(
+        fleet_mod.process_fleet_factory(
+            cfg, platform="cpu", replica_overrides=overrides), cfg)
+    router.start()
+    server, port = fleet_mod.start_router_daemon(router)
+    client = ServiceClient("127.0.0.1", port)
+    kill_at = trials // 2
+
+    # The resident oracle: register + delta, concatenated once.
+    base = _build_from_spec(reg)
+    build_df = pd.concat(
+        [base.to_pandas(), _build_from_spec(delta).to_pandas()],
+        ignore_index=True)
+
+    class _Stub:
+        wire_spec = {k: reg[k] for k in
+                     ("rows", "seed", "rand_max", "unique_keys")}
+        wire_build_keys = base.columns["key"]
+
+    def expected_matches(spec: dict) -> int:
+        probe = _probe_from_spec(spec, _Stub)
+        return len(build_df.merge(probe.to_pandas(), on="key"))
+
+    def refusal_injected(k: int, err: str) -> bool:
+        return k >= kill_at and ("connection" in err
+                                 or "FleetError" in err
+                                 or "StaleGeneration" in err)
+
+    records, failures = [], []
+    pre_fault_spec = None
+    generation = None
+    try:
+        r = client.send(reg)
+        if not r.get("ok"):
+            raise RuntimeError(f"soak register failed: {r}")
+        a = client.send(delta)
+        if not a.get("ok"):
+            raise RuntimeError(f"soak append failed: {a}")
+        generation = int(a.get("generation", 0))
+
+        for k in range(trials):
+            spec = _resident_trial_spec(seed, k, table)
+            if pre_fault_spec is None:
+                pre_fault_spec = dict(spec)
+            if k == kill_at:
+                router.replicas[victim].backend.kill()
+            expected = expected_matches(spec)
+            t0 = time.perf_counter()
+            try:
+                resp = client.send(spec)
+            except (OSError, ValueError) as exc:
+                resp = {"ok": False, "error": "RouterLost",
+                        "message": f"{type(exc).__name__}: {exc}"}
+            if resp.get("ok"):
+                got = resp.get("matches")
+                fl = resp.get("fleet") or {}
+                if resp.get("overflow"):
+                    out = TrialOutcome("FAILED:overflow",
+                                       expected_total=expected)
+                elif got != expected:
+                    # The unforgivable outcome: a holder served rows
+                    # that exclude the delta (or worse).
+                    out = TrialOutcome("FAILED:wrong_result",
+                                       expected_total=expected,
+                                       got_total=got,
+                                       retries=fl.get("failovers",
+                                                      0))
+                elif fl.get("attempts", 1) > cfg.retry_budget + 1:
+                    out = TrialOutcome(
+                        "FAILED:budget",
+                        expected_total=expected, got_total=got,
+                        retries=fl.get("failovers", 0))
+                else:
+                    out = TrialOutcome(
+                        "recovered" if fl.get("failovers") else "ok",
+                        expected_total=expected, got_total=got,
+                        retries=fl.get("failovers", 0))
+            else:
+                err = (f"{resp.get('error')}: "
+                       f"{resp.get('message')}")
+                out = TrialOutcome(
+                    "detected" if refusal_injected(k, err)
+                    else "FAILED:refused",
+                    error=err, expected_total=expected)
+            rec = {"trial": k, "spec": spec,
+                   "fault": "resident-kill",
+                   **dataclasses.asdict(out),
+                   "verdict": out.verdict,
+                   "elapsed_s": round(time.perf_counter() - t0, 3)}
+            records.append(rec)
+            print(f"resident trial {k:3d} -> {rec['verdict']} "
+                  f"({rec['elapsed_s']}s)", flush=True)
+            if out.failed:
+                failures.append(rec)
+                if repro_out:
+                    path = f"{repro_out}_resident_{seed}_{k}.json"
+                    with open(path, "w") as f:
+                        json.dump({**rec, "harness_seed": seed,
+                                   "replay": "python -m distributed_"
+                                   "join_tpu.parallel.chaos --fleet "
+                                   f"{trials} --fleet-fault "
+                                   "resident-kill "
+                                   f"--seed {seed}"},
+                                  f, indent=2)
+                    print(f"  repro written: {path}", flush=True)
+
+        # -- the rebuild gate -----------------------------------------
+        drain_replace = {"required": True}
+        rebuild: dict = {"required": True}
+        replaced = router.wait_replaced(victim,
+                                       timeout_s=cfg.spawn_timeout_s)
+        drain_replace.update(
+            replaced=replaced,
+            generation=router.replicas[victim].generation)
+        if not replaced:
+            failures.append({"gate": "drain_replace",
+                             **drain_replace})
+        holder = None
+        deadline = time.monotonic() + cfg.spawn_timeout_s
+        while time.monotonic() < deadline:
+            holder = (router.stats()["tables"]
+                      .get(table, {}).get("holders", {})
+                      .get(str(victim)))
+            if holder and holder["state"] == "serving":
+                break
+            time.sleep(0.2)
+        rebuild.update(holder=holder,
+                       rebuilds_total=router.stats()
+                       ["rebuilds_total"])
+        if not (holder and holder["state"] == "serving"
+                and holder["generation"] == generation):
+            failures.append({"gate": "rebuild_serving", **rebuild})
+        elif replaced:
+            # The FENCED replay of a pre-fault signature on the
+            # rebuilt image: oracle-exact, correct generation, zero
+            # new traces.
+            try:
+                direct = ServiceClient(
+                    *router.replicas[victim].addr(),
+                    timeout_s=120.0)
+                try:
+                    replay = direct.send(
+                        {**pre_fault_spec,
+                         "min_generation": generation})
+                finally:
+                    direct.close()
+            except (OSError, ValueError) as exc:
+                replay = {"ok": False, "error": "RouterLost",
+                          "message": f"{type(exc).__name__}: {exc}"}
+            rebuild["replay"] = {kk: replay.get(kk) for kk in
+                                 ("ok", "error", "message",
+                                  "new_traces", "matches")}
+            rebuild["replay"]["generation"] = \
+                (replay.get("resident") or {}).get("generation")
+            if (not replay.get("ok")
+                    or replay.get("new_traces") != 0
+                    or replay.get("matches")
+                    != expected_matches(pre_fault_spec)
+                    or rebuild["replay"]["generation"]
+                    != generation):
+                failures.append({"gate": "rebuilt_replay_warm",
+                                 **rebuild})
+    finally:
+        client.close()
+        server.shutdown()
+        server.server_close()
+        router.stop()
+
+    verdicts: dict = {}
+    for rec in records:
+        verdicts[rec["verdict"]] = verdicts.get(rec["verdict"],
+                                                0) + 1
+    if failures:
+        print(f"resident soak artifacts kept at {workdir}",
+              flush=True)
+    else:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {
+        "kind": "fleet_soak",
+        "schema_version": 1,
+        "harness_seed": seed,
+        "slice": "fleet_resident",
+        "fault": "resident-kill",
+        "victim": victim,
+        "table": table,
+        "generation": generation,
+        "replica_ranks": replica_ranks,
+        "trials": len(records),
+        "verdicts": verdicts,
+        "failures": len(failures),
+        "failure_records": failures,
+        "drain_replace": drain_replace,
+        "rebuild": rebuild,
+        "fleet_stats": router.stats(),
+        "records": records,
+    }
+
+
 # -- the soak loop ----------------------------------------------------
 
 
@@ -1126,9 +1409,14 @@ def parse_args(argv=None):
                         "and the zero-trace warm replacement gated "
                         "(docs/FLEET.md)")
     p.add_argument("--fleet-fault", default=None,
-                   choices=("kill", "hang", "corrupt"),
+                   choices=("kill", "hang", "corrupt",
+                            "resident-kill"),
                    help="pin the fleet soak's fault (default: drawn "
-                        "from the harness seed)")
+                        "from the harness seed); resident-kill runs "
+                        "the REPLICATED-table slice instead (K=2 "
+                        "holders, the table's primary holder "
+                        "SIGKILLed mid-soak, manifest rebuild + "
+                        "fenced zero-trace replay gated)")
     p.add_argument("--replica-ranks", type=int, default=2,
                    help="mesh size of each fleet replica")
     p.add_argument("--tuner-slice", type=int, default=None,
@@ -1168,7 +1456,12 @@ def main(argv=None) -> int:
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       0.5)
 
-    if args.fleet:
+    if args.fleet and args.fleet_fault == "resident-kill":
+        summary = fleet_resident_slice(
+            args.seed, args.fleet,
+            replica_ranks=args.replica_ranks,
+            repro_out=args.repro_out)
+    elif args.fleet:
         summary = fleet_slice(args.seed, args.fleet,
                               replica_ranks=args.replica_ranks,
                               fault=args.fleet_fault,

@@ -90,6 +90,11 @@ class FaultPlan:
     - ``fail_after_dispatches``: every invocation AFTER the first N
       raises — a persistent outage, the "killed mid-run" scenario for
       out-of-core resume tests.
+    - ``drop_dispatches``: EXACT 1-based dispatch ordinals that raise,
+      everything else unaffected — a surgical single-message drop.
+      The replication fencing tests use it to make one holder miss
+      precisely the ``append`` delta prep (dispatch #2 after the
+      register prep) while register and later probes stay healthy.
     - ``dispatch_delay_s``: sleep before each dispatch (a slow/
       congested interconnect; drives deadline paths).
       ``delay_after_dispatches`` defers the delay: the first N
@@ -134,6 +139,7 @@ class FaultPlan:
     overflow_programs: int = 0
     fail_dispatches: int = 0
     fail_after_dispatches: Optional[int] = None
+    drop_dispatches: tuple = ()
     dispatch_delay_s: float = 0.0
     delay_after_dispatches: Optional[int] = None
     corrupt_plan_gathers: int = 0
@@ -154,6 +160,9 @@ def plan_from_record(record: dict) -> FaultPlan:
         raise ValueError(
             f"unknown FaultPlan field(s) {sorted(unknown)}; "
             f"known: {sorted(known)}")
+    record = dict(record)
+    if record.get("drop_dispatches") is not None:
+        record["drop_dispatches"] = tuple(record["drop_dispatches"])
     return FaultPlan(**record)
 
 
@@ -413,6 +422,11 @@ class FaultInjectingCommunicator(Communicator):
                 raise FaultInjectedError(
                     f"injected dispatch failure #{self._dispatches} "
                     f"(fail_dispatches={self.plan.fail_dispatches})"
+                )
+            if self._dispatches in (self.plan.drop_dispatches or ()):
+                raise FaultInjectedError(
+                    f"injected dispatch drop #{self._dispatches} "
+                    f"(drop_dispatches={self.plan.drop_dispatches})"
                 )
             after = self.plan.fail_after_dispatches
             if after is not None and self._dispatches > after:

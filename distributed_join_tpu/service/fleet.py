@@ -37,13 +37,43 @@ service:
   own :class:`~..telemetry.live.LiveMetrics` snapshots (taken by the
   health probe): when no replica is admittable the router answers a
   structured ``AdmissionError`` instead of queueing unboundedly.
+- **Replicated resident state** (``table_replication`` = K > 1,
+  docs/FLEET.md "Replication"): ``register`` writes a versioned TABLE
+  MANIFEST (name, key, generation, the register spec, every append
+  delta spec, a payload digest, prep knobs) to the shared coord dir,
+  then fans the registration out to the K live replicas from the
+  table's affine ring slot. ``append`` applies to every holder with
+  GENERATION FENCING: a holder that misses the delta is marked stale
+  in the router's table directory and the router injects
+  ``min_generation`` into probe-only joins, so a stale image REFUSES
+  (``StaleGenerationError``) instead of silently serving rows that
+  exclude the delta — the router fails the attempt over to an
+  up-to-date holder. A replacement replica REBUILDS its image from
+  the manifest on its slot (``rebuilding -> serving`` holder
+  lifecycle; warm probe-only programs reload from the AOT persist
+  dir, so the rebuilt image serves repeat signatures with zero new
+  traces). When NO live holder exists, table ops answer a structured
+  ``NoHolderError`` — never a misroute.
+- **Router HA** (:class:`RouterHA`, ROADMAP 5b): N router processes
+  share the pure affinity function, the durable manifests, and a
+  generation-fenced replica/table DIRECTORY file — no consensus. A
+  fenced LEASE file elects the one serving primary; a standby polls
+  it, and on primary death (lease stale past its TTL) acquires the
+  lease, adopts the directory, binds the advertised endpoint, and
+  serves. Clients ride the same reconnect+resend contract as replica
+  failover: idempotent ops resend through their bounded backoff,
+  mutating ops refuse client-side resend.
 - **Observability.** The router keeps its own
   :class:`~..telemetry.live.LiveMetrics` /
   :class:`~..telemetry.live.FlightRecorder` /
   :class:`~..telemetry.history.WorkloadHistory` (entries stamped with
-  the serving replica), and exposes fleet-level Prometheus gauges
+  the serving replica and, for resident traffic, the table's holder
+  set + generation), and exposes fleet-level Prometheus gauges
   ``djtpu_fleet_{replicas,healthy,suspect,drained,failovers_total,
-  shed_total,replaced_total}`` next to the usual request counters.
+  shed_total,replaced_total,rebuilds_total}``,
+  ``djtpu_fleet_resident_holders{table}``, ``djtpu_router_role`` and
+  ``djtpu_router_takeovers_total`` next to the usual request
+  counters.
 
 ``python -m distributed_join_tpu.service.fleet`` (``tpu-join-fleet``)
 serves the same line-JSON wire protocol as one daemon — clients do not
@@ -51,11 +81,16 @@ change. ``--smoke`` runs the CI acceptance protocol (the ``fleet``
 lane of ``scripts/run_tier1.sh``): a 2-replica CPU-mesh fleet, warm
 affinity discipline, ONE SCRIPTED REPLICA KILL mid-traffic, and gates
 on oracle equality, drain+replace observed, bounded retry count, and
-a zero-trace warm repeat on the replacement.
+a zero-trace warm repeat on the replacement. ``--ha-smoke`` runs the
+replication/HA acceptance protocol (the ``fleet_ha`` lane): K=2
+resident replication, a holder kill with manifest-driven rebuild, and
+a router kill with standby takeover — warm, fenced, oracle-graded.
+``--standby`` joins an existing coord dir as a standby router.
 
 The chaos soak lives in ``parallel/chaos.py --fleet`` (kill / hang /
 corrupt one replica mid-soak, every non-refused answer graded against
-the pandas oracle).
+the pandas oracle; ``--fleet-fault resident-kill`` kills a registered
+table's primary holder instead).
 """
 
 from __future__ import annotations
@@ -72,6 +107,10 @@ import time
 from typing import Callable, Optional
 
 from distributed_join_tpu import telemetry
+from distributed_join_tpu.service.programs import (
+    atomic_write_json,
+    spec_digest,
+)
 from distributed_join_tpu.service.server import (
     AdmissionError,
     ServiceClient,
@@ -85,6 +124,23 @@ class FleetError(RuntimeError):
     """A fleet-level structured failure (failover budget exhausted,
     duplicate in-flight request id) — answered on the wire, never an
     unstructured crash of the router."""
+
+
+class NoHolderError(FleetError):
+    """A table op or probe-only join found NO live holder for its
+    table (replication on): answered as a structured refusal — never
+    silently misrouted to a replica that would invent an 'unknown
+    table' answer for state the fleet actually owns."""
+
+
+# Durable-state artifact versions (docs/FAILURE_SEMANTICS.md,
+# "Replication & durability contract"). `analyze check` validates
+# both kinds.
+TABLE_MANIFEST_SCHEMA_VERSION = 1
+ROUTER_DIRECTORY_SCHEMA_VERSION = 1
+ROUTER_DIRECTORY_FILENAME = "router_directory.json"
+ROUTER_LEASE_FILENAME = "router_lease.json"
+MANIFEST_SUFFIX = ".manifest.json"
 
 
 @dataclasses.dataclass
@@ -104,6 +160,15 @@ class FleetConfig:
     ``shed_qps`` bounds (read from the replicas' probed LiveMetrics
     snapshots) drive admission — beyond them the router sheds with a
     structured ``AdmissionError``.
+
+    ``table_replication`` (K) is the resident-table holder count:
+    K=1 (default) is the exact pre-replication behavior — table ops
+    route by affinity hash alone, no manifests, no directory. K>1
+    turns on durable manifests, holder fan-out, and generation
+    fencing. ``coord_dir`` holds the manifests + the router
+    directory + the HA lease (defaults to ``persist_dir``);
+    ``lease_ttl_s``/``lease_renew_s`` pace the HA lease,
+    ``router_id`` names this router in the lease/directory files.
     """
 
     n_replicas: int = 2
@@ -124,6 +189,148 @@ class FleetConfig:
     history_dir: Optional[str] = None
     flight_records: int = 256
     flight_recorder_path: Optional[str] = None
+    table_replication: int = 1
+    coord_dir: Optional[str] = None
+    lease_ttl_s: float = 3.0
+    lease_renew_s: float = 0.5
+    router_id: Optional[str] = None
+
+
+# -- durable state: table manifests, router directory, HA lease --------
+
+
+def _table_slug(name: str) -> str:
+    """Filesystem-safe manifest stem for a table name (verbatim when
+    it is already safe, content-hashed otherwise — two distinct names
+    can never collide on disk)."""
+    if name and all(c.isalnum() or c in "._-" for c in name):
+        return name
+    return hashlib.sha256(name.encode()).hexdigest()[:24]
+
+
+def table_manifest_path(coord_dir: str, name: str) -> str:
+    return os.path.join(coord_dir, "tables",
+                        _table_slug(name) + MANIFEST_SUFFIX)
+
+
+def table_manifest_doc(name: str, register_spec: dict, key: str,
+                       generation: int, deltas: list,
+                       prep: dict) -> dict:
+    """The versioned table manifest (kind ``table_manifest``): enough
+    to rebuild a holder's image byte-for-byte — the register spec,
+    every append delta spec IN ORDER, the generation they sum to, and
+    a payload digest over both (through the same canonicalizer the
+    program-cache signatures use, so a rebuilt holder can prove it
+    replayed the rows the original held)."""
+    return {
+        "kind": "table_manifest",
+        "schema_version": TABLE_MANIFEST_SCHEMA_VERSION,
+        "name": name,
+        "key": key,
+        "generation": int(generation),
+        "register": register_spec,
+        "deltas": list(deltas),
+        "payload_digest": spec_digest(
+            {"register": register_spec, "deltas": list(deltas)}),
+        "prep": prep,
+        "updated_unix_s": time.time(),
+    }
+
+
+def load_table_manifest(coord_dir: str, name: str) -> Optional[dict]:
+    try:
+        with open(table_manifest_path(coord_dir, name)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def router_directory_path(coord_dir: str) -> str:
+    return os.path.join(coord_dir, ROUTER_DIRECTORY_FILENAME)
+
+
+def load_router_directory(coord_dir: str) -> Optional[dict]:
+    try:
+        with open(router_directory_path(coord_dir)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+class RouterLease:
+    """The fenced lease file behind router HA (ROADMAP 5b): ONE
+    serving router at a time, no consensus — a shared filesystem
+    plays coordinator. The file carries ``{owner, epoch,
+    renewed_unix_s, addr}``. Acquisition is EPOCH-FENCED: a taker
+    writes ``epoch + 1``, settles, and re-reads — if another
+    contender's write landed last, the taker lost and stands down.
+    Renewal re-reads before every write: an owner that finds a higher
+    epoch (someone took over while it stalled) is FENCED OUT and must
+    stop serving rather than split-brain the directory."""
+
+    def __init__(self, path: str, owner: str, ttl_s: float = 3.0,
+                 settle_s: float = 0.2):
+        self.path = path
+        self.owner = owner
+        self.ttl_s = ttl_s
+        self.settle_s = settle_s
+        self.epoch = 0
+
+    def read(self) -> Optional[dict]:
+        try:
+            with open(self.path) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def _write(self, epoch: int, addr=None) -> None:
+        atomic_write_json(self.path, {
+            "kind": "router_lease",
+            "owner": self.owner,
+            "epoch": int(epoch),
+            "ttl_s": self.ttl_s,
+            "renewed_unix_s": time.time(),
+            "addr": addr,
+        })
+
+    def stale(self, doc: Optional[dict] = None) -> bool:
+        doc = doc if doc is not None else self.read()
+        if doc is None:
+            return True
+        age = time.time() - float(doc.get("renewed_unix_s") or 0.0)
+        return age > self.ttl_s
+
+    def acquire(self, addr=None) -> bool:
+        doc = self.read()
+        if doc is not None and not self.stale(doc) \
+                and doc.get("owner") != self.owner:
+            return False
+        epoch = int((doc or {}).get("epoch") or 0) + 1
+        self._write(epoch, addr=addr)
+        time.sleep(self.settle_s)
+        doc = self.read()
+        if doc is None or doc.get("owner") != self.owner \
+                or int(doc.get("epoch") or -1) != epoch:
+            return False
+        self.epoch = epoch
+        return True
+
+    def renew(self) -> bool:
+        doc = self.read()
+        if doc is None or doc.get("owner") != self.owner \
+                or int(doc.get("epoch") or -1) != self.epoch:
+            return False
+        self._write(self.epoch, addr=doc.get("addr"))
+        return True
+
+    def release(self) -> None:
+        """Hand off immediately: stamp the lease stale so a standby
+        takes over without waiting out the TTL."""
+        doc = self.read()
+        if doc is not None and doc.get("owner") == self.owner \
+                and int(doc.get("epoch") or -1) == self.epoch:
+            atomic_write_json(self.path,
+                              {**doc, "renewed_unix_s": 0.0})
 
 
 # -- replica backends --------------------------------------------------
@@ -223,6 +430,31 @@ class InProcessReplica:
     def stop(self, timeout_s: float = 10.0) -> None:  # noqa: ARG002
         if not self._dead:
             self.kill()
+
+
+class AttachedReplica:
+    """A replica endpoint ADOPTED from the router directory at
+    standby takeover: the daemon process belongs to the dead router's
+    spawn tree, so this incarnation has no process handle — liveness
+    is judged on the wire (health probes + request strikes), and
+    ``stop`` deliberately leaves the process running (a router
+    takeover must not reap the serving fleet)."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = int(port)
+        self._dead = False
+
+    def alive(self) -> bool:
+        return not self._dead
+
+    def kill(self) -> None:
+        # No process handle: mark it dead so the state machine treats
+        # the slot as gone (the wire is already refusing).
+        self._dead = True
+
+    def stop(self, timeout_s: float = 10.0) -> None:  # noqa: ARG002
+        pass
 
 
 def in_process_fleet_factory(n_replicas: int, ranks_per_replica: int,
@@ -421,9 +653,19 @@ class FleetRouter:
         self.shed_total = 0
         self.replaced_total = 0
         self.drains_total = 0
+        self.rebuilds_total = 0
+        self.takeovers_total = 0
         self.served = 0
         self.failed = 0
         self.rejected = 0
+        # Replicated-state tier (table_replication > 1): the in-memory
+        # table directory (name -> generation/key/holder set) the
+        # durable router_directory.json mirrors; `role` is the HA
+        # role ("single" outside RouterHA pairing).
+        self.role = "single"
+        self._tables: dict = {}
+        self._lease: Optional[RouterLease] = None
+        self._directory_fence = 0
         self._request_seq = 0
         self._id_stamp = os.urandom(3).hex()
         self._inflight_ids: set = set()
@@ -433,6 +675,14 @@ class FleetRouter:
         # Set by the wire `shutdown` op; the serving loop (main) and
         # embedding harnesses watch it to tear the fleet down.
         self.shutdown_requested = threading.Event()
+
+    @property
+    def _coord_dir(self) -> Optional[str]:
+        return self.config.coord_dir or self.config.persist_dir
+
+    @property
+    def _replicated(self) -> bool:
+        return self.config.table_replication > 1
 
     # -- lifecycle ----------------------------------------------------
 
@@ -634,6 +884,14 @@ class FleetRouter:
             self.replaced_total += 1
         telemetry.event("fleet_replica_replaced", replica=rep.index,
                         generation=rep.generation)
+        if self._replicated:
+            try:
+                self._rebuild_holder_tables(rep)
+            except Exception as exc:  # noqa: BLE001 - rebuild boundary
+                telemetry.event("fleet_rebuild_error",
+                                replica=rep.index,
+                                error=f"{type(exc).__name__}: {exc}")
+            self._save_directory()
 
     # -- dispatch -----------------------------------------------------
 
@@ -699,8 +957,45 @@ class FleetRouter:
         outcome = "failed"
         resp = None
         try:
+            if self._replicated and op in ("register", "append",
+                                           "drop"):
+                # Replicated table ops never ride the single-replica
+                # path: they fan out to the holder set (register
+                # picks it, append/drop route BY it).
+                resp = self._table_fanout(req, rid, key, state)
+                outcome = "served" if resp.get("ok") else "failed"
+                return resp
+            allowed = None
+            if op == "join" and req.get("table"):
+                with self._lock:
+                    entry = self._tables.get(str(req["table"]))
+                if entry is not None:
+                    # Probe-only joins route by HOLDER SET, fenced at
+                    # the directory generation: a stale holder must
+                    # refuse, not serve rows missing a delta. Only
+                    # SERVING holders are routable — a slot mid-
+                    # rebuild has no image yet (its replica would
+                    # answer ResidentError), and a stale slot would
+                    # only burn an attempt on a guaranteed fence
+                    # refusal.
+                    with self._lock:
+                        allowed = {
+                            idx for idx, h
+                            in entry["holders"].items()
+                            if h["state"] == "serving"}
+                        states = {idx: h["state"] for idx, h
+                                  in entry["holders"].items()}
+                    if not allowed:
+                        raise NoHolderError(
+                            f"no serving holder for table "
+                            f"{req['table']!r} (holder states "
+                            f"{states}); rebuilds in flight heal "
+                            "this — retry with backoff")
+                    req = {**req,
+                           "min_generation": entry["generation"]}
             resp = self._dispatch_attempts(
-                req, rid, key, state, retry_with_backoff)
+                req, rid, key, state, retry_with_backoff,
+                allowed=allowed)
             outcome = "served" if resp.get("ok") else "failed"
             return resp
         except AdmissionError as exc:
@@ -712,6 +1007,13 @@ class FleetRouter:
                     "message": str(exc), "shed": True,
                     "request_id": rid,
                     "fleet": {"attempts": state["attempts"]}}
+            return resp
+        except NoHolderError as exc:
+            resp = {"ok": False, "error": "NoHolderError",
+                    "message": str(exc), "request_id": rid,
+                    "table": req.get("table") or req.get("name"),
+                    "fleet": {"attempts": state["attempts"],
+                              "failovers": state["failovers"]}}
             return resp
         except FleetError as exc:
             resp = {"ok": False, "error": "FleetError",
@@ -726,7 +1028,7 @@ class FleetRouter:
                           time.perf_counter() - t0, resp)
 
     def _dispatch_attempts(self, req, rid, key, state,
-                           retry_with_backoff):
+                           retry_with_backoff, allowed=None):
         deadline = time.monotonic() + self.config.request_deadline_s
         # index -> generation at HARD-failure time (dead connection,
         # hang, poison): a later attempt may return to the slot only
@@ -740,8 +1042,22 @@ class FleetRouter:
 
         def attempt_once():
             state["attempts"] += 1
-            rep = self._pick(key, last_failed, soft_failed)
+            rep = self._pick(key, last_failed, soft_failed,
+                             allowed=allowed)
             if rep is None:
+                if allowed is not None:
+                    with self._lock:
+                        live = [r for r in self.replicas
+                                if r.index in allowed
+                                and r.state in ("healthy",
+                                                "suspect")]
+                    if not live:
+                        raise NoHolderError(
+                            f"no live holder for table "
+                            f"{req.get('table')!r} (holder set "
+                            f"{sorted(allowed)} all dead/drained); "
+                            "refusing rather than misrouting to a "
+                            "replica without the image")
                 raise AdmissionError(
                     "fleet admission: no admittable replica "
                     f"(inflight bound "
@@ -773,10 +1089,31 @@ class FleetRouter:
                     if rep.generation == gen0:
                         rep.inflight = max(rep.inflight - 1, 0)
             fault = self._replica_fault(resp)
+            if fault is None and allowed is not None \
+                    and not resp.get("ok") \
+                    and resp.get("error") == "ResidentError":
+                # Holder-routed probe-only join answered "no resident
+                # table": the directory says this slot holds the
+                # image, the replica says it does not (a replacement
+                # whose rebuild has not started yet, or an image lost
+                # with a prior incarnation). That inconsistency is
+                # the FLEET's, not the client's answer — park the
+                # slot stale (the rebuild's completion overwrites
+                # this) and fail over to another holder.
+                fault = "stale"
             if fault is not None:
                 if fault in ("hang", "poisoned"):
                     self._drain(rep, f"request {rid}: {fault}")
                     last_failed[rep.index] = gen0
+                elif fault == "stale":
+                    # Generation fence fired: this holder missed an
+                    # append. Hard-exclude the incarnation (it can
+                    # never catch up short of a rebuild) and record
+                    # the staleness in the table directory — the
+                    # retry lands on an up-to-date holder.
+                    last_failed[rep.index] = gen0
+                    self._mark_holder_stale(req.get("table"),
+                                            rep.index)
                 else:
                     # busy/draining: transient — steer the next
                     # attempt elsewhere, but stay re-eligible on the
@@ -810,7 +1147,8 @@ class FleetRouter:
                 f"{exc}") from exc
 
     def _pick(self, key: str, exclude: dict,
-              soft: Optional[set] = None) -> Optional[_Replica]:
+              soft: Optional[set] = None,
+              allowed: Optional[set] = None) -> Optional[_Replica]:
         """Pick AND reserve (inflight slot taken under the one lock,
         so two concurrent dispatches can never both pass the
         admission bound). The caller releases the slot in its
@@ -820,7 +1158,9 @@ class FleetRouter:
         handed the same request back while still the known-bad
         incarnation. ``soft`` holds transiently-refusing (busy/
         draining) indices: preferred-against on the first pass,
-        re-eligible on the fallback pass."""
+        re-eligible on the fallback pass. ``allowed`` (replicated
+        resident traffic) restricts the walk to the table's holder
+        set — a non-holder never sees the request."""
         with self._lock:
             n = len(self.replicas)
             if not n:
@@ -830,6 +1170,9 @@ class FleetRouter:
                      for k in range(n)]
             for second_pass in (False, True):
                 for rep in order:
+                    if allowed is not None \
+                            and rep.index not in allowed:
+                        continue
                     if rep.index in exclude:
                         if not second_pass:
                             continue
@@ -857,6 +1200,11 @@ class FleetRouter:
         msg = str(resp.get("message", ""))
         if err == "HangError":
             return "hang"
+        if err == "StaleGenerationError":
+            # The holder-side generation fence: failover-able (an
+            # up-to-date holder can serve), never the client's
+            # answer.
+            return "stale"
         if err in ("AdmissionError", "DrainingError"):
             if "poisoned" in msg:
                 return "poisoned"
@@ -886,6 +1234,7 @@ class FleetRouter:
                       "generation": rep.generation,
                       "port": rep.backend.port}
                      if rep is not None else None)
+            resident = self._resident_stamp(resp)
             with self._lock:
                 if outcome == "served":
                     self.served += 1
@@ -903,6 +1252,7 @@ class FleetRouter:
                 new_traces=(resp or {}).get("new_traces"),
                 failovers=state.get("failovers", 0),
                 replica=stamp,
+                resident=resident,
                 error=(None if (resp or {}).get("ok")
                        else (resp or {}).get("message")))
             if self.history is not None and op not in ("ping",
@@ -916,11 +1266,558 @@ class FleetRouter:
                     matches=(resp or {}).get("matches"),
                     error=(None if (resp or {}).get("ok")
                            else str((resp or {}).get("message"))),
+                    resident=resident,
                     replica=stamp))
         except Exception as exc:  # noqa: BLE001 - bookkeeping boundary
             telemetry.event("fleet_observability_error",
                             request_id=rid,
                             error=f"{type(exc).__name__}: {exc}")
+
+    def _resident_stamp(self, resp) -> Optional[dict]:
+        """The holder/generation stamp for history + flight records:
+        the replica's own resident stamp when present, else the table
+        info of a fan-out response; holder set attached from the
+        directory so `analyze history` can attribute a latency step
+        to a rebuild."""
+        r = (resp or {}).get("resident")
+        fl = (resp or {}).get("fleet") or {}
+        stamp = None
+        if isinstance(r, dict) and r.get("table") is not None \
+                and r.get("generation") is not None:
+            stamp = dict(r)
+        elif fl.get("table") is not None \
+                and fl.get("table_generation") is not None:
+            stamp = {"table": fl["table"],
+                     "generation": fl["table_generation"]}
+        if stamp is not None:
+            with self._lock:
+                entry = self._tables.get(stamp["table"])
+                if entry is not None:
+                    stamp["holders"] = sorted(entry["holders"])
+        return stamp
+
+    # -- replicated resident state (table_replication > 1) ------------
+
+    def _holder_slots(self, key: str) -> list:
+        """Ring order for a table key — registration picks the first
+        K LIVE slots from here, so the primary holder is exactly the
+        slot probe-only joins ring-start on."""
+        n = len(self.replicas)
+        start = int(key[:8], 16) % max(n, 1)
+        return [(start + k) % n for k in range(n)]
+
+    def _send_table_op(self, rep: _Replica, req: dict,
+                       rid: str) -> Optional[dict]:
+        """One table-op leg of a fan-out: direct wire send to one
+        holder. ``None`` = connection-dead (struck, failover-able);
+        a dict is the holder's answer, structured refusals
+        included."""
+        with self._lock:
+            rep.inflight += 1
+        gen0 = rep.generation
+        try:
+            client = ServiceClient(
+                *rep.addr(),
+                timeout_s=self.config.request_deadline_s)
+            try:
+                return client.send({**req, "request_id": rid})
+            finally:
+                client.close()
+        except (OSError, ValueError) as exc:
+            self._strike(rep, f"table op {req.get('op')} {rid}: "
+                              f"{type(exc).__name__}: {exc}")
+            return None
+        finally:
+            with self._lock:
+                if rep.generation == gen0:
+                    rep.inflight = max(rep.inflight - 1, 0)
+
+    def _table_fanout(self, req: dict, rid: str, key: str,
+                      state: dict) -> dict:
+        op = req["op"]
+        name = str(req["name"])
+        if op == "register":
+            return self._register_fanout(req, rid, name, key, state)
+        if op == "append":
+            return self._append_fanout(req, rid, name, key, state)
+        return self._drop_fanout(req, rid, name, key, state)
+
+    def _register_fanout(self, req, rid, name, key, state) -> dict:
+        """Register on the first K live ring slots; write the durable
+        manifest; record the holder set in the directory. A
+        structured refusal from any holder (duplicate name, schema)
+        aborts the fan-out, rolls back the holders already
+        registered, and passes the refusal through — registration is
+        all-or-nothing."""
+        want = min(self.config.table_replication,
+                   len(self.replicas))
+        results: list = []
+        for idx in self._holder_slots(key):
+            if len(results) >= want:
+                break
+            rep = self.replicas[idx]
+            with self._lock:
+                if rep.state not in ("healthy", "suspect"):
+                    continue
+            state["attempts"] += 1
+            state["replica"] = rep
+            resp = self._send_table_op(rep, req, rid)
+            if resp is None:
+                continue
+            if not resp.get("ok"):
+                for prep, _ in results:
+                    self._send_table_op(
+                        prep, {"op": "drop", "name": name},
+                        f"{rid}-rollback")
+                return {**resp, "request_id": rid}
+            results.append((rep, resp))
+        if not results:
+            raise NoHolderError(
+                f"register {name!r}: no live replica accepted the "
+                f"registration (wanted {want} holder(s) of "
+                f"{len(self.replicas)} slots)")
+        holders = {rep.index: {"state": "serving",
+                               "generation":
+                                   int(r.get("generation", 1))}
+                   for rep, r in results}
+        gen = max(h["generation"] for h in holders.values())
+        primary = results[0][1]
+        with self._lock:
+            self._tables[name] = {
+                "generation": gen,
+                "key": primary.get("key", "key"),
+                "holders": holders,
+            }
+        self._write_manifest_register(name, req, primary)
+        self._save_directory()
+        telemetry.event("fleet_table_registered", table=name,
+                        holders=sorted(holders), generation=gen)
+        resp = dict(primary)
+        resp["fleet"] = {
+            "table": name,
+            "holders": sorted(holders),
+            "table_generation": gen,
+            "attempts": state["attempts"],
+            "failovers": 0,
+        }
+        return resp
+
+    def _append_fanout(self, req, rid, name, key, state) -> dict:
+        """Apply one delta to EVERY holder. A holder the delta does
+        not reach (dead, injected fault, mid-rebuild) is fenced
+        STALE — it can never catch up by later appends, so it stops
+        serving probe-only work until a rebuild replays the manifest.
+        The delta also lands in the manifest, so rebuilds and
+        late-joining holders replay it."""
+        with self._lock:
+            entry = self._tables.get(name)
+        if entry is None:
+            raise NoHolderError(
+                f"append to {name!r}: table is not in the fleet "
+                "directory (never registered through this router, "
+                "or already dropped)")
+        outcomes: dict = {}
+        for idx in sorted(entry["holders"]):
+            rep = self.replicas[idx]
+            hstate = entry["holders"][idx]
+            with self._lock:
+                live = rep.state in ("healthy", "suspect")
+            if not live or hstate["state"] == "rebuilding":
+                # Unreachable or mid-rebuild: the manifest carries
+                # the delta to it (rebuild replays; a dead slot's
+                # replacement rebuilds on arrival).
+                continue
+            state["attempts"] += 1
+            state["replica"] = rep
+            outcomes[idx] = self._send_table_op(rep, req, rid)
+        ok_items = {i: r for i, r in outcomes.items()
+                    if r is not None and r.get("ok")}
+        if not ok_items:
+            refusals = [r for r in outcomes.values()
+                        if r is not None]
+            if refusals:
+                # Deterministic client refusal (schema mismatch,
+                # unknown table): every holder answered the same —
+                # pass it through, fence nothing.
+                return {**refusals[0], "request_id": rid}
+            raise NoHolderError(
+                f"append to {name!r}: no live holder reachable "
+                f"(holder set {sorted(entry['holders'])})")
+        gen = max(int(r.get("generation", 0))
+                  for r in ok_items.values())
+        for idx, hstate in entry["holders"].items():
+            if idx in ok_items:
+                hstate["state"] = "serving"
+                hstate["generation"] = \
+                    int(ok_items[idx]["generation"])
+            elif hstate["state"] not in ("rebuilding", "stale"):
+                hstate["state"] = "stale"
+                telemetry.event("fleet_holder_stale", table=name,
+                                replica=idx,
+                                holder_generation=
+                                hstate["generation"],
+                                required_generation=gen)
+                self.recorder.record(
+                    request_id=rid, op="append", signature=key,
+                    outcome="holder_stale",
+                    replica={"index": idx,
+                             "generation":
+                                 self.replicas[idx].generation},
+                    resident={"table": name,
+                              "generation": hstate["generation"]})
+        entry["generation"] = gen
+        self._append_manifest_delta(name, req, gen)
+        self._save_directory()
+        if len(ok_items) < len(entry["holders"]):
+            telemetry.event("fleet_append_partial", table=name,
+                            applied=sorted(ok_items),
+                            holders=sorted(entry["holders"]),
+                            generation=gen)
+        primary = ok_items[min(ok_items)]
+        resp = dict(primary)
+        resp["fleet"] = {
+            "table": name,
+            "holders": sorted(entry["holders"]),
+            "applied": sorted(ok_items),
+            "table_generation": gen,
+            "attempts": state["attempts"],
+            "failovers": 0,
+        }
+        return resp
+
+    def _drop_fanout(self, req, rid, name, key, state) -> dict:
+        with self._lock:
+            entry = self._tables.pop(name, None)
+        if entry is None:
+            raise NoHolderError(
+                f"drop {name!r}: table is not in the fleet "
+                "directory")
+        dropped = []
+        for idx in sorted(entry["holders"]):
+            rep = self.replicas[idx]
+            with self._lock:
+                live = rep.state in ("healthy", "suspect")
+            if not live:
+                continue
+            state["attempts"] += 1
+            state["replica"] = rep
+            resp = self._send_table_op(rep, req, rid)
+            if resp is not None and resp.get("ok"):
+                dropped.append(idx)
+        self._drop_manifest(name)
+        self._save_directory()
+        telemetry.event("fleet_table_dropped", table=name,
+                        holders=sorted(entry["holders"]),
+                        reached=dropped)
+        # Idempotent by intent: the directory entry and manifest are
+        # gone even if a dead holder could not be reached — its
+        # replacement rebuilds from the manifest set, which no
+        # longer includes this table.
+        return {"ok": True, "op": "drop", "table": name,
+                "dropped": True, "request_id": rid,
+                "fleet": {"table": name,
+                          "holders": sorted(entry["holders"]),
+                          "applied": dropped,
+                          "attempts": state["attempts"],
+                          "failovers": 0}}
+
+    def _mark_holder_stale(self, table, index: int) -> None:
+        if table is None:
+            return
+        with self._lock:
+            entry = self._tables.get(str(table))
+            if entry is None:
+                return
+            hstate = entry["holders"].get(index)
+            if hstate is None or hstate["state"] == "stale":
+                return
+            hstate["state"] = "stale"
+        telemetry.event("fleet_holder_stale", table=str(table),
+                        replica=index,
+                        holder_generation=hstate["generation"],
+                        required_generation=entry["generation"])
+        self._save_directory()
+
+    def _rebuild_holder_tables(self, rep: _Replica) -> None:
+        """Replacement arrived on a holder slot: replay every table
+        the slot holds from its durable manifest (rebuilding ->
+        serving lifecycle). Warm probe-only programs reload from the
+        AOT persist dir, so the rebuilt image serves repeat
+        signatures with zero new traces."""
+        with self._lock:
+            todo = [name for name, e in self._tables.items()
+                    if rep.index in e["holders"]]
+        for name in todo:
+            self._rebuild_one(rep, name)
+
+    def _rebuild_one(self, rep: _Replica, name: str) -> None:
+        with self._lock:
+            entry = self._tables.get(name)
+            holder = (entry or {}).get("holders",
+                                       {}).get(rep.index)
+            if holder is None:
+                return
+            holder["state"] = "rebuilding"
+        self._save_directory()
+        telemetry.event("fleet_holder_rebuilding", table=name,
+                        replica=rep.index,
+                        generation_target=entry["generation"])
+        manifest = (load_table_manifest(self._coord_dir, name)
+                    if self._coord_dir else None)
+        if manifest is None:
+            with self._lock:
+                holder["state"] = "stale"
+            telemetry.event("fleet_rebuild_no_manifest",
+                            table=name, replica=rep.index)
+            self._save_directory()
+            return
+        t0 = time.perf_counter()
+        # Deltas replay with maintain=True: the LSM merge runs INSIDE
+        # the rebuild, so the rebuilt image is the same merged shape
+        # the surviving holders serve (merge programs are trace-only —
+        # no AOT blob — and a merge deferred to the first probe-only
+        # join would cost that join its zero-trace warm gate).
+        ops = ([dict(manifest["register"], replace=True)]
+               + [dict(d, maintain=True)
+                  for d in manifest.get("deltas", [])])
+        gen = 0
+        step = 0
+        for _catchup_round in range(3):
+            for op_req in ops:
+                rid = (f"rebuild-{_table_slug(name)}-r{rep.index}"
+                       f"g{rep.generation}-{step}")
+                step += 1
+                resp = self._send_table_op(rep, op_req, rid)
+                if resp is None or not resp.get("ok"):
+                    with self._lock:
+                        holder["state"] = "stale"
+                    telemetry.event(
+                        "fleet_rebuild_failed", table=name,
+                        replica=rep.index, step=step - 1,
+                        error=(resp or {}).get("message")
+                        or (resp or {}).get("error")
+                        or "connection failed")
+                    self._save_directory()
+                    return
+                gen = int(resp.get("generation", 0))
+            with self._lock:
+                target = entry["generation"]
+            if gen >= target:
+                break
+            # An append fanned out WHILE we replayed: it skipped this
+            # rebuilding slot (the fan-out never waits on a rebuild)
+            # but landed in the manifest. Reload and replay the tail
+            # instead of parking the fresh image stale — stale here
+            # would silently degrade the table to K-1 durability for
+            # the rest of this incarnation. deltas[k] produces
+            # generation k+2 (register is 1), so after reaching
+            # ``gen`` the unapplied tail starts at deltas[gen-1].
+            manifest = (load_table_manifest(self._coord_dir, name)
+                        if self._coord_dir else None)
+            tail = (manifest or {}).get("deltas",
+                                        [])[max(gen - 1, 0):]
+            if not tail:
+                break
+            ops = [dict(d, maintain=True) for d in tail]
+        elapsed = time.perf_counter() - t0
+        with self._lock:
+            holder["generation"] = gen
+            if gen >= entry["generation"]:
+                holder["state"] = "serving"
+            else:
+                # The manifest was behind the directory (a lost
+                # write): refuse to serve a silently-short image.
+                holder["state"] = "stale"
+            self.rebuilds_total += 1
+        telemetry.event("fleet_holder_rebuilt", table=name,
+                        replica=rep.index, generation=gen,
+                        state=holder["state"],
+                        elapsed_s=round(elapsed, 3))
+        self.recorder.record(
+            request_id=f"rebuild-{_table_slug(name)}-r{rep.index}",
+            op="rebuild",
+            signature=self.affinity_key({"op": "register",
+                                         "name": name}),
+            outcome="rebuilt", elapsed_s=round(elapsed, 6),
+            resident={"table": name, "generation": gen,
+                      "holders": sorted(entry["holders"])},
+            replica={"index": rep.index,
+                     "generation": rep.generation})
+        if self.history is not None:
+            self.history.append(tel_history.request_entry(
+                request_id=(f"rebuild-{_table_slug(name)}"
+                            f"-r{rep.index}"),
+                op="rebuild",
+                signature=self.affinity_key({"op": "register",
+                                             "name": name}),
+                outcome="rebuilt", wall_s=elapsed,
+                resident={"table": name, "generation": gen,
+                          "holders": sorted(entry["holders"])},
+                replica={"index": rep.index,
+                         "generation": rep.generation,
+                         "port": getattr(rep.backend, "port",
+                                         None)}))
+        self._save_directory()
+
+    # -- the durable router directory + HA adoption -------------------
+
+    def _save_directory(self) -> None:
+        """Mirror the in-memory replica/table directory to the coord
+        dir (kind ``router_directory``), fence-counted and stamped
+        with the lease epoch. Only an actively-serving router writes
+        — a standby or fenced-out incarnation never clobbers the
+        primary's view."""
+        coord = self._coord_dir
+        if coord is None:
+            return
+        if not (self._replicated or self._lease is not None):
+            return
+        if self.role in ("standby", "fenced"):
+            return
+        if self._lease is not None:
+            # Write-time fence: re-read the lease file. A crashed or
+            # stalled ex-primary whose renewer hasn't (or can never)
+            # flip its role must not clobber the directory the NEW
+            # primary is writing — ownership at the moment of the
+            # write is what authorizes the write.
+            doc0 = self._lease.read()
+            if doc0 is not None and (
+                    doc0.get("owner") != self._lease.owner
+                    or int(doc0.get("epoch") or 0)
+                    != self._lease.epoch):
+                self.role = "fenced"
+                telemetry.event(
+                    "fleet_directory_write_fenced",
+                    owner=self._lease.owner,
+                    lease_owner=doc0.get("owner"),
+                    lease_epoch=doc0.get("epoch"))
+                return
+        with self._lock:
+            self._directory_fence += 1
+            doc = {
+                "kind": "router_directory",
+                "schema_version": ROUTER_DIRECTORY_SCHEMA_VERSION,
+                "fence": self._directory_fence,
+                "lease_epoch": (self._lease.epoch
+                                if self._lease is not None else 0),
+                "written_by": self.config.router_id or "router",
+                "table_replication":
+                    self.config.table_replication,
+                "updated_unix_s": time.time(),
+                "tables": {
+                    name: {
+                        "generation": e["generation"],
+                        "key": e.get("key", "key"),
+                        "holders": {str(i): dict(h) for i, h
+                                    in e["holders"].items()},
+                    } for name, e in self._tables.items()
+                },
+                "replicas": [
+                    {"index": r.index,
+                     "host": getattr(r.backend, "host", None),
+                     "port": getattr(r.backend, "port", None),
+                     "generation": r.generation,
+                     "state": r.state}
+                    for r in self.replicas
+                ],
+            }
+        try:
+            atomic_write_json(router_directory_path(coord), doc)
+        except OSError as exc:
+            telemetry.event("fleet_directory_write_failed",
+                            error=f"{type(exc).__name__}: {exc}")
+
+    def adopt_from_directory(self) -> bool:
+        """Standby takeover: rebuild the replica set and table
+        directory from the durable ``router_directory.json`` written
+        by the dead primary. Replica endpoints attach WITHOUT process
+        handles (:class:`AttachedReplica`) — liveness is re-judged on
+        the wire by the prober, and a dead slot drains and respawns
+        through this router's own factory."""
+        coord = self._coord_dir
+        doc = load_router_directory(coord) if coord else None
+        if doc is None:
+            return False
+        with self._lock:
+            self.replicas = [
+                _Replica(
+                    index=int(spec["index"]),
+                    backend=AttachedReplica(
+                        spec.get("host") or "127.0.0.1",
+                        int(spec["port"])),
+                    generation=int(spec.get("generation") or 0),
+                    state=str(spec.get("state") or "healthy"))
+                for spec in doc.get("replicas", [])
+                if spec.get("port") is not None
+            ]
+            self._tables = {
+                name: {
+                    "generation": int(e["generation"]),
+                    "key": e.get("key", "key"),
+                    "holders": {int(i): dict(h) for i, h
+                                in (e.get("holders") or {}).items()},
+                } for name, e in (doc.get("tables") or {}).items()
+            }
+            self._directory_fence = int(doc.get("fence") or 0)
+        telemetry.event("fleet_directory_adopted",
+                        replicas=len(self.replicas),
+                        tables=sorted(self._tables),
+                        fence=self._directory_fence)
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        daemon=True,
+                                        name="fleet-prober")
+        self._prober.start()
+        return True
+
+    def _write_manifest_register(self, name, req, resp) -> None:
+        if not self._coord_dir:
+            return
+        spec = {k: v for k, v in req.items() if k != "request_id"}
+        doc = table_manifest_doc(
+            name, spec, resp.get("key", "key"),
+            int(resp.get("generation", 1)), [],
+            {"replica_ranks": self.config.replica_ranks,
+             "table_replication": self.config.table_replication})
+        try:
+            atomic_write_json(
+                table_manifest_path(self._coord_dir, name), doc)
+        except OSError as exc:
+            telemetry.event("fleet_manifest_write_failed",
+                            table=name,
+                            error=f"{type(exc).__name__}: {exc}")
+
+    def _append_manifest_delta(self, name, req,
+                               generation: int) -> None:
+        if not self._coord_dir:
+            return
+        man = load_table_manifest(self._coord_dir, name)
+        if man is None:
+            # Without the register spec the delta cannot be made
+            # durable — loud, because a rebuild of this table now
+            # CANNOT reach the new generation.
+            telemetry.event("fleet_manifest_missing", table=name,
+                            generation=generation)
+            return
+        spec = {k: v for k, v in req.items() if k != "request_id"}
+        doc = table_manifest_doc(
+            name, man["register"], man.get("key", "key"),
+            generation, list(man.get("deltas") or []) + [spec],
+            man.get("prep") or {})
+        try:
+            atomic_write_json(
+                table_manifest_path(self._coord_dir, name), doc)
+        except OSError as exc:
+            telemetry.event("fleet_manifest_write_failed",
+                            table=name,
+                            error=f"{type(exc).__name__}: {exc}")
+
+    def _drop_manifest(self, name) -> None:
+        if not self._coord_dir:
+            return
+        try:
+            os.remove(table_manifest_path(self._coord_dir, name))
+        except OSError:
+            pass
 
     # -- operator surfaces --------------------------------------------
 
@@ -974,6 +1871,16 @@ class FleetRouter:
                 "shed_total": self.shed_total,
                 "replaced_total": self.replaced_total,
                 "drains_total": self.drains_total,
+                "rebuilds_total": self.rebuilds_total,
+                "takeovers_total": self.takeovers_total,
+                "router_role": self.role,
+                "table_replication":
+                    self.config.table_replication,
+                "tables": {
+                    name: {"generation": e["generation"],
+                           "holders": {str(i): dict(h) for i, h
+                                       in e["holders"].items()}}
+                    for name, e in self._tables.items()},
                 "served": self.served,
                 "failed_requests": self.failed,
                 "rejected": self.rejected,
@@ -985,7 +1892,7 @@ class FleetRouter:
 
     def prometheus_metrics(self) -> str:
         st = self.stats()
-        return self.live.to_prometheus(gauges={
+        text = self.live.to_prometheus(gauges={
             "fleet_replicas": st["replicas"],
             "fleet_healthy": st["healthy"],
             "fleet_suspect": st["suspect"],
@@ -994,7 +1901,28 @@ class FleetRouter:
             "fleet_shed_total": st["shed_total"],
             "fleet_replaced_total": st["replaced_total"],
             "fleet_drains_total": st["drains_total"],
+            "fleet_rebuilds_total": st["rebuilds_total"],
+            "router_takeovers_total": st["takeovers_total"],
+            # 1 = actively serving (single/primary), 0 = standby or
+            # fenced out.
+            "router_role": (1 if st["router_role"] in ("single",
+                                                       "primary")
+                            else 0),
         })
+        if not st["tables"]:
+            return text
+        # Labeled per-table gauge: serving-holder count (the fleet's
+        # effective replication factor per table, live).
+        lines = [text.rstrip("\n"),
+                 "# TYPE djtpu_fleet_resident_holders gauge"]
+        for name in sorted(st["tables"]):
+            holders = st["tables"][name]["holders"]
+            serving = sum(1 for h in holders.values()
+                          if h.get("state") == "serving")
+            lines.append(
+                f'djtpu_fleet_resident_holders{{table="{name}"}} '
+                f"{serving}")
+        return "\n".join(lines) + "\n"
 
     def metrics_snapshot(self) -> dict:
         snap = self.live.snapshot()
@@ -1082,9 +2010,20 @@ def start_router_daemon(router: FleetRouter, host: str = "127.0.0.1",
                         port: int = 0):
     """Bind + serve the fleet wire on a background thread; returns
     ``(server, port)``. Same line-JSON protocol as one daemon."""
+    import socket
     import socketserver
 
     class Handler(socketserver.StreamRequestHandler):
+        def setup(self):
+            super().setup()
+            with self.server._conns_lock:
+                self.server._conns.add(self.connection)
+
+        def finish(self):
+            with self.server._conns_lock:
+                self.server._conns.discard(self.connection)
+            super().finish()
+
         def handle(self):
             for raw in self.rfile:
                 line = raw.decode("utf-8").strip()
@@ -1109,11 +2048,215 @@ def start_router_daemon(router: FleetRouter, host: str = "127.0.0.1",
         allow_reuse_address = True
         daemon_threads = True
 
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._conns: set = set()
+            self._conns_lock = threading.Lock()
+
+        def close_connections(self) -> None:
+            """Sever every ESTABLISHED connection. ``shutdown()``
+            only stops the accept loop — handler threads keep
+            serving open sockets, which is exactly wrong for the
+            crash() path (a killed process tears its sockets, and
+            clients must observe the tear to fail over)."""
+            with self._conns_lock:
+                conns = list(self._conns)
+            for sock in conns:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
     server = Server((host, port), Handler)
     thread = threading.Thread(target=server.serve_forever,
                               daemon=True)
     thread.start()
     return server, server.server_address[1]
+
+
+# -- router HA: fenced lease + standby takeover ------------------------
+
+
+class RouterHA:
+    """Primary/standby pairing for the fleet router (ROADMAP 5b).
+
+    N router processes share the PURE affinity function, the durable
+    table manifests, and the generation-fenced directory file; the
+    fenced lease file (:class:`RouterLease`) elects the ONE serving
+    primary — no consensus protocol. The primary renews the lease on
+    ``lease_renew_s``; a standby polls it and, when it goes stale
+    past ``lease_ttl_s`` (the primary died, or was fenced out),
+    acquires the lease, ADOPTS the replica/table directory, binds the
+    ADVERTISED endpoint, and serves. Clients ride the same
+    reconnect+resend contract as replica failover: idempotent ops
+    resend through their bounded backoff; the duplicate-request fence
+    answers a resent id idempotently on whichever router serves it.
+    """
+
+    def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
+                 port: int = 0, owner: Optional[str] = None):
+        self.router = router
+        self.host = host
+        self.port = port
+        cfg = router.config
+        self.owner = (owner or cfg.router_id
+                      or f"router-{os.getpid()}-"
+                         f"{os.urandom(2).hex()}")
+        cfg.router_id = self.owner
+        coord = router._coord_dir
+        if coord is None:
+            raise FleetError(
+                "router HA needs a coord_dir (or persist_dir) for "
+                "the lease + directory files")
+        os.makedirs(coord, exist_ok=True)
+        self.lease = RouterLease(
+            os.path.join(coord, ROUTER_LEASE_FILENAME),
+            self.owner, ttl_s=cfg.lease_ttl_s)
+        self.server = None
+        self.bound_port: Optional[int] = None
+        self.took_over = threading.Event()
+        self._stop = threading.Event()
+        self._threads: list = []
+
+    # -- primary ------------------------------------------------------
+
+    def start_primary(self, spawn: bool = True) -> int:
+        """Acquire the lease, spawn (or keep) the replica set, bind,
+        advertise the serving addr in the lease, start renewing.
+        Returns the bound port."""
+        if not self.lease.acquire():
+            raise FleetError(
+                f"router {self.owner!r} could not acquire the lease "
+                f"at {self.lease.path} (a live primary holds it)")
+        self.router._lease = self.lease
+        self.router.role = "primary"
+        if spawn:
+            self.router.start()
+        self.server, self.bound_port = start_router_daemon(
+            self.router, self.host, self.port)
+        self.lease._write(self.lease.epoch,
+                          addr=[self.host, self.bound_port])
+        self.router._save_directory()
+        self._start_renewer()
+        telemetry.event("router_primary", owner=self.owner,
+                        port=self.bound_port,
+                        epoch=self.lease.epoch)
+        return self.bound_port
+
+    def _start_renewer(self):
+        t = threading.Thread(target=self._renew_loop, daemon=True,
+                             name=f"router-lease-{self.owner}")
+        self._threads.append(t)
+        t.start()
+
+    def _renew_loop(self):
+        while not self._stop.wait(self.router.config.lease_renew_s):
+            if not self.lease.renew():
+                # Fenced out: a higher epoch landed (another router
+                # took over while this one stalled). Serving on
+                # would split-brain the directory — stand down.
+                self.router.role = "fenced"
+                telemetry.event("router_fenced", owner=self.owner,
+                                epoch=self.lease.epoch)
+                self.router.shutdown_requested.set()
+                return
+
+    # -- standby ------------------------------------------------------
+
+    def start_standby(self) -> None:
+        """Watch the lease; take over when it goes stale."""
+        self.router.role = "standby"
+        t = threading.Thread(target=self._standby_loop, daemon=True,
+                             name=f"router-standby-{self.owner}")
+        self._threads.append(t)
+        t.start()
+        telemetry.event("router_standby", owner=self.owner)
+
+    def _standby_loop(self):
+        while not self._stop.wait(self.router.config.lease_renew_s):
+            doc = self.lease.read()
+            if doc is not None and not self.lease.stale(doc):
+                continue
+            addr = (doc or {}).get("addr")
+            if not self.lease.acquire(addr=addr):
+                continue  # lost the race to another standby
+            try:
+                self._take_over(addr)
+            except Exception as exc:  # noqa: BLE001 - takeover edge
+                telemetry.event(
+                    "router_takeover_failed", owner=self.owner,
+                    error=f"{type(exc).__name__}: {exc}")
+            return
+
+    def _take_over(self, addr):
+        from distributed_join_tpu.parallel.faults import (
+            retry_with_backoff,
+        )
+
+        r = self.router
+        r._lease = self.lease
+        r.adopt_from_directory()
+        r.role = "primary"
+        with r._lock:
+            r.takeovers_total += 1
+        host = addr[0] if addr else self.host
+        port = int(addr[1]) if addr else self.port
+
+        def bind():
+            return start_router_daemon(r, host, port)
+
+        # The dead primary's socket may linger a beat — retry the
+        # bind under the same bounded backoff clients use.
+        (self.server, self.bound_port), _ = retry_with_backoff(
+            bind, max_attempts=20, backoff_s=0.1,
+            retry_on=(OSError,))
+        self.lease._write(self.lease.epoch,
+                          addr=[host, self.bound_port])
+        r._save_directory()
+        self._start_renewer()
+        self.took_over.set()
+        telemetry.event("router_takeover", owner=self.owner,
+                        port=self.bound_port,
+                        epoch=self.lease.epoch)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def crash(self) -> None:
+        """Die like a killed process (test/smoke/chaos helper): stop
+        renewing, close the listening socket, stop the prober —
+        WITHOUT draining, reaping, or releasing anything. The lease
+        goes stale on its own; the replicas belong to the fleet, not
+        to this router incarnation."""
+        self._stop.set()
+        self.router._stop.set()
+        if self.server is not None:
+            self.server.shutdown()
+            self.server.server_close()
+            # A killed process tears its ESTABLISHED sockets too —
+            # without this, in-process handler threads would keep
+            # serving connected clients from beyond the grave (and
+            # those clients would never fail over).
+            self.server.close_connections()
+            self.server = None
+        telemetry.event("router_crashed", owner=self.owner)
+
+    def stop(self, drain: bool = True) -> None:
+        """Graceful teardown: release the lease (instant standby
+        handoff), close the wire, stop the router."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        if self.server is not None:
+            self.server.shutdown()
+            self.server.server_close()
+            self.server = None
+        if self.router.role == "primary":
+            self.lease.release()
+        self.router.stop(drain=drain)
 
 
 # -- the CI smoke ------------------------------------------------------
@@ -1405,6 +2548,424 @@ class FleetSmokeError(RuntimeError):
         self.record = record
 
 
+def run_fleet_ha_smoke(args) -> dict:
+    """The ``fleet_ha`` lane's acceptance protocol (docs/FLEET.md
+    "Replication & HA"), end to end through subprocess replicas, the
+    durable coord dir, and TWO router incarnations:
+
+    1. K=2 registration: both replicas hold the table (directory +
+       versioned manifest on disk), one append lands on both holders
+       (generation 2 everywhere);
+    2. probe-only cold/warm discipline: the warm repeat adds zero
+       traces at the correct generation;
+    3. ONE SCRIPTED HOLDER KILL (SIGKILL of the table's primary
+       holder): the immediate probe-only repeat fails over to the
+       surviving holder within the retry budget, oracle-equal;
+    4. the replacement REBUILDS its image from the manifest
+       (``rebuilding -> serving``), and a direct fenced replay on it
+       answers warm — ZERO new traces at generation 2;
+    5. ONE SCRIPTED ROUTER KILL: the standby takes the fenced lease,
+       adopts the directory, binds the SAME advertised endpoint, and
+       the client's reconnect+resend gets the pre-fault signature
+       served warm at the correct generation;
+    6. a post-takeover append still reaches BOTH holders (generation
+       3), and the final probe-only join is oracle-equal over
+       register + both deltas.
+
+    Returns the JSON record (kind ``fleet_ha_smoke``) whose
+    deterministic counter signature the perfgate lane gates against
+    ``results/baselines/fleet_ha_smoke.json``.
+    """
+    import tempfile
+
+    violations: list = []
+    workdir_owned = args.persist_dir is None
+    workdir = args.persist_dir or tempfile.mkdtemp(
+        prefix="djtpu_fleet_ha_smoke_")
+    cfg = FleetConfig(
+        n_replicas=2,
+        replica_ranks=args.replica_ranks,
+        persist_dir=os.path.join(workdir, "programs"),
+        history_dir=(args.history_dir
+                     or os.path.join(workdir, "history")),
+        coord_dir=os.path.join(workdir, "coord"),
+        table_replication=2,
+        # Request-path fault discovery, as in run_fleet_smoke: the
+        # prober must not drain the victim before the failover
+        # attempt counts are graded.
+        probe_interval_s=max(args.probe_interval_s, 5.0),
+        retry_budget=2,
+        max_inflight_per_replica=args.max_inflight,
+        spawn_timeout_s=args.spawn_timeout_s,
+        lease_ttl_s=2.0,
+        lease_renew_s=0.25,
+        flight_recorder_path=args.flight_recorder_path,
+    )
+    factory = process_fleet_factory(cfg,
+                                    platform=args.platform or "cpu")
+    router = FleetRouter(factory, cfg)
+    ha1 = RouterHA(router, owner="router-a")
+    port = ha1.start_primary()
+    # retries=8 spans the takeover gap (lease TTL + poll + settle +
+    # bind) under the client's jittered exponential backoff.
+    client = ServiceClient("127.0.0.1", port, retries=8)
+
+    table = "ha_users"
+    reg = {"op": "register", "name": table, "rows": 4096,
+           "seed": 23, "rand_max": 8192, "unique_keys": True}
+    delta = {"op": "append", "name": table, "rows": 512,
+             "seed": 29, "rand_max": 8192}
+    delta2 = {"op": "append", "name": table, "rows": 256,
+              "seed": 31, "rand_max": 8192}
+    q = {"op": "join", "table": table, "probe_nrows": 2048,
+         "seed": 23, "selectivity": 0.4, "rand_max": 8192,
+         "out_capacity_factor": 3.0}
+
+    def oracle_matches(delta_specs):
+        import pandas as pd
+
+        from distributed_join_tpu.service.server import (
+            _build_from_spec,
+            _probe_from_spec,
+        )
+
+        base = _build_from_spec(reg)
+        frames = [base.to_pandas()]
+        frames += [_build_from_spec(d).to_pandas()
+                   for d in delta_specs]
+
+        class _Stub:
+            wire_spec = {k: reg[k] for k in
+                         ("rows", "seed", "rand_max", "unique_keys")
+                         if reg.get(k) is not None}
+            wire_build_keys = base.columns["key"]
+
+        probe = _probe_from_spec(q, _Stub)
+        return len(pd.concat(frames, ignore_index=True)
+                   .merge(probe.to_pandas(), on="key"))
+
+    standby_router = None
+    ha2 = None
+    crashed_primary = False
+    try:
+        # 1. replicated registration + append.
+        r = client.send(reg)
+        if not r.get("ok"):
+            raise RuntimeError(f"register failed: {r}")
+        reg_holders = r.get("fleet", {}).get("holders") or []
+        reg_gen = int(r.get("generation", -1))
+        if len(reg_holders) != 2:
+            violations.append(
+                f"register landed on {reg_holders}, wanted 2 "
+                "holders")
+        if reg_gen != 1:
+            violations.append(
+                f"register generation {reg_gen} != 1")
+        a = client.send(delta)
+        if not a.get("ok"):
+            raise RuntimeError(f"append failed: {a}")
+        append_gen = int(a.get("generation", -1))
+        append_applied = a.get("fleet", {}).get("applied") or []
+        if append_gen != 2:
+            violations.append(f"append generation {append_gen} != 2")
+        if len(append_applied) != 2:
+            violations.append(
+                f"append applied on {append_applied}, wanted both "
+                "holders")
+
+        # Durable artifacts on disk.
+        man = load_table_manifest(cfg.coord_dir, table)
+        if man is None:
+            violations.append("no table manifest on disk")
+        else:
+            if man.get("generation") != 2:
+                violations.append(
+                    f"manifest generation {man.get('generation')} "
+                    "!= 2")
+            if len(man.get("deltas") or []) != 1:
+                violations.append(
+                    f"manifest holds {len(man.get('deltas') or [])} "
+                    "delta(s), wanted 1")
+            if not man.get("payload_digest"):
+                violations.append("manifest missing payload_digest")
+        dirdoc = load_router_directory(cfg.coord_dir)
+        if dirdoc is None:
+            violations.append("no router directory on disk")
+        elif table not in (dirdoc.get("tables") or {}):
+            violations.append(
+                f"router directory does not list {table!r}")
+
+        # 2. cold/warm probe-only discipline.
+        expected2 = oracle_matches([delta])
+        cold = client.send(q)
+        if not cold.get("ok"):
+            raise RuntimeError(f"cold probe-only join failed: "
+                               f"{cold}")
+        warm = client.send(q)
+        if not warm.get("ok"):
+            raise RuntimeError(f"warm probe-only join failed: "
+                               f"{warm}")
+        for name_, resp_ in (("cold", cold), ("warm", warm)):
+            if resp_["matches"] != expected2:
+                violations.append(
+                    f"{name_} matches {resp_['matches']} != oracle "
+                    f"{expected2}")
+            gen_ = (resp_.get("resident") or {}).get("generation")
+            if gen_ != 2:
+                violations.append(
+                    f"{name_} served at generation {gen_} != 2")
+        if warm["new_traces"] != 0:
+            violations.append(
+                f"warm probe-only repeat traced "
+                f"{warm['new_traces']} new program(s)")
+
+        # 3. THE holder kill: SIGKILL the serving (primary) holder.
+        victim_index = cold["fleet"]["replica"]
+        router.replicas[victim_index].backend.kill()
+        failover = client.send(q)
+        if not failover.get("ok"):
+            violations.append(
+                f"probe-only failover was not served: {failover}")
+        else:
+            if failover["matches"] != expected2:
+                violations.append(
+                    f"failover matches {failover['matches']} != "
+                    f"oracle {expected2}")
+            if failover["fleet"]["replica"] == victim_index:
+                violations.append(
+                    "failover answered from the killed holder")
+            if failover["fleet"]["attempts"] > cfg.retry_budget + 1:
+                violations.append(
+                    f"failover took "
+                    f"{failover['fleet']['attempts']} attempts > "
+                    f"budget {cfg.retry_budget + 1}")
+            fgen = (failover.get("resident") or {}).get("generation")
+            if fgen != 2:
+                violations.append(
+                    f"failover served at generation {fgen} != 2")
+
+        # 4. replacement rebuild from the manifest -> serving, then
+        # a direct FENCED replay answers warm at generation 2.
+        if not router.wait_replaced(victim_index,
+                                    timeout_s=cfg.spawn_timeout_s):
+            violations.append(
+                f"killed holder {victim_index} was not replaced "
+                f"within {cfg.spawn_timeout_s}s")
+        holder_ok = False
+        deadline = time.monotonic() + cfg.spawn_timeout_s
+        while time.monotonic() < deadline:
+            tbl = router.stats()["tables"].get(table) or {}
+            h = (tbl.get("holders") or {}).get(str(victim_index))
+            if h and h["state"] == "serving" \
+                    and h["generation"] == 2:
+                holder_ok = True
+                break
+            time.sleep(0.2)
+        if not holder_ok:
+            violations.append(
+                f"replacement holder {victim_index} never reached "
+                "serving at generation 2")
+        rebuilds = router.stats()["rebuilds_total"]
+        if rebuilds < 1:
+            violations.append("no rebuild counted")
+        replay: dict = {}
+        if holder_ok:
+            direct = ServiceClient(
+                *router.replicas[victim_index].addr(),
+                timeout_s=120.0)
+            try:
+                replay = direct.send(
+                    {**q, "min_generation": 2,
+                     "request_id": "ha-smoke-replay"})
+            finally:
+                direct.close()
+            if not replay.get("ok"):
+                violations.append(
+                    f"rebuilt holder refused the fenced replay: "
+                    f"{replay}")
+            else:
+                if replay["matches"] != expected2:
+                    violations.append(
+                        f"rebuilt replay matches "
+                        f"{replay['matches']} != oracle "
+                        f"{expected2}")
+                if replay["new_traces"] != 0:
+                    violations.append(
+                        "rebuilt holder was not warm: "
+                        f"{replay['new_traces']} new trace(s) — "
+                        "the shared persist dir must hand it the "
+                        "probe-only program")
+                rgen = (replay.get("resident")
+                        or {}).get("generation")
+                if rgen != 2:
+                    violations.append(
+                        f"rebuilt replay served at generation "
+                        f"{rgen} != 2")
+
+        # 5. THE router kill: crash the primary, standby takes over
+        # the same advertised endpoint, the client resends.
+        standby_router = FleetRouter(factory,
+                                     dataclasses.replace(cfg))
+        ha2 = RouterHA(standby_router, owner="router-b")
+        ha2.start_standby()
+        ha1.crash()
+        crashed_primary = True
+        if not ha2.took_over.wait(timeout=cfg.lease_ttl_s * 10
+                                  + 30.0):
+            raise RuntimeError(
+                "standby router never took over the lease")
+        after = client.send({**q,
+                             "request_id": "ha-after-takeover"})
+        if not after.get("ok"):
+            violations.append(
+                f"post-takeover resend was not served: {after}")
+        else:
+            if after["matches"] != expected2:
+                violations.append(
+                    f"post-takeover matches {after['matches']} != "
+                    f"oracle {expected2}")
+            if after["new_traces"] != 0:
+                violations.append(
+                    "post-takeover repeat traced "
+                    f"{after['new_traces']} new program(s)")
+            agen = (after.get("resident") or {}).get("generation")
+            if agen != 2:
+                violations.append(
+                    f"post-takeover served at generation {agen} "
+                    "!= 2")
+        st2 = standby_router.stats()
+        if st2["router_role"] != "primary":
+            violations.append(
+                f"standby role after takeover is "
+                f"{st2['router_role']!r}, wanted 'primary'")
+        if st2["takeovers_total"] != 1:
+            violations.append(
+                f"takeovers_total {st2['takeovers_total']} != 1")
+
+        # 6. the new primary still owns the table: append reaches
+        # both holders; the final probe-only join is oracle-equal
+        # over register + both deltas.
+        a2 = client.send(delta2)
+        if not a2.get("ok"):
+            violations.append(f"post-takeover append failed: {a2}")
+        post_gen = int(a2.get("generation", -1))
+        post_applied = a2.get("fleet", {}).get("applied") or []
+        if post_gen != 3:
+            violations.append(
+                f"post-takeover append generation {post_gen} != 3")
+        if len(post_applied) != 2:
+            violations.append(
+                f"post-takeover append applied on {post_applied}, "
+                "wanted both holders")
+        expected3 = oracle_matches([delta, delta2])
+        final = client.send(q)
+        if not final.get("ok"):
+            violations.append(f"final probe-only join failed: "
+                              f"{final}")
+        else:
+            if final["matches"] != expected3:
+                violations.append(
+                    f"final matches {final['matches']} != oracle "
+                    f"{expected3}")
+            lgen = (final.get("resident") or {}).get("generation")
+            if lgen != 3:
+                violations.append(
+                    f"final served at generation {lgen} != 3")
+
+        prom = standby_router.prometheus_metrics()
+        for needle in ("djtpu_fleet_rebuilds_total",
+                       "djtpu_router_takeovers_total",
+                       "djtpu_router_role",
+                       'djtpu_fleet_resident_holders{table="'):
+            if needle not in prom:
+                violations.append(
+                    f"prometheus exposition missing {needle}")
+    finally:
+        client.close()
+        if ha2 is not None:
+            try:
+                ha2.stop(drain=False)
+            except Exception:  # noqa: BLE001 - teardown boundary
+                pass
+        if not crashed_primary:
+            try:
+                ha1.crash()
+            except Exception:  # noqa: BLE001 - teardown boundary
+                pass
+        # Reap every subprocess replica from BOTH router
+        # incarnations (adopted AttachedReplica endpoints hold no
+        # process handle — the originals do).
+        seen: set = set()
+        for rep_ in (list(router.replicas)
+                     + list(getattr(standby_router, "replicas",
+                                    None) or [])):
+            if id(rep_.backend) in seen:
+                continue
+            seen.add(id(rep_.backend))
+            try:
+                rep_.backend.stop()
+            except Exception:  # noqa: BLE001 - teardown boundary
+                pass
+
+    record = {
+        "kind": "fleet_ha_smoke",
+        "benchmark": "fleet_ha_smoke",
+        "n_ranks": cfg.replica_ranks,
+        "replicas": cfg.n_replicas,
+        "table_replication": cfg.table_replication,
+        "table": table,
+        "matches_expected": expected2,
+        "matches_expected_final": expected3,
+        "killed_holder": victim_index,
+        "failover_attempts": (failover.get("fleet", {})
+                              .get("attempts")),
+        "rebuilds_total": rebuilds,
+        "takeovers_total": st2["takeovers_total"],
+        "coord_dir": cfg.coord_dir,
+        "violations": violations,
+        "counter_signature": {
+            "signature_version": 1,
+            "n_ranks": cfg.replica_ranks,
+            "counters": {
+                "replicas": cfg.n_replicas,
+                "table_replication": cfg.table_replication,
+                "register_holders": len(reg_holders),
+                "register_generation": reg_gen,
+                "append_generation": append_gen,
+                "append_applied": len(append_applied),
+                "matches_cold": cold["matches"],
+                "matches_warm": warm["matches"],
+                "warm_new_traces": warm["new_traces"],
+                "matches_failover": failover.get("matches", -1),
+                "rebuilds_total": rebuilds,
+                "rebuilt_replay_matches": replay.get("matches",
+                                                     -1),
+                "rebuilt_replay_new_traces": replay.get(
+                    "new_traces", -1),
+                "takeovers_total": st2["takeovers_total"],
+                "matches_after_takeover": after.get("matches",
+                                                    -1),
+                "takeover_new_traces": after.get("new_traces",
+                                                 -1),
+                "post_takeover_append_generation": post_gen,
+                "post_takeover_append_applied":
+                    len(post_applied),
+                "matches_final": final.get("matches", -1),
+            },
+        },
+    }
+    if violations:
+        record["workdir"] = workdir
+        raise FleetSmokeError(
+            "fleet HA smoke violations: " + "; ".join(violations),
+            record)
+    if workdir_owned:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return record
+
+
 # -- CLI ---------------------------------------------------------------
 
 
@@ -1457,6 +3018,31 @@ def parse_args(argv=None):
                         "CPU-mesh fleet, scripted replica kill, "
                         "oracle/drain/replace/shed gates) instead of "
                         "serving; JSON record on stdout")
+    p.add_argument("--ha-smoke", action="store_true",
+                   help="run the replication/HA acceptance protocol "
+                        "(K=2 resident table, scripted holder kill "
+                        "with manifest rebuild, scripted router kill "
+                        "with lease takeover) instead of serving; "
+                        "JSON record on stdout")
+    p.add_argument("--coord-dir", default=None, metavar="DIR",
+                   help="SHARED durable coordination dir (table "
+                        "manifests, router directory, router lease); "
+                        "enables the HA tier when set")
+    p.add_argument("--table-replication", type=int, default=1,
+                   metavar="K",
+                   help="resident-table replication factor: "
+                        "register/append fan out to the first K live "
+                        "replicas on the signature ring (1 = legacy "
+                        "single-holder)")
+    p.add_argument("--standby", action="store_true",
+                   help="serve as a STANDBY router: poll the lease "
+                        "in --coord-dir and take over the advertised "
+                        "endpoint when the primary dies")
+    p.add_argument("--router-id", default=None,
+                   help="stable owner id stamped into the lease and "
+                        "directory (default: fleet-<pid>)")
+    p.add_argument("--lease-ttl-s", type=float, default=3.0)
+    p.add_argument("--lease-renew-s", type=float, default=0.5)
     p.add_argument("--json-output", default=None)
     return p.parse_args(argv)
 
@@ -1465,6 +3051,24 @@ def main(argv=None) -> int:
     from distributed_join_tpu.benchmarks import report
 
     args = parse_args(argv)
+    if args.ha_smoke:
+        try:
+            record = run_fleet_ha_smoke(args)
+        except FleetSmokeError as exc:
+            report("fleet HA smoke FAILED", exc.record,
+                   args.json_output)
+            print(str(exc), file=sys.stderr)
+            return 1
+        sig = record["counter_signature"]["counters"]
+        report(
+            f"fleet HA smoke: K={record['table_replication']} "
+            f"holders, holder kill -> failover in "
+            f"{record['failover_attempts']} attempt(s) + rebuild "
+            f"warm ({sig['rebuilt_replay_new_traces']} traces), "
+            f"router kill -> takeover #{record['takeovers_total']} "
+            f"warm ({sig['takeover_new_traces']} traces)",
+            record, args.json_output)
+        return 0
     if args.smoke:
         try:
             record = run_fleet_smoke(args)
@@ -1499,16 +3103,45 @@ def main(argv=None) -> int:
         respawn=not args.no_respawn,
         flight_records=args.flight_records,
         flight_recorder_path=args.flight_recorder_path,
+        coord_dir=args.coord_dir,
+        table_replication=args.table_replication,
+        lease_ttl_s=args.lease_ttl_s,
+        lease_renew_s=args.lease_renew_s,
+        router_id=args.router_id,
     )
     router = FleetRouter(
         process_fleet_factory(cfg, platform=args.platform or "cpu",
                               extra_args=args.replica_arg),
         cfg)
-    router.start()
-    server, port = start_router_daemon(router, args.host, args.port)
-    print(f"join-fleet listening on {args.host}:{port} "
-          f"({cfg.n_replicas} replicas x {cfg.replica_ranks} ranks)",
-          flush=True)
+    ha = None
+    if args.standby:
+        if not args.coord_dir:
+            print("--standby requires --coord-dir", file=sys.stderr)
+            return 2
+        # Standby mode: no replicas are spawned here — on takeover
+        # the fleet is ADOPTED from the durable directory and the
+        # primary's advertised endpoint is re-bound.
+        ha = RouterHA(router, host=args.host, port=args.port,
+                      owner=args.router_id)
+        ha.start_standby()
+        print(f"join-fleet STANDBY watching lease in "
+              f"{args.coord_dir}", flush=True)
+    elif args.coord_dir:
+        ha = RouterHA(router, host=args.host, port=args.port,
+                      owner=args.router_id)
+        port = ha.start_primary()
+        print(f"join-fleet listening on {args.host}:{port} "
+              f"({cfg.n_replicas} replicas x {cfg.replica_ranks} "
+              f"ranks, K={cfg.table_replication}, leased primary)",
+              flush=True)
+    else:
+        router.start()
+        server, port = start_router_daemon(router, args.host,
+                                           args.port)
+        print(f"join-fleet listening on {args.host}:{port} "
+              f"({cfg.n_replicas} replicas x "
+              f"{cfg.replica_ranks} ranks)",
+              flush=True)
     try:
         import signal
 
@@ -1520,9 +3153,12 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
-        server.shutdown()
-        server.server_close()
-        router.stop()
+        if ha is not None:
+            ha.stop()
+        else:
+            server.shutdown()
+            server.server_close()
+            router.stop()
     return 0
 
 

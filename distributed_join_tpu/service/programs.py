@@ -79,6 +79,33 @@ def _canon(v):
     return repr(v)
 
 
+def spec_digest(doc) -> str:
+    """Stable content digest of a JSON-ish document (dicts, lists,
+    scalars), through the same canonicalizer the program-cache
+    signatures use. Table manifests (docs/FLEET.md) stamp their
+    register spec / delta payloads with this so a rebuilt holder can
+    prove it replayed the same rows the original held."""
+    canon = _canon(doc)
+    return hashlib.sha256(
+        json.dumps(canon, sort_keys=True, default=str).encode()
+    ).hexdigest()
+
+
+def atomic_write_json(path: str, doc) -> str:
+    """Crash-safe JSON write: tmp file + ``os.replace`` so a reader
+    never observes a torn document. The same discipline the program
+    persist tier and the join manifest use; table manifests and the
+    router directory (service/fleet.py) share it."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
 def _schema_of(table) -> tuple:
     """(name, dtype, trailing-dims) triples, name-sorted — the aval
     identity of a Table (or a Table of ShapeDtypeStructs) minus the

@@ -112,6 +112,16 @@ class ResidentError(RuntimeError):
     check failing on a prep/merge pass. Never a wrong answer."""
 
 
+class StaleGenerationError(ResidentError):
+    """Generation fence (docs/FAILURE_SEMANTICS.md, replication
+    contract): this holder's image is at a LOWER generation than the
+    fleet directory requires — it missed an ``append`` fan-out. A
+    stale image would serve rows that silently exclude the missed
+    delta, so probe-only work is refused loudly instead; the router
+    treats the refusal as a failover signal and retries the request
+    on an up-to-date holder."""
+
+
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 

@@ -1343,6 +1343,7 @@ class _Handler(socketserver.StreamRequestHandler):
             name = str(req["table"])
             from distributed_join_tpu.service.resident import (
                 ResidentError,
+                StaleGenerationError,
             )
 
             try:
@@ -1353,6 +1354,20 @@ class _Handler(socketserver.StreamRequestHandler):
                 service.note_refused_resident(
                     name, req.get("request_id"), exc)
                 raise
+            ming = req.get("min_generation")
+            if ming is not None and handle.generation < int(ming):
+                # Generation fence (docs/FAILURE_SEMANTICS.md): this
+                # holder missed an append fan-out — serving now would
+                # silently exclude the missed delta. Refuse loudly;
+                # the fleet router retries on an up-to-date holder.
+                exc = StaleGenerationError(
+                    f"resident table {name!r} is at generation "
+                    f"{handle.generation} < required {int(ming)} "
+                    "(this holder missed an append); probe-only "
+                    "serving refused — retry on an up-to-date holder")
+                service.note_refused_resident(
+                    name, req.get("request_id"), exc)
+                raise exc
             probe = _probe_from_spec(req, handle)
             t0 = time.perf_counter()
             res = service.resident_join(
@@ -2189,7 +2204,13 @@ def run_smoke(service: JoinService, args) -> dict:
     # Resident A/B: in-process against the same (still-live) service
     # object — the TCP loop above is untouched, and every drill join
     # runs with_metrics=False so the baseline-gated counter block
-    # stays the batched join's.
+    # stays the batched join's. The wire shutdown above closed the
+    # admission window (service.draining = "shutdown"); reopen it for
+    # the in-process drills — a real process would have exited, and
+    # the drills stand in for a fresh incarnation sharing the warm
+    # caches.
+    with service._admit_lock:
+        service.draining = None
     resident_drill = _resident_drill(service, args, violations)
 
     drill = _poison_drill(service.comm.n_ranks, args)

@@ -1110,6 +1110,52 @@ def check_file(path: str) -> list:
         elif "counter_signature" in doc:
             problems.append("counter_signature is not an object")
         return problems
+    elif name.startswith("fleet_ha_smoke") or \
+            doc.get("kind") == "fleet_ha_smoke":
+        # The fleet replication/HA CI smoke record (service/fleet.py
+        # run_fleet_ha_smoke): K=2 resident table, scripted holder
+        # kill with manifest rebuild, scripted router kill with lease
+        # takeover; deterministic counter signature gated against
+        # results/baselines/fleet_ha_smoke.json.
+        for key in ("kind", "n_ranks", "replicas",
+                    "table_replication", "counter_signature",
+                    "rebuilds_total", "takeovers_total"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        sig = doc.get("counter_signature")
+        if isinstance(sig, dict):
+            if not isinstance(sig.get("counters"), dict):
+                problems.append("counter_signature missing "
+                                "'counters'")
+        elif "counter_signature" in doc:
+            problems.append("counter_signature is not an object")
+        return problems
+    elif name.endswith(".manifest.json") or \
+            doc.get("kind") == "table_manifest":
+        # A durable resident-table manifest (service/fleet.py,
+        # docs/FAILURE_SEMANTICS.md "Replication & durability
+        # contract"): the versioned register spec + ordered delta
+        # specs a replacement holder replays to rebuild its image.
+        for key in ("kind", "schema_version", "name", "generation",
+                    "register", "deltas", "payload_digest"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        if not isinstance(doc.get("deltas"), list):
+            problems.append("deltas is not a list")
+        return problems
+    elif name == "router_directory.json" or \
+            doc.get("kind") == "router_directory":
+        # The generation-fenced replica/table directory a standby
+        # router adopts on takeover (service/fleet.py).
+        for key in ("kind", "schema_version", "fence",
+                    "tables", "replicas"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        if not isinstance(doc.get("tables"), dict):
+            problems.append("tables is not an object")
+        if not isinstance(doc.get("replicas"), list):
+            problems.append("replicas is not a list")
+        return problems
     elif name.startswith("fleet_soak") or \
             doc.get("kind") == "fleet_soak":
         # The fleet chaos soak summary (parallel/chaos.py --fleet):

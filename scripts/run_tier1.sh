@@ -108,6 +108,33 @@
 #                                   # replica faulted mid-soak, every
 #                                   # non-refused answer pandas-
 #                                   # oracle-graded)
+#   scripts/run_tier1.sh fleet_ha   # durable resident state + router
+#                                   # HA (docs/FLEET.md "Replication
+#                                   # & HA"): tests/test_fleet_ha.py
+#                                   # (manifest/directory schemas,
+#                                   # generation fencing via a
+#                                   # surgically dropped append,
+#                                   # NoHolderError refusal, rebuild-
+#                                   # from-manifest, lease fencing,
+#                                   # router takeover with request-id-
+#                                   # fenced resend) + the --ha-smoke
+#                                   # subprocess protocol (K=2
+#                                   # replicated register, warm
+#                                   # zero-trace serving, holder
+#                                   # SIGKILL -> bounded failover ->
+#                                   # rebuilt image's fenced ZERO-
+#                                   # trace replay, primary router
+#                                   # crash -> standby takeover ->
+#                                   # idempotent resend, counter
+#                                   # signature gated vs results/
+#                                   # baselines/fleet_ha_smoke.json,
+#                                   # manifest + directory artifacts
+#                                   # schema-checked) + the chaos
+#                                   # --fleet-fault resident-kill
+#                                   # soak (primary HOLDER killed
+#                                   # mid-soak: zero wrong rows,
+#                                   # failover within budget, rebuild
+#                                   # + fenced zero-trace replay)
 #   scripts/run_tier1.sh tuner      # autotuner: -m tuner suite + a
 #                                   # cold/warm driver A/B (warm run
 #                                   # must start at the escalated
@@ -309,6 +336,21 @@ PY
       "$tmp/fleet_smoke.json"
     python -m distributed_join_tpu.telemetry.analyze compare \
       "$tmp/fleet_smoke.json" --baseline fleet_smoke
+    # The HA smoke's counter signature is part of the same gate
+    # (docs/FLEET.md "Replication & HA"): the scripted holder-kill +
+    # router-takeover protocol's deterministic match/trace/generation
+    # counters — a changed fan-out, fence, manifest replay, or lease
+    # protocol moves them. The latency/ordering gates live in the
+    # fleet_ha lane.
+    timeout -k 10 900 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.service.fleet --ha-smoke \
+      --platform cpu --replica-ranks 2 \
+      --json-output "$tmp/fleet_ha_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/fleet_ha_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/fleet_ha_smoke.json" --baseline fleet_ha_smoke
     exit $?
     ;;
   agg)
@@ -538,7 +580,11 @@ for side in ("build", "probe"):
         (side, sh, red)
 assert set(prof["stages"]) == {"partition", "shuffle", "join", "skew"}
 assert prof["stages"]["join"]["counters"]["matches"] == red["matches"]
-assert prof["sum_of_stages_min_s"] >= prof["monolithic"]["wall_min_s"], \
+# 5% noise allowance: on the emulated mesh the two mins are a
+# near-tie and scheduler jitter can flip the sign of a sub-ms gap
+# (same allowance as tests/test_stageprof.py's min-wall gate).
+assert prof["sum_of_stages_min_s"] >= \
+    0.95 * prof["monolithic"]["wall_min_s"], \
     (prof["sum_of_stages_min_s"], prof["monolithic"])
 print("stageprof gate: per-stage wire bytes exact, stage set matches "
       "cost.predict,",
@@ -631,6 +677,57 @@ PY
     # no exec: the EXIT trap must still clean $tmp
     python -m distributed_join_tpu.telemetry.analyze check \
       "$tmp/fleet_soak.json"
+    ;;
+  fleet_ha)
+    # Durable replicated resident state + router HA (docs/FLEET.md
+    # "Replication & HA"). 1. tests/test_fleet_ha.py: manifest +
+    # directory artifact schemas, generation fencing (a FaultPlan-
+    # dropped append fences EXACTLY the holder that missed it —
+    # StaleGenerationError on fenced work, honest old-generation
+    # serving without the fence), structured NoHolderError refusal,
+    # rebuild-from-manifest to the acked generation, lease fencing
+    # (live lease not stealable, expired lease stolen, fenced-out
+    # renew refused), router takeover (standby adopts the directory,
+    # request-id-fenced resend — no loss, no double-execution).
+    # 2. the --ha-smoke subprocess protocol: K=2 replicated register
+    # -> manifest/directory on disk -> warm zero-trace serving ->
+    # holder SIGKILL -> failover within the bounded budget -> the
+    # replacement rebuilds from the manifest and answers the FENCED
+    # replay with zero new traces -> primary router crash -> standby
+    # takeover -> the client's resend answers identically with zero
+    # new traces; counter signature gated vs results/baselines/
+    # fleet_ha_smoke.json; the manifest and router-directory
+    # artifacts are schema-checked. 3. the chaos resident-kill soak:
+    # the table's PRIMARY HOLDER killed mid-soak — zero wrong rows,
+    # failover within budget, rebuild + fenced zero-trace replay
+    # gated inside the harness.
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/test_fleet_ha.py -q --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    tmp="$(mktemp -d /tmp/djtpu_fleet_ha.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    timeout -k 10 900 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.service.fleet --ha-smoke \
+      --platform cpu --replica-ranks 2 \
+      --persist-dir "$tmp/ha" \
+      --json-output "$tmp/fleet_ha_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/fleet_ha_smoke.json" \
+      "$tmp"/ha/coord/tables/*.manifest.json \
+      "$tmp/ha/coord/router_directory.json"
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/fleet_ha_smoke.json" --baseline fleet_ha_smoke
+    timeout -k 10 900 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.parallel.chaos \
+      --fleet 10 --fleet-fault resident-kill --seed 42 \
+      --json-output "$tmp/fleet_ha_soak.json" \
+      --repro-out /tmp/djtpu_fleet_ha_repro
+    # no exec: the EXIT trap must still clean $tmp
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/fleet_ha_soak.json"
     ;;
   tuner)
     # History-driven autotuner (docs/OBSERVABILITY.md "Autotuner").
@@ -773,7 +870,7 @@ PY
     exit $?
     ;;
   *)
-    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|stageprof|tuner|resident|hier|agg|sortpath|fleet]" >&2
+    echo "usage: $0 [tier1|faults|telemetry|analysis|perfgate|lint|chaos|service|stageprof|tuner|resident|hier|agg|sortpath|fleet|fleet_ha]" >&2
     exit 2
     ;;
 esac

@@ -1,0 +1,458 @@
+"""Replicated resident state + router HA (docs/FLEET.md
+"Replication & HA", docs/FAILURE_SEMANTICS.md "Replication &
+durability contract").
+
+What is being locked down, from the bottom up:
+
+- **Durable artifacts.** ``register`` through a K=2 router writes a
+  versioned table manifest and a generation-fenced router directory
+  into the shared coord dir — the exact shapes ``analyze check``
+  validates.
+- **Generation fencing.** A holder that missed an ``append`` (a
+  surgically dropped fan-out leg via ``FaultPlan.drop_dispatches``)
+  is fenced at its stale generation: it REFUSES probe-only work with
+  a structured ``StaleGenerationError`` instead of silently serving
+  rows that exclude the missed delta, and the router fails the
+  request over to the up-to-date sibling.
+- **Holder-set routing.** Table ops route by holder set; when no
+  live holder exists the router refuses loudly with a structured
+  ``NoHolderError`` — never a misroute to a replica that would give
+  a confusing (or worse, wrong) answer.
+- **Rebuild.** A killed holder's replacement rebuilds its image by
+  replaying the manifest (register + deltas, merges folded in), and
+  a generation-fenced replay on it answers oracle-exact.
+- **Router HA.** A standby router takes the fenced lease on primary
+  death, adopts the fleet from the directory, re-binds the SAME
+  advertised endpoint, and serves a resent request-id'd query
+  idempotently; a post-takeover append applies EXACTLY once.
+"""
+
+import dataclasses
+import time
+
+import pytest
+
+from distributed_join_tpu.parallel.faults import (
+    FaultInjectingCommunicator,
+    FaultPlan,
+)
+from distributed_join_tpu.service.fleet import (
+    FleetConfig,
+    FleetRouter,
+    RouterHA,
+    RouterLease,
+    affine_replica,
+    in_process_fleet_factory,
+    load_router_directory,
+    load_table_manifest,
+    start_router_daemon,
+)
+from distributed_join_tpu.service.server import ServiceClient
+from distributed_join_tpu.telemetry.analyze import check_file
+
+pytestmark = pytest.mark.fleet
+
+TABLE = "ha_t"
+REG = {"op": "register", "name": TABLE, "rows": 1024, "seed": 5,
+       "rand_max": 2048, "unique_keys": True}
+DELTA = {"op": "append", "name": TABLE, "rows": 256, "seed": 7,
+         "rand_max": 2048}
+Q = {"op": "join", "table": TABLE, "probe_nrows": 512, "seed": 5,
+     "selectivity": 0.4, "rand_max": 2048,
+     "out_capacity_factor": 3.0}
+
+# The table's primary holder slot — probe-only joins ring-start here,
+# and the K=2 holder set is this slot plus the next.
+VICTIM = affine_replica({"op": "join", "table": TABLE}, 2, 2)
+
+
+def oracle_matches(deltas=()) -> int:
+    import pandas as pd
+
+    from distributed_join_tpu.service.server import (
+        _build_from_spec,
+        _probe_from_spec,
+    )
+
+    base = _build_from_spec(REG)
+    frames = [base.to_pandas()]
+    frames += [_build_from_spec(d).to_pandas() for d in deltas]
+
+    class _Stub:
+        wire_spec = {k: REG[k] for k in
+                     ("rows", "seed", "rand_max", "unique_keys")}
+        wire_build_keys = base.columns["key"]
+
+    probe = _probe_from_spec(Q, _Stub)
+    return len(pd.concat(frames, ignore_index=True)
+               .merge(probe.to_pandas(), on="key"))
+
+
+def make_ha_fleet(tmp_path, *, comm_wrap=None, probe_interval_s=5.0,
+                  **cfg_overrides):
+    """A K=2 in-process fleet with the durable coord dir armed.
+
+    The long probe interval keeps fault discovery on the REQUEST
+    path, so failover attempt counts are deterministic."""
+    cfg = FleetConfig(
+        n_replicas=2, replica_ranks=2,
+        probe_interval_s=probe_interval_s,
+        suspect_strikes=2, retry_budget=2,
+        table_replication=2,
+        coord_dir=str(tmp_path / "coord"),
+        **cfg_overrides)
+    factory = in_process_fleet_factory(
+        2, 2, comm_wrap=comm_wrap,
+        persist_dir=str(tmp_path / "programs"))
+    router = FleetRouter(factory, cfg)
+    router.start()
+    server, port = start_router_daemon(router)
+    client = ServiceClient("127.0.0.1", port)
+    return router, server, client
+
+
+def teardown_fleet(router, server, client):
+    client.close()
+    server.shutdown()
+    server.server_close()
+    router.stop()
+
+
+# -- durable artifacts -------------------------------------------------
+
+
+def test_replicated_register_writes_manifest_and_directory(tmp_path):
+    """K=2 register lands on BOTH ring slots and durably records the
+    table: a versioned manifest (replayable register + delta specs,
+    payload digest) and the generation-fenced router directory —
+    both passing ``analyze check``'s artifact validation."""
+    router, server, client = make_ha_fleet(tmp_path)
+    coord = str(tmp_path / "coord")
+    try:
+        r = client.send(REG)
+        assert r["ok"], r
+        assert r["generation"] == 1
+        assert sorted(r["fleet"]["holders"]) == [0, 1]
+
+        a = client.send(DELTA)
+        assert a["ok"], a
+        assert a["generation"] == 2
+        assert sorted(a["fleet"]["applied"]) == [0, 1]
+
+        man = load_table_manifest(coord, TABLE)
+        assert man is not None
+        assert man["kind"] == "table_manifest"
+        assert man["generation"] == 2
+        assert man["register"]["name"] == TABLE
+        assert len(man["deltas"]) == 1
+        assert man["payload_digest"]
+        from distributed_join_tpu.service.fleet import (
+            table_manifest_path,
+        )
+
+        assert check_file(table_manifest_path(coord, TABLE)) == []
+
+        doc = load_router_directory(coord)
+        assert doc is not None
+        assert doc["kind"] == "router_directory"
+        assert doc["fence"] >= 1
+        assert TABLE in doc["tables"]
+        import os
+
+        assert check_file(
+            os.path.join(coord, "router_directory.json")) == []
+
+        st = router.stats()
+        assert st["table_replication"] == 2
+        holders = st["tables"][TABLE]["holders"]
+        assert {h["state"] for h in holders.values()} == {"serving"}
+        assert {h["generation"] for h in holders.values()} == {2}
+    finally:
+        teardown_fleet(router, server, client)
+
+
+# -- generation fencing ------------------------------------------------
+
+
+def test_missed_append_fences_holder_and_fails_over(tmp_path):
+    """The replication contract's core safety property. One fan-out
+    leg of the ``append`` is DROPPED on the table's primary holder
+    (dispatch #2 of its comm: register prep is #1, append delta prep
+    is #2). The router fences that holder at its stale generation;
+    the probe-only join that ring-starts there is refused with a
+    structured ``StaleGenerationError`` — never rows that silently
+    exclude the delta — and fails over to the up-to-date sibling."""
+
+    def wrap(index, generation, comm):
+        if index == VICTIM and generation == 0:
+            return FaultInjectingCommunicator(
+                comm, FaultPlan(drop_dispatches=(2,)))
+        return comm
+
+    router, server, client = make_ha_fleet(tmp_path, comm_wrap=wrap)
+    try:
+        r = client.send(REG)
+        assert r["ok"], r
+
+        a = client.send(DELTA)
+        # The append still succeeds fleet-wide (the sibling applied
+        # it) but the victim's leg was dropped.
+        assert a["ok"], a
+        assert a["generation"] == 2
+        assert a["fleet"]["applied"] == [1 - VICTIM]
+
+        holders = router.stats()["tables"][TABLE]["holders"]
+        assert holders[str(VICTIM)]["state"] == "stale"
+        assert holders[str(VICTIM)]["generation"] == 1
+        assert holders[str(1 - VICTIM)]["state"] == "serving"
+        assert holders[str(1 - VICTIM)]["generation"] == 2
+
+        expected = oracle_matches([DELTA])
+        j = client.send(Q)
+        assert j["ok"], j
+        assert j["matches"] == expected
+        assert j["fleet"]["replica"] == 1 - VICTIM
+        assert j["resident"]["generation"] == 2
+
+        # The fence itself, observed directly on the stale holder:
+        # a structured refusal, not wrong rows.
+        direct = ServiceClient(*router.replicas[VICTIM].addr())
+        try:
+            refusal = direct.send({**Q, "min_generation": 2})
+        finally:
+            direct.close()
+        assert not refusal["ok"]
+        assert refusal["error"] == "StaleGenerationError"
+        assert "generation 1" in refusal["message"]
+
+        # Unfenced, the stale holder still serves ITS generation
+        # (pre-append rows) — stale reads are refused only when the
+        # router says the directory requires newer.
+        direct = ServiceClient(*router.replicas[VICTIM].addr())
+        try:
+            old = direct.send(Q)
+        finally:
+            direct.close()
+        assert old["ok"], old
+        assert old["matches"] == oracle_matches([])
+    finally:
+        teardown_fleet(router, server, client)
+
+
+# -- holder-set routing ------------------------------------------------
+
+
+def test_no_live_holder_is_a_structured_refusal(tmp_path):
+    """Table ops route by holder set; when the set is empty (never
+    registered) or fully dead (every holder drained), the router
+    refuses loudly with ``NoHolderError`` — not a misroute."""
+    router, server, client = make_ha_fleet(tmp_path)
+    try:
+        # Never registered through this router.
+        a = client.send({"op": "append", "name": "ghost", "rows": 8,
+                         "seed": 1, "rand_max": 64})
+        assert not a["ok"]
+        assert a["error"] == "NoHolderError"
+        assert a["table"] == "ghost"
+
+        # Registered, then every holder drained.
+        r = client.send(REG)
+        assert r["ok"], r
+        for rep in router.replicas:
+            rep.state = "drained"
+        j = client.send(Q)
+        assert not j["ok"]
+        assert j["error"] == "NoHolderError"
+        assert j["table"] == TABLE
+    finally:
+        teardown_fleet(router, server, client)
+
+
+def test_rebuilding_holder_is_not_routed(tmp_path):
+    """A slot mid-rebuild has no image yet: probe-only joins must
+    route around it (to the serving sibling) instead of burning an
+    attempt on its honest ``ResidentError``."""
+    router, server, client = make_ha_fleet(tmp_path)
+    try:
+        r = client.send(REG)
+        assert r["ok"], r
+        want = oracle_matches()
+        entry = router._tables[TABLE]
+        for hidden in (0, 1):
+            entry["holders"][hidden]["state"] = "rebuilding"
+            j = client.send(Q)
+            assert j["ok"], j
+            assert j["matches"] == want
+            assert j["fleet"]["replica"] == 1 - hidden
+            entry["holders"][hidden]["state"] = "serving"
+    finally:
+        teardown_fleet(router, server, client)
+
+
+def test_holder_without_image_fails_over_not_passthrough(tmp_path):
+    """Directory says a slot holds the image, the replica says it
+    does not (here: the image is dropped behind the router's back —
+    the stand-in for a replacement whose rebuild has not landed).
+    That inconsistency is the FLEET's: the holder is parked stale and
+    the request fails over, never surfacing the replica's
+    ``ResidentError`` as if it were the client's answer; the NEXT
+    request gets the structured no-serving-holder refusal."""
+    router, server, client = make_ha_fleet(tmp_path)
+    try:
+        r = client.send(REG)
+        assert r["ok"], r
+        for rep in router.replicas:
+            c = ServiceClient(*rep.addr())
+            try:
+                d = c.send({"op": "drop", "name": TABLE})
+                assert d["ok"], d
+            finally:
+                c.close()
+        j = client.send(Q)
+        assert not j["ok"]
+        assert j["error"] != "ResidentError", j
+        assert j["fleet"]["attempts"] >= 2, j
+        states = {i: h["state"] for i, h
+                  in router._tables[TABLE]["holders"].items()}
+        assert set(states.values()) == {"stale"}, states
+        j2 = client.send(Q)
+        assert not j2["ok"]
+        assert j2["error"] == "NoHolderError", j2
+        assert "no serving holder" in j2["message"], j2
+    finally:
+        teardown_fleet(router, server, client)
+
+
+# -- holder kill -> manifest rebuild -----------------------------------
+
+
+def test_killed_holder_rebuilds_from_manifest(tmp_path):
+    """Kill the table's primary holder AFTER an append: the
+    replacement replays the durable manifest (register + delta with
+    the merge folded in), walks ``rebuilding -> serving``, and a
+    generation-fenced replay on it answers oracle-exact at the
+    directory's generation."""
+    router, server, client = make_ha_fleet(tmp_path)
+    try:
+        assert client.send(REG)["ok"]
+        assert client.send(DELTA)["ok"]
+        expected = oracle_matches([DELTA])
+
+        router.replicas[VICTIM].backend.kill()
+        # The immediate probe-only join fails over within budget.
+        j = client.send(Q)
+        assert j["ok"], j
+        assert j["matches"] == expected
+        assert j["fleet"]["replica"] == 1 - VICTIM
+        assert j["fleet"]["failovers"] >= 1
+
+        assert router.wait_replaced(VICTIM, timeout_s=60.0)
+        deadline = time.monotonic() + 60.0
+        holder = None
+        while time.monotonic() < deadline:
+            holder = (router.stats()["tables"][TABLE]["holders"]
+                      .get(str(VICTIM)))
+            if holder and holder["state"] == "serving":
+                break
+            time.sleep(0.1)
+        assert holder and holder["state"] == "serving", holder
+        assert holder["generation"] == 2
+        assert router.stats()["rebuilds_total"] >= 1
+
+        # The rebuilt image passes the fence and serves the delta.
+        direct = ServiceClient(*router.replicas[VICTIM].addr())
+        try:
+            replay = direct.send({**Q, "min_generation": 2})
+        finally:
+            direct.close()
+        assert replay["ok"], replay
+        assert replay["matches"] == expected
+        assert replay["resident"]["generation"] == 2
+    finally:
+        teardown_fleet(router, server, client)
+
+
+# -- router HA ---------------------------------------------------------
+
+
+def test_lease_is_fenced(tmp_path):
+    """The lease file is a FENCE, not a lock: a second owner can only
+    acquire a stale lease, and the fenced-out first owner's renew
+    fails instead of silently double-writing."""
+    coord = str(tmp_path / "coord")
+    a = RouterLease(coord, owner="a", ttl_s=0.3)
+    b = RouterLease(coord, owner="b", ttl_s=0.3)
+    assert a.acquire()
+    assert not b.acquire(), "a live lease must not be stealable"
+    assert a.renew()
+    time.sleep(0.5)  # let a's lease expire un-renewed
+    assert b.acquire()
+    assert not a.renew(), "the fenced-out owner must notice"
+    assert b.renew()
+
+
+def test_router_takeover_serves_resend_and_single_apply(tmp_path):
+    """Kill the primary router mid-stream: the standby takes the
+    fenced lease, adopts the fleet from the directory, re-binds the
+    SAME advertised endpoint, and the client's retry-armed resend of
+    the SAME request id is served idempotently (equal answer, warm).
+    A post-takeover append applies EXACTLY once — generation moves
+    by exactly one, both holders apply."""
+    cfg = FleetConfig(
+        n_replicas=2, replica_ranks=2,
+        probe_interval_s=5.0, suspect_strikes=2, retry_budget=2,
+        table_replication=2,
+        coord_dir=str(tmp_path / "coord"),
+        lease_ttl_s=1.0, lease_renew_s=0.2)
+    factory = in_process_fleet_factory(
+        2, 2, persist_dir=str(tmp_path / "programs"))
+    router = FleetRouter(factory, cfg)
+    ha1 = RouterHA(router, owner="router-a")
+    port = ha1.start_primary()
+    client = ServiceClient("127.0.0.1", port, retries=8)
+    standby = FleetRouter(factory, dataclasses.replace(cfg))
+    ha2 = None
+    try:
+        assert client.send(REG)["ok"]
+        expected = oracle_matches([])
+        pre = client.send({**Q, "request_id": "ha-pin"})
+        assert pre["ok"], pre
+        assert pre["matches"] == expected
+
+        ha2 = RouterHA(standby, owner="router-b")
+        ha2.start_standby()
+        ha1.crash()
+        assert ha2.took_over.wait(timeout=30.0), \
+            "standby never took over the lease"
+        assert standby.role == "primary"
+        assert standby.takeovers_total == 1
+
+        # Same endpoint, same request id: the reconnecting client's
+        # resend is served — not lost, answer unchanged, zero new
+        # traces (the adopted holders are the SAME warm processes).
+        again = client.send({**Q, "request_id": "ha-pin"})
+        assert again["ok"], again
+        assert again["matches"] == expected
+        assert again["new_traces"] == 0
+
+        # Exactly-once for mutations across the takeover: one append
+        # moves the generation by exactly one, on both holders.
+        a = client.send(DELTA)
+        assert a["ok"], a
+        assert a["generation"] == 2
+        assert sorted(a["fleet"]["applied"]) == [0, 1]
+        holders = standby.stats()["tables"][TABLE]["holders"]
+        assert {h["generation"] for h in holders.values()} == {2}
+    finally:
+        client.close()
+        if ha2 is not None:
+            ha2.stop(drain=False)
+        seen = set()
+        for rep in list(router.replicas) + list(standby.replicas):
+            if id(rep.backend) in seen:
+                continue
+            seen.add(id(rep.backend))
+            try:
+                rep.backend.stop()
+            except Exception:  # noqa: BLE001 - teardown boundary
+                pass
