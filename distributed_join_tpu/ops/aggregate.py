@@ -158,8 +158,12 @@ def resolve_agg_mode(spec: AggregateSpec, keys: Sequence[str],
                      build_cols: dict, probe_cols: dict) -> str:
     """Validate ``spec`` against the join and return the fused mode:
     ``"key"`` (group keys == join keys: reduce in the merged order,
-    partials final per rank) or ``"probe"`` (probe-side group columns:
-    one regroup sort + a partials-only cross-rank exchange).
+    partials final per rank), ``"probe"`` (probe-side group columns:
+    one regroup sort + a partials-only cross-rank exchange), or
+    ``"build"`` (build-side group columns: the probe-mode algebra with
+    sides swapped — per-build-row contributions read the run's PROBE
+    totals through a backward broadcast, then the same regroup sort +
+    partials exchange).
 
     ``build_cols``/``probe_cols`` map column name ->
     ``(dtype_str, ndim)`` — pure schema, so :mod:`..planning.plan`
@@ -215,8 +219,10 @@ def resolve_agg_mode(spec: AggregateSpec, keys: Sequence[str],
             side_of(c, "carry column")
         return "key"
 
-    # probe mode: every group key must be a scalar probe-side column
-    # (join keys exist on the probe side too, so subsets route here).
+    # probe/build mode: every group key must resolve to ONE side's
+    # scalar integer columns (join keys exist on the probe side too,
+    # so key subsets route to probe mode).
+    g_sides = set()
     for g in spec.group_keys:
         if g in keys:
             # a strict subset of a composite key is probe-resolvable
@@ -225,12 +231,16 @@ def resolve_agg_mode(spec: AggregateSpec, keys: Sequence[str],
                 _refuse(f"group key {g!r} (a join key) has no "
                         "probe-side column to regroup by")
             dtype, ndim = probe_cols[g]
+            g_sides.add("p")
+        elif g in probe_cols and g in build_cols:
+            _refuse(f"group key {g!r} exists on BOTH sides — rename "
+                    "one side")
         elif g in probe_cols:
             dtype, ndim = probe_cols[g]
+            g_sides.add("p")
         elif g in build_cols:
-            _refuse(f"group key {g!r} lives on the BUILD side; "
-                    "build-side group-bys are unimplemented — group "
-                    "by the join key and carry the column instead")
+            dtype, ndim = build_cols[g]
+            g_sides.add("b")
         else:
             _refuse(f"group key {g!r} not found")
         if ndim != 1:
@@ -239,12 +249,21 @@ def resolve_agg_mode(spec: AggregateSpec, keys: Sequence[str],
             _refuse(f"group key {g!r} has dtype {dtype}; non-key "
                     "group keys must be integers (hash-partitioned "
                     "partials exchange)")
+    if g_sides == {"b", "p"}:
+        _refuse("group keys span BOTH sides "
+                f"({sorted(spec.group_keys)}); mixed-side group-bys "
+                "are unimplemented — group by one side and carry the "
+                "other side's column when it is key-functional")
+    mode = "build" if g_sides == {"b"} else "probe"
+    want = "p" if mode == "probe" else "b"
     for c in spec.carry:
-        if side_of(c, "carry column") != "p":
-            _refuse(f"carry column {c!r} lives on the build side; "
-                    "under a non-key group-by only probe-side "
+        if side_of(c, "carry column") != want:
+            _refuse(f"carry column {c!r} lives on the "
+                    f"{'build' if want == 'p' else 'probe'} side; "
+                    f"under a {mode}-side group-by only "
+                    f"{'probe' if want == 'p' else 'build'}-side "
                     "carries are functionally sound")
-    return "probe"
+    return mode
 
 
 def partial_lane_schema(spec: AggregateSpec, build_cols: dict,
@@ -301,6 +320,9 @@ def wire_columns(spec: AggregateSpec, mode: str, keys: Sequence[str],
     if mode == "probe":
         for g in spec.group_keys:
             need_p.add(g)
+    elif mode == "build":
+        for g in spec.group_keys:
+            need_b.add(g)
     return (tuple(keys) + tuple(sorted(need_b - set(keys))),
             tuple(keys) + tuple(sorted(need_p - set(keys))))
 
@@ -318,6 +340,7 @@ def partial_columns(spec: AggregateSpec, mode: str,
     cols = []
     for g in group_names:
         d, _ = (probe_cols.get(g) if mode == "probe"
+                else build_cols.get(g) if mode == "build"
                 else build_cols.get(g) or probe_cols.get(g))
         cols.append((g, str(d)))
     for name, _op, _col, dt in partial_lane_schema(spec, build_cols,
@@ -408,6 +431,20 @@ def seg_first(v: jax.Array, flag: jax.Array, seg0: jax.Array):
         flag = jnp.where(take, pf | flag, flag)
         d *= 2
     return v, flag
+
+
+def seg_total(incl: jax.Array, first: jax.Array) -> jax.Array:
+    """Broadcast each segment's TOTAL — the inclusive scan's value at
+    the segment's LAST row — back over the whole segment. Build-mode
+    aggregation needs this: builds precede probes in the merged tag
+    order, so a build position's inclusive scan has not seen its run's
+    probe rows yet. Flip the domain (run-lasts become run-firsts) and
+    reuse :func:`seg_first`; the flipped lane is its own boundary
+    structure."""
+    last = _run_last(first)
+    f = jnp.flip(last)
+    v, _ = seg_first(jnp.flip(incl), f, seg_start(f))
+    return jnp.flip(v)
 
 
 # -- run extraction + compaction ---------------------------------------
@@ -527,6 +564,9 @@ def local_join_aggregate(build: Table, probe: Table,
     if mode == "probe":
         for g in spec.group_keys:
             needed[("p", g)] = None
+    elif mode == "build":
+        for g in spec.group_keys:
+            needed[("b", g)] = None
 
     nb_rows, np_rows = build.capacity, probe.capacity
     bvalid, pvalid = build.valid, probe.valid
@@ -628,6 +668,56 @@ def local_join_aggregate(build: Table, probe: Table,
         groups, valid, g_total, overflow = _compact_runs(
             is_rec, cols, groups_capacity)
         group_names = keys
+    elif mode == "build":
+        # build mode: the probe-mode algebra with sides swapped —
+        # per-BUILD-row contributions. Builds precede probes in the
+        # run, so a build position's inclusive scans have not seen its
+        # run's probe rows; seg_total broadcasts each run's probe
+        # totals backward over the run, then the same regroup sort +
+        # segmented reduce settles the group partials.
+        p_cnt = seg_total(
+            seg_scan(is_probe.astype(jnp.int32), seg0, "sum"), first)
+
+        def probe_total(col, op):
+            v = svals[("p", col)]
+            if op == "sum":
+                acc = jnp.dtype(
+                    v.dtype if jnp.issubdtype(v.dtype, jnp.floating)
+                    else jnp.int64)
+                incl = seg_scan(
+                    jnp.where(is_probe, v.astype(acc),
+                              jnp.zeros((), acc)), seg0, "sum")
+            else:
+                ident = (_sentinel_max(v.dtype) if op == "min"
+                         else _sentinel_min(v.dtype))
+                incl = seg_scan(jnp.where(is_probe, v, ident), seg0,
+                                op)
+            return seg_total(incl, first)
+
+        part = is_build & (p_cnt > 0)
+        lanes = []
+        for lane_name, op, col, dt in lanes_schema:
+            adt = jnp.dtype(dt)
+            if op == "sum" and col is None:
+                contrib = p_cnt.astype(adt)
+            elif op == "sum":
+                if side_of(col) == "b":
+                    contrib = svals[("b", col)].astype(adt) \
+                        * p_cnt.astype(adt)
+                else:
+                    contrib = probe_total(col, "sum").astype(adt)
+            elif op in ("min", "max"):
+                if side_of(col) == "b":
+                    contrib = svals[("b", col)]
+                else:
+                    contrib = probe_total(col, op)
+            else:  # first: build-side carry
+                contrib = svals[("b", col)]
+            lanes.append((lane_name, op, contrib))
+        group_vals = [(g, svals[("b", g)]) for g in spec.group_keys]
+        groups, valid, g_total, overflow = _reduce_sorted(
+            group_vals, lanes, part, groups_capacity)
+        group_names = list(spec.group_keys)
     else:
         # probe mode: per-probe-row contributions in the merged
         # domain, then ONE regroup sort by the group columns (value

@@ -98,6 +98,16 @@ def _dtype_sentinel_max(dt):
 # bootstrap contract (jax.distributed.initialize must run first).
 _I32_MAX = 2**31 - 1
 
+# The join-type family (docs/QUERY.md). Orientation: PROBE is the
+# preserved ("left") side, BUILD the other — matching the build/probe
+# naming everywhere else in the repo. Outer variants append bool
+# validity columns (BUILD_VALID / PROBE_VALID) marking which side of
+# each output row carries real values; NULL payloads are zeroed.
+JOIN_TYPES = ("inner", "left", "right", "full_outer", "semi", "anti")
+OUTER_TYPES = ("left", "right", "full_outer")
+BUILD_VALID = "build#valid"   # emitted by left / full_outer
+PROBE_VALID = "probe#valid"   # emitted by right / full_outer
+
 def _holds_i32_exactly(dt) -> bool:
     """Can dt round-trip any NON-NEGATIVE int32 value (for riding the
     int32 run-geometry lanes in the key dtype's gather pack)? f32's
@@ -120,6 +130,31 @@ class JoinResult:
     # (parallel/faults.RetryReport: the auto_retry escalation trail).
     # It is NOT a pytree field — JoinResult traces through shard_map,
     # and the report only exists outside the compiled program.
+
+
+def patch_string_lengths(table: Table, keys, join_type: str) -> Table:
+    """Recompute '<key>#len' companions from the rebuilt key BYTES on
+    rows whose probe side is absent (right/full_outer): the companion
+    rides as ordinary probe payload, so an unmatched-build row gets a
+    NULL-zeroed length even though its key bytes are exact. The
+    encoding is zero-padded with no interior NULs (utils/strings), so
+    the byte count recovers the true length. No-op for other types."""
+    if join_type not in ("right", "full_outer"):
+        return table
+    from distributed_join_tpu.utils.strings import LEN_SUFFIX
+
+    cols = dict(table.columns)
+    pm = cols[PROBE_VALID]
+    changed = False
+    for k in keys:
+        ln = k + LEN_SUFFIX
+        if ln in cols and cols[k].ndim == 2:
+            from_bytes = jnp.sum(
+                (cols[k] != 0).astype(cols[ln].dtype), axis=1
+            )
+            cols[ln] = jnp.where(pm, cols[ln], from_bytes)
+            changed = True
+    return Table(cols, table.valid) if changed else table
 
 
 def _to_u64_lane(c: jax.Array):
@@ -538,21 +573,41 @@ def sort_merge_inner_join(
     build_payload: Optional[Sequence[str]] = None,
     probe_payload: Optional[Sequence[str]] = None,
     kernel_config: Optional["KernelConfig"] = None,
+    join_type: str = "inner",
     _internal: Sequence[str] = (),
 ) -> JoinResult:
-    """Inner-join ``build`` and ``probe`` on equality of ``key`` — a
+    """Join ``build`` and ``probe`` on equality of ``key`` — a
     column name or a sequence of names (composite key). A key column
     may be a fixed-width 2-D uint8 byte column (utils/strings.py):
     it joins on lexicographic equality of the zero-padded bytes via
     packed big-endian uint64 words, the same composite-key machinery
     as scalar keys (SURVEY.md §2 string children; §7 step 7).
 
-    Output columns: the key column(s) (probe's copy), then build
-    payloads, then probe payloads. Payload names must not collide.
+    ``join_type`` selects the variant (docs/QUERY.md): ``inner``
+    (default — the seed program, unchanged), ``left`` (every valid
+    probe row survives; unmatched rows carry zeroed build payloads and
+    a False ``build#valid``), ``right`` (every valid build row
+    survives; unmatched rows carry zeroed probe payloads and a False
+    ``probe#valid``), ``full_outer`` (both), ``semi`` (probe rows with
+    at least one build match, once each), ``anti`` (probe rows with no
+    build match). Semi/anti emit keys + probe payloads only — an
+    explicit non-empty ``build_payload`` is refused. All variants are
+    the SAME merged-domain sort/scan/compact/expand with a different
+    per-position emission count; unmatched builds are already visible
+    at merge time as key runs containing zero probe rows.
+
+    Output columns: the key column(s), then build payloads, then probe
+    payloads, then any validity columns. Payload names must not
+    collide.
 
     ``kernel_config`` (ops/kernel_config.KernelConfig) selects the
     Pallas kernel paths; None reads the DJTPU_* env fallbacks.
     """
+    if join_type not in JOIN_TYPES:
+        raise ValueError(
+            f"unknown join_type {join_type!r}; expected one of "
+            f"{JOIN_TYPES}"
+        )
     cfg = resolve_kernel_config(kernel_config)
     keys = [key] if isinstance(key, str) else list(key)
     # String keys: pack 2-D byte key columns into uint64 word columns
@@ -578,13 +633,22 @@ def sort_merge_inner_join(
         res = sort_merge_inner_join(
             b2, p2, keys2, out_capacity,
             build_payload=bp, probe_payload=pp,
-            kernel_config=kernel_config, _internal=allowed,
+            kernel_config=kernel_config, join_type=join_type,
+            _internal=allowed,
         )
-        return JoinResult(
-            rebuild_string_keys(res.table, spec, keys),
-            total=res.total, overflow=res.overflow,
+        out = patch_string_lengths(
+            rebuild_string_keys(res.table, spec, keys), keys, join_type
         )
+        return JoinResult(out, total=res.total, overflow=res.overflow)
 
+    if join_type in ("semi", "anti"):
+        if build_payload:
+            raise ValueError(
+                f"join_type={join_type!r} emits probe rows only; an "
+                "explicit build_payload cannot be honored — drop it "
+                "or use a left join with the build#valid column"
+            )
+        build_payload = []
     if build_payload is None:
         build_payload = [n for n in build.column_names if n not in keys]
     if probe_payload is None:
@@ -592,6 +656,20 @@ def sort_merge_inner_join(
     clash = set(build_payload) & set(probe_payload)
     if clash:
         raise ValueError(f"payload name collision: {sorted(clash)}")
+    if join_type in OUTER_TYPES:
+        taken = set(keys) | set(build_payload) | set(probe_payload)
+        emitted = [
+            nm for nm in (
+                (BUILD_VALID,) if join_type == "left"
+                else (PROBE_VALID,) if join_type == "right"
+                else (BUILD_VALID, PROBE_VALID)
+            ) if nm in taken
+        ]
+        if emitted:
+            raise ValueError(
+                f"column(s) {emitted} collide with the outer-join "
+                "validity columns"
+            )
     # Internal record lanes (__S, __key{i}, __lo, __prow, __browidx)
     # share one dict namespace with user column names; a payload named
     # '__S' would silently overwrite a geometry lane and corrupt the
@@ -639,6 +717,12 @@ def sort_merge_inner_join(
     use_kernel, interpret = _kernel_path_ok(
         build, probe, keys, b1d, p1d, nb, npr, out_capacity, cfg
     )
+    if join_type != "inner":
+        # The fused kernel pipeline is inner-only (its scans drop
+        # zero-count probes and unmatched builds by construction); the
+        # typed variants run the XLA formulation below, whose emission
+        # count generalizes per position.
+        use_kernel = False
     if use_kernel:
         return _join_kernel_path(
             build, probe, keys, b1d, b2d, p1d, p2d, build_payload,
@@ -731,9 +815,52 @@ def sort_merge_inner_join(
     #    out_capacity, so overflow fires and the (garbage) payload rows
     #    are already flagged untrustworthy. (The x64 warning for this
     #    contract is issued once by sort_merge_inner_join.)
-    csum = jnp.cumsum(cnt)
-    total = jnp.sum(cnt.astype(jnp.int64))
-    start_out = csum - cnt            # first output slot of each run
+    if join_type == "inner":
+        csum = jnp.cumsum(cnt)
+        total = jnp.sum(cnt.astype(jnp.int64))
+        start_out = csum - cnt        # first output slot of each run
+        is_rec = is_probe & (cnt > 0)
+    else:
+        # Typed emission (docs/QUERY.md): each merged position emits
+        # ``emit`` output rows instead of ``cnt``. Probe rows emit
+        # their match count (padded to 1 for left/full_outer, collapsed
+        # to a presence bit for semi, an absence bit for anti); for
+        # right/full_outer an UNMATCHED build row — a key run holding
+        # zero probe rows — emits itself once with the probe payloads
+        # NULL-zeroed (the merged sort already planted zeros there).
+        if join_type in ("right", "full_outer"):
+            p_incl = jnp.cumsum(is_probe.astype(jnp.int32))
+            # Probes before the run start, broadcast down the run
+            # (non-decreasing, so cummax of run-start samples holds).
+            p_before = lax.cummax(jnp.where(
+                first, p_incl - is_probe.astype(jnp.int32), 0
+            ))
+            # Probes THROUGH the run end, broadcast backwards: p_incl
+            # sampled at run-last positions is non-decreasing, so a
+            # reversed cummin over a max-filled lane carries each
+            # run's last sample back to its start.
+            run_last = jnp.concatenate(
+                [first[1:], jnp.ones((1,), dtype=bool)]
+            )
+            p_thru = jnp.flip(lax.cummin(jnp.flip(
+                jnp.where(run_last, p_incl, _I32_MAX)
+            )))
+            b_unmatched = is_build & ((p_thru - p_before) == 0)
+        if join_type == "left":
+            emit = jnp.where(is_probe, jnp.maximum(cnt, 1), 0)
+        elif join_type == "semi":
+            emit = (is_probe & (cnt > 0)).astype(jnp.int32)
+        elif join_type == "anti":
+            emit = (is_probe & (cnt == 0)).astype(jnp.int32)
+        elif join_type == "right":
+            emit = cnt + b_unmatched.astype(jnp.int32)
+        else:  # full_outer
+            emit = (jnp.where(is_probe, jnp.maximum(cnt, 1), 0)
+                    + b_unmatched.astype(jnp.int32))
+        csum = jnp.cumsum(emit)
+        total = jnp.sum(emit.astype(jnp.int64))
+        start_out = csum - emit
+        is_rec = emit > 0
 
     # -- 4. run-record compaction sort: one record per probe row with
     #    matches, keyed by its first output slot (strictly increasing
@@ -746,14 +873,26 @@ def sort_merge_inner_join(
     #    scatter-only expansion this replaces measured 486 ms of a
     #    1050 ms join at 10M x 10M — sorts move values almost for free,
     #    scatters pay per operand element.)
-    is_rec = is_probe & (cnt > 0)
     rkey = jnp.where(is_rec, start_out, _I32_MAX)
     kdt = skeys[0].dtype
     geom_dt = kdt if _holds_i32_exactly(kdt) else jnp.int32
     rec_cols = {f"__key{i}": sk for i, sk in enumerate(skeys)}
     for nm in p1d:
         rec_cols[nm] = sp_payload[nm]
-    rec_cols["__lo"] = lo.astype(geom_dt)
+    if join_type == "inner":
+        rec_cols["__lo"] = lo.astype(geom_dt)
+    else:
+        # An unmatched-build record (right/full_outer) gathers its OWN
+        # payload: its rank in the step-1 sorted valid prefix is
+        # b_before. Side-presence flags ride as int8 lanes so each
+        # output row knows which side carries real values.
+        rec_cols["__lo"] = jnp.where(
+            is_build, b_before, lo
+        ).astype(geom_dt)
+        rec_cols["__bm"] = jnp.where(
+            is_build, jnp.int8(1), (cnt > 0).astype(jnp.int8)
+        )
+        rec_cols["__pm"] = is_probe.astype(jnp.int8)
     if p2d:
         rec_cols["__prow"] = sp_rowidx
     rec_names = list(rec_cols)
@@ -783,6 +922,10 @@ def sort_merge_inner_join(
     #    blocks past the f32-exact rank range.
     j = jnp.arange(out_capacity, dtype=jnp.int32)
     out_vals, start_b = _expand_records(S, recs, out_capacity, j, cfg)
+    bm = pm = None
+    if join_type != "inner":
+        bm = out_vals.pop("__bm") != 0
+        pm = out_vals.pop("__pm") != 0
     lo_b = out_vals.pop("__lo").astype(jnp.int32)
     build_rank = lo_b + (j - start_b)
     safe_rank = jnp.clip(build_rank, 0, max(nb - 1, 0))
@@ -794,22 +937,33 @@ def sort_merge_inner_join(
     for i, k in enumerate(keys):
         out_cols[k] = out_vals.pop(f"__key{i}")
     for nm in b1d:
-        out_cols[nm] = build_vals[nm]
+        # Unmatched probe rows (left/full_outer) derive a garbage rank
+        # (lo of an unrelated run) — NULL-zero their build values.
+        out_cols[nm] = (build_vals[nm] if bm is None else jnp.where(
+            bm, build_vals[nm], jnp.zeros_like(build_vals[nm])))
     if b2d:
         bidx = build_vals["__browidx"]
         for nm in b2d:
-            out_cols[nm] = build.columns[nm][bidx]
+            rows = build.columns[nm][bidx]
+            out_cols[nm] = (rows if bm is None else jnp.where(
+                bm[:, None], rows, jnp.zeros_like(rows)))
     for nm in p1d:
         out_cols[nm] = out_vals.pop(nm)
     if p2d:
         p = jnp.clip(out_vals.pop("__prow") - nb, 0, max(npr - 1, 0))
         for nm in p2d:
-            out_cols[nm] = probe.columns[nm][p]
-    # Column order: keys, build payloads, probe payloads.
-    out_cols = {
-        nm: out_cols[nm]
-        for nm in [*keys, *build_payload, *probe_payload]
-    }
+            rows = probe.columns[nm][p]
+            out_cols[nm] = (rows if pm is None else jnp.where(
+                pm[:, None], rows, jnp.zeros_like(rows)))
+    # Column order: keys, build payloads, probe payloads, validity.
+    order = [*keys, *build_payload, *probe_payload]
+    if join_type in ("left", "full_outer"):
+        out_cols[BUILD_VALID] = bm
+        order.append(BUILD_VALID)
+    if join_type in ("right", "full_outer"):
+        out_cols[PROBE_VALID] = pm
+        order.append(PROBE_VALID)
+    out_cols = {nm: out_cols[nm] for nm in order}
 
     out_valid = j < total
     return JoinResult(

@@ -37,7 +37,12 @@ from typing import Optional, Sequence
 import jax.numpy as jnp
 
 from distributed_join_tpu import telemetry
-from distributed_join_tpu.ops.join import JoinResult, sort_merge_inner_join
+from distributed_join_tpu.ops.join import (
+    JOIN_TYPES,
+    JoinResult,
+    patch_string_lengths,
+    sort_merge_inner_join,
+)
 from distributed_join_tpu.ops.partition import radix_hash_partition
 from distributed_join_tpu.parallel.communicator import Communicator
 from distributed_join_tpu.parallel.shuffle import (
@@ -168,6 +173,7 @@ def _batch_shuffle_segmented(comm, pt, batch: int, n_ranks: int,
 def make_join_step(
     comm: Communicator,
     key: str = "key",
+    join_type: str = "inner",
     over_decomposition: int = 1,
     shuffle_capacity_factor: float = DEFAULT_SHUFFLE_CAPACITY_FACTOR,
     out_capacity_factor: float = DEFAULT_OUT_CAPACITY_FACTOR,
@@ -191,6 +197,17 @@ def make_join_step(
     metrics_static: Optional[dict] = None,
 ):
     """The raw per-rank join step (partition -> shuffle -> local join).
+
+    ``join_type`` (docs/QUERY.md): ``inner`` (default — the exact seed
+    program, byte-for-byte) or one of ``left``/``right``/``full_outer``
+    /``semi``/``anti`` (ops.join.JOIN_TYPES). Probe is the preserved
+    ("left") side. Typed emission is purely local to each bucket's
+    sort-merge — hash partitioning already co-locates every key's rows
+    from both sides — so all shuffle modes and over-decomposition
+    compose unchanged. Three shapes refuse by name: the skew sidecar
+    (broadcast heavy-hitter build rows would emit unmatched once per
+    rank), aggregate pushdown (no NULL-row emission in the fused
+    reduction), and ``sort_mode='segmented'``.
 
     ``sort_mode`` ("flat"/"segmented"): "flat" is the exact existing
     pipeline, byte-for-byte. "segmented" is the segmented-sort path
@@ -343,6 +360,36 @@ def make_join_step(
     k = over_decomposition
     if k < 1:
         raise ValueError("over_decomposition must be >= 1")
+    if join_type not in JOIN_TYPES:
+        raise ValueError(
+            f"unknown join_type {join_type!r}; expected one of "
+            f"{JOIN_TYPES}")
+    if join_type != "inner":
+        # The typed variants ride the same hash partitioning — every
+        # key's build AND probe rows land in one bucket, so unmatched
+        # rows are locally visible — but three shapes would break that
+        # locality (or double-emit) and refuse by name, mirroring the
+        # aggregate-pushdown discipline:
+        if skew_threshold is not None:
+            raise ValueError(
+                f"join_type={join_type!r} does not combine with the "
+                "skew sidecar: broadcast heavy-hitter build rows are "
+                "replicated on every rank, so an unmatched heavy "
+                "build row would emit once PER RANK — run typed joins "
+                "without skew_threshold")
+        if aggregate is not None:
+            raise ValueError(
+                f"join_type={join_type!r} does not combine with "
+                "aggregate pushdown: the fused reduction counts "
+                "matches in the merged domain and has no NULL-row "
+                "emission — aggregate over a materialized typed join "
+                "instead")
+        if sort_mode == "segmented":
+            raise ValueError(
+                f"join_type={join_type!r} is not part of the "
+                "segmented-sort path (the batched short-run "
+                "formulation emits matches only) — use "
+                "sort_mode='flat'")
     if shuffle not in SHUFFLE_MODES:
         # Validate for EVERY config — the single-rank path never
         # reaches the shuffle, and a typo'd mode must not silently
@@ -600,7 +647,7 @@ def make_join_step(
                 res = sort_merge_inner_join(
                     build_local, probe_local, keys_eff, out_cap,
                     build_payload=bpay, probe_payload=ppay,
-                    kernel_config=kernel_config,
+                    kernel_config=kernel_config, join_type=join_type,
                     _internal=sk_names,
                 )
             parts.append(res.table)
@@ -719,7 +766,7 @@ def make_join_step(
                     res = sort_merge_inner_join(
                         recv_build, recv_probe, keys_eff, out_cap,
                         build_payload=bpay, probe_payload=ppay,
-                        kernel_config=kernel_config,
+                        kernel_config=kernel_config, join_type=join_type,
                         _internal=sk_names,
                     )
                 parts.append(res.table)
@@ -737,7 +784,9 @@ def make_join_step(
                 rebuild_string_keys,
             )
 
-            out = rebuild_string_keys(out, str_spec, keys)
+            out = patch_string_lengths(
+                rebuild_string_keys(out, str_spec, keys), keys,
+                join_type)
         if tape is not None:
             # Local (pre-psum) match count: the gathered per-rank
             # vector sums to the global total, giving per-rank match
@@ -871,10 +920,13 @@ def _make_join_agg_step(comm, spec, *, keys, k,
                 parts.append(partials)
                 total = total + t
                 overflow = overflow | ovf_b | ovf_p | ovf_j
-        if mode == "probe":
+        if mode in ("probe", "build"):
             # Key mode needs NEITHER settle pass: a key lives in
             # exactly one (batch, rank) by the bucket arithmetic, so
             # per-batch per-rank partials are disjoint final groups.
+            # Probe and build mode share both settle passes — the
+            # regroup/exchange machinery is side-agnostic once the
+            # partials table exists.
             if len(parts) > 1:
                 # Non-key groups recur across batches — one combine
                 # (concat + regroup sort at groups size) settles them.
@@ -1224,6 +1276,12 @@ def _make_probe_agg_step(comm, spec, *, keys, k,
         bschema = agg_ops.table_schema(resident_local)
         pschema = agg_ops.table_schema(probe_local)
         mode = agg_ops.resolve_agg_mode(spec, keys, bschema, pschema)
+        if mode == "build":
+            raise agg_ops.AggregatePushdownUnsupported(
+                "group keys live on the RESIDENT (build) side; the "
+                "probe-only program keeps the build shards pinned and "
+                "only exchanges probe rows, so build-keyed group-bys "
+                "ride make_join_step(aggregate=) instead")
         wire_b, wire_p = agg_ops.wire_columns(spec, mode, keys,
                                               bschema, pschema)
         resident_w = resident_local.select(wire_b)

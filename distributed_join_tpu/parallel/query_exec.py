@@ -1,0 +1,309 @@
+"""Execute a :class:`~..planning.query.QueryPlan` as ONE SPMD program.
+
+The composition property this module banks on: every
+:func:`~.distributed_join.make_join_step` step is a *per-rank*
+function over collectives — legal anywhere inside ``shard_map``. So a
+multi-operator plan lowers by calling the per-op steps sequentially
+inside one wrapping function and compiling THAT once with
+``comm.spmd``: intermediates are ordinary traced Tables that never
+leave the device or the program, each operator's own partition+shuffle
+re-shards them by the next key, and XLA schedules the whole chain as a
+single executable. One trace, one cache entry, one dispatch per query
+— the multi-operator generalization of the seed's "whole pipeline is
+ONE compiled SPMD program" stance.
+
+``distributed_query`` is the convenience wrapper mirroring
+``distributed_inner_join``: pad + shard the base tables, resolve the
+program through an optional :class:`~..service.programs.
+JoinProgramCache` (keyed on :class:`QuerySignature`, whose digest
+folds the PLAN digest — a repeated query dispatches warm with zero new
+traces), and on overflow escalate every operator's capacity factors
+together up to ``auto_retry`` times (the whole program is one
+executable; per-operator rungs would key a combinatorial signature
+space for no measured benefit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributed_join_tpu import telemetry
+from distributed_join_tpu.parallel.distributed_join import (
+    DEFAULT_OUT_CAPACITY_FACTOR,
+    DEFAULT_SHUFFLE_CAPACITY_FACTOR,
+    make_join_step,
+)
+from distributed_join_tpu.table import Table
+
+__all__ = [
+    "QueryResult",
+    "QuerySignature",
+    "make_query_step",
+    "make_distributed_query",
+    "distributed_query",
+]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class QueryResult:
+    """The terminal operator's output plus the chain's aggregate
+    health: ``total`` is the final op's count (groups emitted when the
+    plan ends in a fused aggregate, matches otherwise), ``op_totals``
+    the per-operator counts in plan order, ``overflow`` the OR across
+    every operator — any tripped capacity anywhere in the chain raises
+    it, so a retry re-runs the WHOLE query (one program, one rung).
+    Host-side attributes attached by :func:`distributed_query` (not
+    pytree fields): ``plan_digest``, ``cache_hit``, ``retry_attempts``,
+    and ``telemetry`` (per-operator Metrics tuple) when instrumented.
+    """
+
+    table: Table
+    total: jax.Array
+    overflow: jax.Array
+    op_totals: tuple
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _op_steps(comm, plan, defaults, with_metrics, metrics_static):
+    from distributed_join_tpu.ops import aggregate as agg_ops
+
+    steps = []
+    for op in plan.ops:
+        opts = dict(defaults)
+        opts.update(op.opts())
+        if op.aggregate is not None:
+            opts["aggregate"] = agg_ops.AggregateSpec.from_wire(
+                op.aggregate)
+        key = list(op.keys) if len(op.keys) > 1 else op.keys[0]
+        steps.append(make_join_step(
+            comm, key=key, join_type=op.join_type,
+            with_metrics=with_metrics,
+            metrics_static=metrics_static, **opts))
+    return steps
+
+
+def make_query_step(comm, plan, *, defaults: Optional[dict] = None,
+                    with_metrics: bool = False,
+                    metrics_static: Optional[dict] = None):
+    """Per-rank step for the whole plan: ``step(*tables) ->
+    QueryResult`` (or ``(QueryResult, metrics_tuple)`` when
+    instrumented — one Metrics block per operator, in plan order).
+    ``tables`` arrive in ``plan.tables`` order. Compile with
+    :func:`make_distributed_query` / ``comm.spmd``; the matching
+    ``sharded_out`` is :func:`query_sharded_out`."""
+    defaults = dict(defaults or {})
+    op_steps = _op_steps(comm, plan, defaults, with_metrics,
+                         metrics_static)
+    names = tuple(plan.tables)
+    ops = plan.ops
+
+    def step(*tables):
+        if len(tables) != len(names):
+            raise TypeError(
+                f"query step takes {len(names)} tables "
+                f"{list(names)}, got {len(tables)}")
+        env = dict(zip(names, tables))
+        op_totals = []
+        metrics = []
+        overflow = jnp.bool_(False)
+        res = None
+        for op, op_step in zip(ops, op_steps):
+            out = op_step(env[op.build], env[op.probe])
+            if with_metrics:
+                res, m = out
+                metrics.append(m)
+            else:
+                res = out
+            env[op.op_id] = res.table
+            op_totals.append(res.total)
+            overflow = overflow | res.overflow
+        result = QueryResult(
+            table=res.table, total=res.total, overflow=overflow,
+            op_totals=tuple(op_totals))
+        return (result, tuple(metrics)) if with_metrics else result
+
+    return step
+
+
+def query_sharded_out(plan, with_metrics: bool = False):
+    """The ``comm.spmd`` out-spec for :func:`make_query_step`'s
+    return: the result table row-sharded, every psummed count and the
+    overflow flag (and the gathered Metrics blocks) replicated."""
+    res = QueryResult(table=False, total=True, overflow=True,
+                      op_totals=(True,) * len(plan.ops))
+    return (res, (True,) * len(plan.ops)) if with_metrics else res
+
+
+def make_distributed_query(comm, plan, with_metrics=None,
+                           metrics_static: Optional[dict] = None,
+                           **defaults):
+    """Compile the plan over ``comm``'s ranks: a jitted
+    ``fn(*tables) -> QueryResult`` taking row-sharded global Tables
+    (capacities divisible by n_ranks) in ``plan.tables`` order —
+    the whole chain as ONE program. ``with_metrics=None`` resolves
+    from the telemetry session; instrumented results carry the
+    per-operator Metrics tuple as ``res.telemetry``. ``defaults``
+    are join knobs applied to every operator (per-op plan options
+    win)."""
+    if with_metrics is None:
+        with_metrics = telemetry.enabled()
+    step = make_query_step(comm, plan, defaults=defaults,
+                           with_metrics=with_metrics,
+                           metrics_static=metrics_static)
+    compiled = comm.spmd(
+        step, sharded_out=query_sharded_out(plan, with_metrics))
+    if not with_metrics:
+        return compiled
+
+    def fn(*tables):
+        res, metrics = compiled(*tables)
+        object.__setattr__(res, "telemetry", metrics)
+        return res
+
+    return fn
+
+
+# -- cache identity ----------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class QuerySignature:
+    """Cache identity of one compiled query program: the PLAN digest
+    (operators, keys, join types, per-op options — the canonical
+    record), the padded base-table avals, the executor-level defaults
+    (escalation rung included), and the mesh. Satisfies the
+    ``get_keyed`` contract (``digest()``/``canonical()``/name-sorted
+    ``options``)."""
+
+    n_ranks: int
+    plan_digest: str
+    tables: tuple            # (name, schema triples, capacity) per base
+    options: tuple           # name-sorted (knob, value) pairs
+    n_slices: int = 1
+
+    @classmethod
+    def of(cls, comm, plan, tables, **options) -> "QuerySignature":
+        from distributed_join_tpu.service.programs import _schema_of
+
+        entries = []
+        for name in plan.tables:
+            t = tables[name]
+            entries.append((
+                name, _schema_of(t),
+                int(next(iter(t.columns.values())).shape[0])))
+        return cls(
+            n_ranks=int(comm.n_ranks),
+            plan_digest=plan.digest(),
+            tables=tuple(entries),
+            options=tuple(sorted(options.items())),
+            n_slices=int(getattr(comm, "n_slices", 1)),
+        )
+
+    def canonical(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def digest(self) -> str:
+        blob = json.dumps(self.canonical(), sort_keys=True,
+                          default=str)
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+
+# -- the one-shot convenience ------------------------------------------
+
+
+def distributed_query(tables: Mapping[str, Table], plan, comm,
+                      auto_retry: int = 0, program_cache=None,
+                      with_metrics=None, **defaults) -> QueryResult:
+    """Run the whole plan: pad each base table to rank-divisible
+    capacity, shard over the mesh, compile (or cache-resolve) the ONE
+    program, dispatch. On overflow — anywhere in the chain — double
+    every operator's ``shuffle_capacity_factor``/
+    ``out_capacity_factor`` and retry, up to ``auto_retry`` times;
+    each rung keys its own signature, so a retried sizing seen before
+    also dispatches warm. The result carries ``plan_digest``,
+    ``cache_hit`` (first attempt resolved resident/persisted) and
+    ``retry_attempts`` as host-side attributes."""
+    if program_cache is not None and program_cache.comm is not comm:
+        raise ValueError(
+            "program_cache was built for a different communicator")
+    if with_metrics is None:
+        with_metrics = telemetry.enabled()
+
+    n = comm.n_ranks
+    missing = [name for name in plan.tables if name not in tables]
+    if missing:
+        raise ValueError(
+            f"plan references base tables {missing} not supplied "
+            f"(have {sorted(tables)})")
+    padded = {
+        name: tables[name].pad_to(
+            _round_up(tables[name].capacity, n))
+        for name in plan.tables
+    }
+    if hasattr(comm, "device_put_sharded"):
+        padded = comm.device_put_sharded(padded)
+    args = tuple(padded[name] for name in plan.tables)
+
+    shuffle_f = float(defaults.pop("shuffle_capacity_factor",
+                                   DEFAULT_SHUFFLE_CAPACITY_FACTOR))
+    out_f = float(defaults.pop("out_capacity_factor",
+                               DEFAULT_OUT_CAPACITY_FACTOR))
+
+    first_hit = None
+    res = None
+    for attempt in range(auto_retry + 1):
+        scale = 2 ** attempt
+        sizing = dict(defaults,
+                      shuffle_capacity_factor=shuffle_f * scale,
+                      out_capacity_factor=out_f * scale)
+        if program_cache is not None:
+            sig = QuerySignature.of(
+                comm, plan, padded, with_metrics=bool(with_metrics),
+                rung=attempt, **sizing)
+
+            def builder():
+                return make_distributed_query(
+                    comm, plan, with_metrics=False,
+                    metrics_static={"retry_attempt_max": attempt},
+                    **sizing) if not with_metrics else _raw_spmd(
+                        comm, plan, attempt, sizing)
+
+            entry, hit = program_cache.get_keyed(
+                sig, builder, example_args=args,
+                with_aux=bool(with_metrics))
+            fn = entry
+        else:
+            fn = make_distributed_query(
+                comm, plan, with_metrics=with_metrics,
+                metrics_static={"retry_attempt_max": attempt},
+                **sizing)
+            hit = False
+        if first_hit is None:
+            first_hit = hit
+        res = fn(*args)
+        if not bool(res.overflow):
+            break
+    object.__setattr__(res, "plan_digest", plan.digest())
+    object.__setattr__(res, "cache_hit", bool(first_hit))
+    object.__setattr__(res, "retry_attempts", attempt)
+    return res
+
+
+def _raw_spmd(comm, plan, attempt, sizing):
+    """The UNWRAPPED ``(res, metrics)`` program for cache admission
+    with aux: ``CachedProgram`` owns the telemetry re-attachment."""
+    step = make_query_step(
+        comm, plan, defaults=sizing, with_metrics=True,
+        metrics_static={"retry_attempt_max": attempt})
+    return comm.spmd(
+        step, sharded_out=query_sharded_out(plan, True))

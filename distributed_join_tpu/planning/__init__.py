@@ -40,6 +40,13 @@ from distributed_join_tpu.planning.plan import (
     build_probe_plan,
     explain_join,
 )
+from distributed_join_tpu.planning.query import (
+    QUERY_SCHEMA_VERSION,
+    QueryOp,
+    QueryPlan,
+    explain_query,
+    tpch_query_plan,
+)
 from distributed_join_tpu.planning.tuner import (
     TUNER_SCHEMA_VERSION,
     JoinTuner,
@@ -52,11 +59,14 @@ __all__ = [
     "DEFAULT_COST_MODEL",
     "DEFAULT_PREDICTION_BAND",
     "EXPLAIN_SCHEMA_VERSION",
+    "QUERY_SCHEMA_VERSION",
     "STAGE_CONSTANTS",
     "TUNER_SCHEMA_VERSION",
     "CostModel",
     "JoinPlan",
     "JoinTuner",
+    "QueryOp",
+    "QueryPlan",
     "SidePlan",
     "TunedConfig",
     "abstract_tables",
@@ -66,7 +76,9 @@ __all__ = [
     "calibrate_from_history",
     "calibrate_from_stage_profile",
     "explain_join",
+    "explain_query",
     "predict",
     "predict_exchange",
+    "tpch_query_plan",
     "workload_signature",
 ]

@@ -701,7 +701,7 @@ def build_plan(comm, build, probe, key="key", with_metrics=None,
             "partial_columns": [list(c) for c in partial_cols],
             "partial_row_bytes": partial_row_bytes,
         }
-        if agg_mode == "probe" and n > 1:
+        if agg_mode in ("probe", "build") and n > 1:
             # The partials-only cross-rank exchange (ONE padded
             # collective, not per batch): per-destination capacity is
             # the full groups block, so the billed bytes are EXACTLY
@@ -942,6 +942,12 @@ def build_probe_plan(comm, resident, probe, key="key",
         psch = {name: (dtype, 1 + len(tr))
                 for name, dtype, tr in pcols}
         agg_mode = agg_ops.resolve_agg_mode(agg_spec, keys, rsch, psch)
+        if agg_mode == "build":
+            raise agg_ops.AggregatePushdownUnsupported(
+                "group keys live on the RESIDENT (build) side; the "
+                "probe-only program keeps the build shards pinned and "
+                "only exchanges probe rows, so build-keyed group-bys "
+                "ride make_join_step(aggregate=) instead")
         agg_schemas = (rsch, psch)
         need_b, need_p = agg_ops.wire_columns(
             agg_spec, agg_mode, keys, rsch, psch)

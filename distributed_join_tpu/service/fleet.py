@@ -596,6 +596,24 @@ def affinity_key(req: dict, replica_ranks: int) -> str:
     if table is not None:
         return hashlib.sha256(
             f"table:{table}".encode()).hexdigest()[:16]
+    if op == "query":
+        # Multi-operator plans route by the PLAN DIGEST — the same
+        # key the replicas' program caches hold the compiled
+        # whole-plan program under, so a repeated query lands warm
+        # on the same replica (docs/QUERY.md).
+        try:
+            from distributed_join_tpu.planning.query import (
+                tpch_query_plan,
+            )
+
+            digest = tpch_query_plan(
+                str(req.get("query", "q3"))).digest()
+            return hashlib.sha256(
+                f"queryplan:{digest}".encode()).hexdigest()[:16]
+        except Exception as exc:  # noqa: BLE001 - fall to JSON hash
+            telemetry.event(
+                "fleet_affinity_fallback", op=op,
+                error=f"{type(exc).__name__}: {exc}")
     if op in ("join", "explain") and req.get("build_nrows"):
         try:
             from distributed_join_tpu.planning import abstract_tables

@@ -197,6 +197,14 @@ class JoinService:
         self.agg_queries = 0
         self.agg_warm_hits = 0
         self.agg_groups_emitted = 0
+        # Multi-operator query plans (docs/QUERY.md): whole plans
+        # served as ONE compiled SPMD program, how many of those
+        # dispatched warm (zero new traces across every operator),
+        # and the widest plan seen (operator count including a fused
+        # aggregate) — stats() "query" and the djtpu_query_* gauges.
+        self.query_plans = 0
+        self.query_warm_hits = 0
+        self.query_operators_max = 0
         self.live = tel_live.LiveMetrics()
         self.recorder = tel_live.FlightRecorder(
             self.config.flight_records)
@@ -629,6 +637,115 @@ class JoinService:
                           plan_digest, resident=resident_rec,
                           aggregate=agg_rec)
 
+    def query(self, tables: dict, plan, *, request_id=None, **opts):
+        """One admitted multi-operator plan (docs/QUERY.md) through
+        the program cache: the WHOLE plan — every join plus a fused
+        aggregate — is one compiled SPMD program keyed on the plan
+        digest, so a repeat of the same plan over same-shaped tables
+        dispatches warm with zero new traces. Admission, the exec
+        lock's poisoned re-check, the watchdog deadline, and
+        ``_observe`` bookkeeping follow :meth:`join` exactly; the
+        result is the :class:`~..parallel.query_exec.QueryResult`
+        with ``new_traces`` / ``request_id`` attached."""
+        from distributed_join_tpu.parallel.query_exec import (
+            distributed_query,
+        )
+        from distributed_join_tpu.parallel.watchdog import (
+            HangError,
+            call_with_deadline,
+        )
+
+        op = "query"
+        rid = self._admit(op, request_id)
+        t_start = time.perf_counter()
+        plan_digest = plan.digest()
+        # Rung-stable signature for history/live grouping: the digest
+        # already folds in tables+ops+options, so it IS the workload.
+        sig = f"queryplan-{plan_digest[:16]}"
+        outcome = "failed"
+        res = None
+        err: Optional[BaseException] = None
+        new_traces = cache_hits = 0
+        agg_rec = None
+        try:
+            with self._exec_lock:
+                with self._admit_lock:
+                    if self.poisoned is not None:
+                        self.rejected += 1
+                        outcome = "rejected"
+                        telemetry.event("request_rejected",
+                                        reason="poisoned",
+                                        request_id=rid)
+                        raise AdmissionError(
+                            "mesh poisoned by a hung request "
+                            f"({self.poisoned}); restart the server")
+
+                def run_once():
+                    return distributed_query(
+                        tables, plan, self.comm,
+                        auto_retry=self.config.auto_retry,
+                        program_cache=self.cache, **opts)
+
+                deadline = self.config.request_deadline_s
+                traces0 = self.cache.traces
+                hits0 = self.cache.hits
+                try:
+                    with telemetry.request_scope(rid), \
+                            telemetry.span("request", request_id=rid,
+                                           op=op, signature=sig) as sp:
+                        if deadline is None:
+                            res = run_once()
+                        else:
+                            res = call_with_deadline(
+                                run_once, deadline,
+                                what=f"request {rid}")
+                        if sp is not None:
+                            sp.sync_on(res.total)
+                except Exception as exc:
+                    new_traces = self.cache.traces - traces0
+                    cache_hits = self.cache.hits - hits0
+                    if isinstance(exc, HangError):
+                        outcome = "hang"
+                        with self._admit_lock:
+                            self.poisoned = str(exc)
+                    raise
+                self.served += 1
+                new_traces = self.cache.traces - traces0
+                cache_hits = self.cache.hits - hits0
+                outcome = "served"
+                groups = None
+                agg_wire = plan.ops[-1].aggregate
+                if agg_wire is not None:
+                    groups = _count_groups(res.table.valid)
+                    agg_rec = dict(agg_wire, groups=groups)
+                with self._admit_lock:
+                    self.query_plans += 1
+                    if new_traces == 0:
+                        self.query_warm_hits += 1
+                    self.query_operators_max = max(
+                        self.query_operators_max, plan.n_operators())
+                    if groups is not None:
+                        self.agg_groups_emitted += groups
+                object.__setattr__(res, "groups", groups)
+                object.__setattr__(res, "new_traces", new_traces)
+                object.__setattr__(res, "request_id", rid)
+                return res
+        except BaseException as exc:
+            err = exc
+            if outcome != "rejected":
+                if isinstance(exc, Exception):
+                    with self._admit_lock:
+                        self.failed += 1
+                else:
+                    outcome = "aborted"
+            raise
+        finally:
+            self._release()
+            self._observe(rid, op, sig, outcome, res, err,
+                          time.perf_counter() - t_start,
+                          new_traces, cache_hits, None,
+                          plan_digest, aggregate=agg_rec)
+
     def _table_op(self, op: str, table: str, fn, request_id=None):
         """Admission + exec-lock + accounting wrapper for the
         resident table-management ops (register/append/drop). They
@@ -1048,6 +1165,11 @@ class JoinService:
                 "warm_hits": self.agg_warm_hits,
                 "groups_emitted": self.agg_groups_emitted,
             },
+            "query": {
+                "plans": self.query_plans,
+                "warm_hits": self.query_warm_hits,
+                "operators_max": self.query_operators_max,
+            },
             "tuner": (self.tuner.stats() if self.tuner is not None
                       else None),
         }
@@ -1108,6 +1230,11 @@ class JoinService:
             "agg_warm_hits_total": st["aggregate"]["warm_hits"],
             "agg_groups_emitted_total":
                 st["aggregate"]["groups_emitted"],
+            # Multi-operator query plans (docs/QUERY.md): whole-plan
+            # single-program traffic and its warm-dispatch rate.
+            "query_plans_total": st["query"]["plans"],
+            "query_warm_hits_total": st["query"]["warm_hits"],
+            "query_operators_max": st["query"]["operators_max"],
         })
 
 
@@ -1119,6 +1246,15 @@ _WIRE_JOIN_OPTS = (
     "shuffle", "over_decomposition", "shuffle_capacity_factor",
     "out_capacity_factor", "compression_bits", "skew_threshold",
     "dcn_codec", "aggregate", "sort_mode", "sort_segments",
+)
+
+# Plan-level defaults a `query` wire request may set; applied to
+# every operator of the plan (the plan's own per-op options win).
+# No "aggregate" here — a plan carries its own fused aggregate.
+_WIRE_QUERY_OPTS = (
+    "shuffle", "over_decomposition", "shuffle_capacity_factor",
+    "out_capacity_factor", "compression_bits", "skew_threshold",
+    "dcn_codec",
 )
 
 
@@ -1140,6 +1276,27 @@ def _tables_from_spec(spec: dict):
         selectivity=float(spec.get("selectivity", 0.3)),
         unique_build_keys=bool(spec.get("unique_build_keys", False)),
     )
+
+
+def _query_from_spec(spec: dict):
+    """The ``(tables, plan)`` pair a ``query`` wire request names.
+    Demo data plane like :func:`_tables_from_spec`: the canonical
+    TPC-H plan plus deterministic generator tables keyed by the
+    request's seed, filtered by the query's predicates — an embedding
+    deployment calls :meth:`JoinService.query` with real tables and
+    an arbitrary :class:`~..planning.query.QueryPlan` instead."""
+    from distributed_join_tpu.planning.query import tpch_query_plan
+    from distributed_join_tpu.utils.tpch import (
+        generate_tpch_query_tables,
+        query_filters,
+    )
+
+    q = str(spec.get("query", "q3"))
+    plan = tpch_query_plan(q)
+    tables = generate_tpch_query_tables(
+        seed=int(spec.get("seed", 42)),
+        scale_factor=float(spec.get("scale_factor", 0.01)))
+    return query_filters(tables, q), plan
 
 
 def _join_opts_from_spec(spec: dict) -> dict:
@@ -1435,9 +1592,39 @@ class _Handler(socketserver.StreamRequestHandler):
                                if results else 0),
                 "cache": service.cache.stats(),
             }
+        if op == "query":
+            # One multi-operator plan as ONE SPMD program
+            # (docs/QUERY.md): the wire names a canonical TPC-H query
+            # and the demo data plane's shape knobs; the whole chain
+            # — every join plus the fused aggregate — resolves
+            # through the program cache under the plan digest.
+            tables, plan = _query_from_spec(req)
+            opts = {k: req[k] for k in _WIRE_QUERY_OPTS
+                    if req.get(k) is not None}
+            t0 = time.perf_counter()
+            res = service.query(tables, plan,
+                                request_id=req.get("request_id"),
+                                **opts)
+            elapsed = time.perf_counter() - t0
+            return {
+                "ok": True,
+                "request_id": getattr(res, "request_id", None),
+                "query": req.get("query", "q3"),
+                "digest": plan.digest(),
+                "n_operators": plan.n_operators(),
+                "rows": int(res.total),
+                "op_totals": [int(t) for t in res.op_totals],
+                "groups": getattr(res, "groups", None),
+                "overflow": bool(res.overflow),
+                "retry_attempts": getattr(res, "retry_attempts", 0),
+                "elapsed_s": elapsed,
+                "new_traces": getattr(res, "new_traces", 0),
+                "cache": service.cache.stats(),
+            }
         raise ValueError(f"unknown op {op!r} (ops: ping, stats, "
-                         "metrics, explain, join, batch, register, "
-                         "append, tables, drop, drain, shutdown)")
+                         "metrics, explain, join, batch, query, "
+                         "register, append, tables, drop, drain, "
+                         "shutdown)")
 
 
 class _Server(socketserver.ThreadingTCPServer):

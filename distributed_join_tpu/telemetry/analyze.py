@@ -751,6 +751,88 @@ def grade_explain(explain: dict, metrics: Optional[dict],
     return out
 
 
+def grade_queryplan(doc: dict, record: Optional[dict]) -> dict:
+    """EXPLAIN ANALYZE for a multi-operator plan (docs/QUERY.md):
+    join the queryplan artifact's per-operator wire predictions to
+    the driver's measured per-operator counters (the ``wire`` list
+    of a ``--query`` record) and surface the join-order candidates
+    the cost model priced. With no record the predictions render
+    ungraded."""
+    meas = {}
+    if record is not None:
+        for entry in record.get("wire") or []:
+            meas[entry.get("id")] = entry
+    ops = []
+    gated = record is not None
+    exact = True
+    for orec in doc.get("operators") or []:
+        entry = {
+            "id": orec.get("id"),
+            "join_type": orec.get("join_type"),
+            "aggregate": bool(orec.get("aggregate")),
+            "wire": {},
+        }
+        m = meas.get(orec.get("id")) or {}
+        for side in ("build", "probe"):
+            pred = int(((orec.get("wire") or {}).get(side) or {})
+                       .get("bytes_total", 0))
+            e = {"predicted_bytes": pred}
+            if side in m:
+                mb = int(m[side]["measured_bytes"])
+                e["measured_bytes"] = mb
+                e["match"] = pred == mb
+                exact &= pred == mb
+            entry["wire"][side] = e
+        ops.append(entry)
+    return {
+        "kind": "queryplan_grade",
+        "plan_digest": doc.get("digest"),
+        "n_operators": doc.get("n_operators"),
+        "total_s": doc.get("total_s"),
+        "operators": ops,
+        "orders": doc.get("orders"),
+        "wire_match": (exact if gated else None),
+    }
+
+
+def format_queryplan_grade(grade: dict) -> str:
+    lines = [f"queryplan {str(grade.get('plan_digest'))[:16]}  "
+             f"{grade.get('n_operators')} operators, predicted "
+             f"{grade.get('total_s')} s"]
+    for op in grade.get("operators") or []:
+        tag = f"{op['id']} [{op['join_type']}" + \
+            ("+agg]" if op.get("aggregate") else "]")
+        parts = []
+        for side, d in sorted(op["wire"].items()):
+            if "measured_bytes" in d:
+                verdict = ("MATCH" if d["match"] else
+                           f"MISMATCH ({d['measured_bytes']} B "
+                           "measured)")
+                parts.append(f"{side} {d['predicted_bytes']} B "
+                             f"-> {verdict}")
+            else:
+                parts.append(f"{side} {d['predicted_bytes']} B")
+        lines.append(f"  {tag}: " + ", ".join(parts))
+    orders = grade.get("orders") or []
+    if orders:
+        lines.append("  join orders priced:")
+        for o in orders:
+            marks = "".join(
+                [" <- chosen" if o.get("chosen") else "",
+                 " (cheapest)" if o.get("cheapest") else ""])
+            total = o.get("total_s")
+            cost = (f"{total} s" if total is not None
+                    else str(o.get("note")))
+            lines.append(
+                f"    {' -> '.join(o.get('tables', []))}: "
+                f"{cost}{marks}")
+    if grade.get("wire_match") is not None:
+        lines.append("  wire prediction: "
+                     + ("EXACT" if grade["wire_match"]
+                        else "MISMATCH"))
+    return "\n".join(lines)
+
+
 def format_explain_grade(grade: dict) -> str:
     lines = [f"explain {str(grade.get('plan_digest'))[:16]} "
              f"[{grade.get('pipeline')}]  wire prediction: "
@@ -1003,6 +1085,54 @@ def check_file(path: str) -> list:
         required = _SUMMARY_REQUIRED
     elif name == "diagnosis.json":
         required = _DIAGNOSIS_REQUIRED
+    elif name.startswith("queryplan") or \
+            doc.get("kind") == "queryplan":
+        # The multi-operator EXPLAIN artifact (planning/query.py
+        # explain_query, docs/QUERY.md): the whole plan priced
+        # operator by operator plus the join-order candidates.
+        # Dispatched BEFORE the single-join explain branch so a
+        # kind-stamped queryplan doc named explain.json still lands
+        # here.
+        for key in ("schema_version", "kind", "digest", "n_ranks",
+                    "plan", "operators", "n_operators", "total_s",
+                    "orders"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        ops = doc.get("operators")
+        if isinstance(ops, list):
+            for j, orec in enumerate(ops):
+                for key in ("id", "build", "probe", "key",
+                            "join_type", "out_capacity", "wire",
+                            "cost"):
+                    if not isinstance(orec, dict) or key not in orec:
+                        problems.append(
+                            f"operators[{j}] missing {key!r}")
+        elif "operators" in doc:
+            problems.append("operators is not a list")
+        if "orders" in doc and not isinstance(doc["orders"], list):
+            problems.append("orders is not a list")
+        return problems
+    elif name.startswith("query_smoke") or \
+            doc.get("kind") == "query_smoke":
+        # The tpch driver's --query record (docs/QUERY.md): the whole
+        # plan graded end to end — oracle equality, warm traces, the
+        # exact per-operator wire bytes — whose merged per-operator
+        # counter signature the perfgate lane gates against
+        # results/baselines/query_smoke.json.
+        for key in ("kind", "n_ranks", "query", "plan_digest",
+                    "n_operators", "groups", "oracle_equal",
+                    "warm_new_traces", "wire_exact", "wire",
+                    "counter_signature"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        sig = doc.get("counter_signature")
+        if isinstance(sig, dict):
+            if not isinstance(sig.get("counters"), dict):
+                problems.append("counter_signature missing "
+                                "'counters'")
+        elif "counter_signature" in doc:
+            problems.append("counter_signature is not an object")
+        return problems
     elif name.startswith("explain") or doc.get("kind") == "explain":
         # The EXPLAIN artifact (planning/plan.py): a plan + cost
         # prediction pair, recognized by basename OR kind stamp.
@@ -1413,6 +1543,35 @@ def main(argv=None) -> int:
         if args.cmd == "explain":
             with open(args.explain) as f:
                 explain_doc = json.load(f)
+            if explain_doc.get("kind") == "queryplan":
+                # Multi-operator plans grade against the --query
+                # record's per-operator wire list (docs/QUERY.md).
+                record = None
+                if args.record:
+                    from distributed_join_tpu.benchmarks import (
+                        load_record,
+                    )
+
+                    record = load_record(args.record)
+                grade = grade_queryplan(explain_doc, record)
+                if args.json:
+                    print(json.dumps(grade, indent=1))
+                else:
+                    print(format_queryplan_grade(grade))
+                if args.gate_wire_bytes and not args.no_gate:
+                    if grade.get("wire_match") is None:
+                        print("error: --gate-wire-bytes needs a "
+                              "--record with measured per-operator "
+                              "wire counters (--query driver "
+                              "record)", file=sys.stderr)
+                        return 1
+                    if not grade["wire_match"]:
+                        print("wire-byte gate FAILED: a predicted "
+                              "operator wire size diverged from "
+                              "the measured counter",
+                              file=sys.stderr)
+                        return 2
+                return 0
             metrics, record = None, None
             if args.run:
                 run = load_run(args.run)

@@ -25,8 +25,15 @@ runs device-side but sizes its outputs before the timed join.
 Simplifications vs real dbgen, documented for honesty: text/enum
 columns (comments, priorities, clerk ids) are omitted — they don't
 affect join structure; prices are independent uniform ints rather than
-part-price-derived; no customer table yet (Q3's customer leg is the
-segment filter, stubbed as a row mask).
+part-price-derived.
+
+The QUERY-plan tables (:func:`generate_tpch_query_tables`, used by the
+``--query`` driver path, the daemon's ``query`` wire op, and the
+multi-operator tests) add the ``customer`` leg: SF * 150k customers
+with dbgen's dense keys, a 5-way market segment, an account balance
+and a nation key; ``orders`` additionally carries ``o_custkey``. Key
+columns are returned under the UNIFIED names the canonical plans join
+on (``custkey``, ``orderkey``).
 """
 
 from __future__ import annotations
@@ -39,6 +46,8 @@ import jax.numpy as jnp
 from distributed_join_tpu.table import Table
 
 ORDERS_PER_SF = 1_500_000
+CUSTOMERS_PER_SF = 150_000
+N_MKT_SEGMENTS = 5
 DATE_RANGE_DAYS = 2406       # 1992-01-01 .. 1998-08-02
 MAX_SHIP_LAG_DAYS = 121
 MAX_LINES_PER_ORDER = 7
@@ -115,6 +124,84 @@ def generate_tpch_join_tables(
     orders = generate_orders(ko, scale_factor)
     lineitem = generate_lineitem(kl, scale_factor, orders)
     return orders, lineitem
+
+
+def generate_customer(key: jax.Array, scale_factor: float) -> Table:
+    """SF * 150k customers, dbgen's DENSE keys 1..n (contrast the
+    sparse order keys): ``c_mktsegment`` uniform over 5 segments,
+    ``c_acctbal`` cents in dbgen's [-999.99, 9999.99] window,
+    ``c_nationkey`` 0..24."""
+    n = int(CUSTOMERS_PER_SF * scale_factor)
+    k_seg, k_bal, k_nat = jax.random.split(key, 3)
+    return Table.from_dense({
+        "c_custkey": jnp.arange(1, n + 1, dtype=jnp.int64),
+        "c_mktsegment": jax.random.randint(
+            k_seg, (n,), 0, N_MKT_SEGMENTS, dtype=jnp.int32),
+        "c_acctbal": jax.random.randint(
+            k_bal, (n,), -99_999, 1_000_000, dtype=jnp.int64),
+        "c_nationkey": jax.random.randint(
+            k_nat, (n,), 0, 25, dtype=jnp.int32),
+    })
+
+
+def generate_tpch_query_tables(seed: int, scale_factor: float) -> dict:
+    """The 3-table family the multi-operator plans consume:
+    ``{"customer", "orders", "lineitem"}`` with the join keys under
+    their UNIFIED plan names — ``custkey`` on customer+orders,
+    ``orderkey`` on orders+lineitem — so the canonical
+    :func:`~..planning.query.tpch_query_plan` chains run without a
+    rename step. ``orders`` gains the ``o_custkey`` FK (uniform over
+    the customer keys; about a third of customers place no order in
+    real dbgen — uniform assignment keeps the same join structure,
+    unmatched customers included, without the skew table)."""
+    kc, ko, kl, kf = jax.random.split(jax.random.PRNGKey(seed), 4)
+    customer = generate_customer(kc, scale_factor)
+    orders = generate_orders(ko, scale_factor)
+    lineitem = generate_lineitem(kl, scale_factor, orders)
+    n_cust = customer.capacity
+    custkey = jax.random.randint(
+        kf, (orders.capacity,), 1, n_cust + 1, dtype=jnp.int64)
+
+    def renamed(table, mapping):
+        cols = {mapping.get(name, name): col
+                for name, col in table.columns.items()}
+        return Table(cols, table.valid)
+
+    orders = Table(dict(orders.columns, o_custkey=custkey),
+                   orders.valid)
+    return {
+        "customer": renamed(customer, {"c_custkey": "custkey"}),
+        "orders": renamed(orders, {"o_custkey": "custkey",
+                                   "o_orderkey": "orderkey"}),
+        "lineitem": renamed(lineitem, {"l_orderkey": "orderkey"}),
+    }
+
+
+def query_filters(tables: dict, query: str,
+                  cutoff_day: int = DATE_RANGE_DAYS // 2,
+                  segment: int = 1) -> dict:
+    """The canonical queries' predicates as validity masks (static
+    shapes, applied before the plan runs — filters are upstream of the
+    compiled program). Q3: ``c_mktsegment == segment``,
+    ``o_orderdate < cutoff``, ``l_shipdate > cutoff``. Q10:
+    ``o_orderdate`` in a quarter-long window starting at ``cutoff``
+    (dbgen's 3-month return window)."""
+    c, o, l = (tables["customer"], tables["orders"],
+               tables["lineitem"])
+    if query == "q3":
+        c = Table(c.columns,
+                  c.valid & (c.columns["c_mktsegment"] == segment))
+        o = Table(o.columns,
+                  o.valid & (o.columns["o_orderdate"] < cutoff_day))
+        l = Table(l.columns,
+                  l.valid & (l.columns["l_shipdate"] > cutoff_day))
+    elif query == "q10":
+        win = (o.columns["o_orderdate"] >= cutoff_day) & \
+              (o.columns["o_orderdate"] < cutoff_day + 90)
+        o = Table(o.columns, o.valid & win)
+    else:
+        raise ValueError(f"unknown query {query!r}")
+    return {"customer": c, "orders": o, "lineitem": l}
 
 
 def q3_filter(

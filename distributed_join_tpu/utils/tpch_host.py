@@ -183,3 +183,85 @@ def rename_batches(batches: HostBatches, mapping: dict) -> HostBatches:
     return [
         {mapping.get(n, n): c for n, c in cols.items()} for cols in batches
     ]
+
+
+# -- whole-query pandas oracle (multi-operator plans) ------------------
+#
+# The query drivers/tests grade END TO END: not per-join counters but
+# the final rows/groups of the whole plan against a pandas replay of
+# the same DAG. The replay mirrors the device semantics exactly —
+# probe is the preserved (LEFT) side, NULL-filled absent payloads are
+# zero, outer types add the `build#valid`/`probe#valid` columns — so
+# `ops.aggregate.frames_equal` can compare frames verbatim.
+
+
+def _merge_oracle(probe_df, build_df, keys, join_type):
+    keys = list(keys)
+    if join_type in ("semi", "anti"):
+        bk = build_df[keys].drop_duplicates()
+        m = probe_df.merge(bk, on=keys, how="left", indicator=True)
+        keep = m["_merge"] == "both"
+        if join_type == "anti":
+            keep = ~keep
+        return m[keep].drop(columns="_merge").reset_index(drop=True)
+    how = {"inner": "inner", "left": "left", "right": "right",
+           "full_outer": "outer"}[join_type]
+    dtypes = {}
+    for df in (build_df, probe_df):
+        for col in df.columns:
+            dtypes[col] = df[col].dtype
+    m = probe_df.merge(build_df, on=keys, how=how,
+                       indicator=(join_type != "inner"))
+    if join_type == "inner":
+        return m.reset_index(drop=True)
+    if join_type in ("left", "full_outer"):
+        m["build#valid"] = m["_merge"] != "left_only"
+    if join_type in ("right", "full_outer"):
+        m["probe#valid"] = m["_merge"] != "right_only"
+    m = m.drop(columns="_merge").fillna(0)
+    for col, dt in dtypes.items():   # fillna widened ints to float
+        if col in m.columns:
+            m[col] = m[col].astype(dt)
+    return m.reset_index(drop=True)
+
+
+def query_oracle(plan, frames: dict):
+    """Replay ``plan`` (a :class:`~..planning.query.QueryPlan`) over
+    host DataFrames (``Table.to_pandas`` of the VALID rows of each
+    base table). Returns the final frame: joined rows for a
+    materializing plan, one row per group (sorted by the group keys)
+    when the plan ends in a fused aggregate."""
+    import pandas as pd
+
+    from distributed_join_tpu.ops.aggregate import AggregateSpec
+
+    env = dict(frames)
+    for op in plan.ops:
+        env[op.op_id] = _merge_oracle(
+            env[op.probe], env[op.build], op.keys, op.join_type)
+    final = env[plan.ops[-1].op_id]
+    wire = plan.ops[-1].aggregate
+    if wire is None:
+        return final
+    spec = AggregateSpec.from_wire(wire)
+    gk = list(spec.group_keys)
+    g = final.groupby(gk, sort=True)
+    out = {}
+    for a in spec.aggs:
+        if a.op == "count":
+            out[a.name] = g.size()
+        elif a.op == "sum":
+            out[a.name] = g[a.column].sum()
+        elif a.op == "min":
+            out[a.name] = g[a.column].min()
+        elif a.op == "max":
+            out[a.name] = g[a.column].max()
+        elif a.op == "mean":
+            out[a.name] = g[a.column].mean()
+        else:
+            raise ValueError(f"oracle: unknown agg op {a.op!r}")
+    for c in spec.carry:
+        # Any-value-per-group on the device; the carry contract
+        # (key-functional columns) makes `first` equivalent.
+        out[c] = g[c].first()
+    return pd.DataFrame(out).reset_index()

@@ -76,6 +76,23 @@
 #                                   # agg_smoke.json — plus the tpch
 #                                   # driver's --agg mode (oracle-
 #                                   # graded in-driver)
+#   scripts/run_tier1.sh query      # multi-operator query plans
+#                                   # (docs/QUERY.md): -m query suite
+#                                   # (join-type family edge cases,
+#                                   # plan validation/refusals, ONE-
+#                                   # program compile lock, service
+#                                   # query op) + a deterministic
+#                                   # CPU-mesh Q3 driver smoke —
+#                                   # whole-query pandas-oracle
+#                                   # equality, zero warm traces, ONE
+#                                   # traced program, the exact per-
+#                                   # operator wire-byte prediction
+#                                   # (analyze explain
+#                                   # --gate-wire-bytes on the
+#                                   # queryplan artifact), and the
+#                                   # merged per-operator counter
+#                                   # signature gated vs results/
+#                                   # baselines/query_smoke.json
 #   scripts/run_tier1.sh sortpath   # segmented-sort join pipeline:
 #                                   # -m sortpath suite + a
 #                                   # deterministic CPU-mesh
@@ -322,6 +339,22 @@ json.dump(ab, open(f"{sys.argv[1]}/sortpath_smoke.json", "w"),
 PY
     python -m distributed_join_tpu.telemetry.analyze compare \
       "$tmp/sortpath_smoke.json" --baseline sortpath_smoke
+    # The query-plan smoke's counter signature is part of the same
+    # gate (docs/QUERY.md): the canonical Q3 plan compiled as ONE
+    # SPMD program, every operator's counters under an op-id prefix
+    # — a changed re-shard seam, wire-column restriction, fused-
+    # aggregate exchange, or capacity rung in ANY operator moves
+    # them. The oracle/trace/wire-exact gates live in the query
+    # lane.
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.benchmarks.tpch_join \
+      --platform cpu --n-ranks 8 --query q3 --scale-factor 0.01 \
+      --iterations 1 --json-output "$tmp/query_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/query_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/query_smoke.json" --baseline query_smoke
     # The fleet smoke's counter signature is part of the same gate
     # (docs/FLEET.md): the scripted-kill protocol's deterministic
     # match + trace counters — a changed router, affinity hash,
@@ -410,6 +443,54 @@ assert rec["agg"] and agg["oracle_equal"], rec
 print(f"tpch --agg: {agg['groups']} groups oracle-exact, "
       f"{rec['matches_per_join']} would-be join rows fused away")
 PY
+    ;;
+  query)
+    # Multi-operator query plans (docs/QUERY.md). 1. the -m query
+    # unit suite (the six-way join-type family vs the pandas oracle
+    # incl. empty-build/all-unmatched/dup-heavy-overflow/string-key
+    # edges, plan normalization + the refusal matrix, the ONE-
+    # program compile lock, digest-keyed warm serving, the service
+    # `query` wire op and its counters); 2. a deterministic CPU-mesh
+    # Q3 driver smoke: whole-query pandas-oracle equality (the
+    # driver itself exits nonzero on divergence), ONE traced
+    # program, zero warm traces, the queryplan artifact schema-
+    # checked, its per-operator padded wire-byte prediction gated
+    # EXACTLY (analyze explain --gate-wire-bytes), and the merged
+    # per-operator counter signature gated vs the committed
+    # query_smoke baseline. Wall time is never gated on the CPU
+    # mesh (emulation, not perf).
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/ -q -m query --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    tmp="$(mktemp -d /tmp/djtpu_query.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.benchmarks.tpch_join \
+      --platform cpu --n-ranks 8 --query q3 --scale-factor 0.01 \
+      --iterations 1 --explain --telemetry "$tmp/tel" \
+      --json-output "$tmp/query_smoke.json"
+    python - "$tmp" <<'PY'
+import json, sys
+rec = json.load(open(f"{sys.argv[1]}/query_smoke.json"))
+assert rec["oracle_equal"], rec
+assert rec["warm_new_traces"] == 0, rec
+assert rec["programs_traced"] == 1, rec
+assert rec["retry_attempts"] == 0, rec
+assert rec["wire_exact"], rec["wire"]
+assert rec["n_operators"] == 3, rec
+print(f"query smoke: q3 as ONE program, {rec['groups']} groups "
+      f"oracle-exact, 0 warm traces, wire bytes exact over "
+      f"{len(rec['wire'])} operators")
+PY
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/query_smoke.json" "$tmp/tel/explain.json"
+    python -m distributed_join_tpu.telemetry.analyze explain \
+      "$tmp/tel/explain.json" --record "$tmp/query_smoke.json" \
+      --gate-wire-bytes
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/query_smoke.json" --baseline query_smoke
     ;;
   sortpath)
     # Segmented-sort join pipeline (docs/ROOFLINE.md §9). 1. the

@@ -175,6 +175,83 @@ def test_probe_mode_over_decomposition(comm, probe_grouped):
     _grade(res, build, probe, SPEC_PROBE, ["grp"], comm)
 
 
+# -- build-mode pushdown (group key on the BUILD side) -----------------
+
+
+@pytest.fixture(scope="module")
+def build_grouped():
+    """Build-side group column (few distinct values) with a carry
+    functionally dependent on it — the build-mode settle path."""
+    return _build_grouped_tables(7, 512, 1024, 256, 16)
+
+
+def _build_grouped_tables(seed, nb, npr, kmax, gmax):
+    rng = np.random.default_rng(seed)
+    bg = rng.integers(0, gmax, nb).astype(np.int64)
+    build = Table.from_dense({
+        "key": jnp.asarray(rng.integers(0, kmax, nb), jnp.int64),
+        "bgroup": jnp.asarray(bg),
+        "bval": jnp.asarray(rng.integers(0, 1000, nb), jnp.int64),
+        # carry must be key-functional on the group key
+        "bcarry": jnp.asarray(bg * 10 + 3),
+    })
+    probe = Table.from_dense({
+        "key": jnp.asarray(rng.integers(0, kmax, npr), jnp.int64),
+        "pval": jnp.asarray(rng.integers(0, 1000, npr), jnp.int64),
+    })
+    return build, probe
+
+
+BUILD_SPECS = [
+    AggregateSpec.of("bgroup", [("count", None)]),
+    AggregateSpec.of("bgroup", [("sum", "bval"), ("sum", "pval")]),
+    AggregateSpec.of("bgroup", [("min", "bval"), ("max", "pval"),
+                                ("min", "pval"), ("max", "bval")]),
+    AggregateSpec.of("bgroup", [("mean", "pval"), ("mean", "bval")]),
+    AggregateSpec.of("bgroup", [("count", None), ("sum", "pval")],
+                     carry=["bcarry"]),
+]
+
+
+@pytest.mark.parametrize("spec", BUILD_SPECS,
+                         ids=["count", "sums", "minmax", "means",
+                              "carry"])
+def test_build_mode_oracle(comm, build_grouped, spec):
+    build, probe = build_grouped
+    res = distributed_inner_join(build, probe, comm, key="key",
+                                 aggregate=spec, auto_retry=4)
+    assert not bool(res.overflow)
+    _grade(res, build, probe, spec, ["bgroup"], comm)
+
+
+def test_build_mode_dup_heavy(comm):
+    """Four groups over 32 hot keys: every rank combines partials for
+    every group."""
+    build, probe = _build_grouped_tables(8, 64, 2048, 32, 4)
+    spec = BUILD_SPECS[1]
+    res = distributed_inner_join(build, probe, comm, key="key",
+                                 aggregate=spec, auto_retry=4)
+    assert not bool(res.overflow)
+    _grade(res, build, probe, spec, ["bgroup"], comm, auto_retry=6)
+
+
+@pytest.mark.parametrize("opts", [
+    {"over_decomposition": 2},
+    {"shuffle": "ragged"},
+    {"shuffle": "ppermute", "over_decomposition": 2},
+], ids=["overdecomp", "ragged", "ppermute-k2"])
+def test_build_mode_shuffle_variants(comm, opts):
+    """Build-side groups survive re-batching: the cross-batch combine
+    must merge partials for groups recurring across batches."""
+    build, probe = _build_grouped_tables(11, 400, 3000, 128, 8)
+    spec = BUILD_SPECS[1]
+    res = distributed_inner_join(build, probe, comm, key="key",
+                                 aggregate=spec, auto_retry=4, **opts)
+    assert not bool(res.overflow)
+    _grade(res, build, probe, spec, ["bgroup"], comm, auto_retry=6,
+           **opts)
+
+
 @pytest.mark.hier
 def test_hierarchical_pushdown(probe_grouped, tables):
     hcomm = HierarchicalTpuCommunicator(n_slices=2, n_ranks=8)
@@ -233,8 +310,8 @@ def test_explicit_groups_overflow_is_loud(comm, tables):
 
 @pytest.mark.parametrize("spec,opts,reason", [
     (AggregateSpec.of("key", [("sum", "nope")]), {}, "not found"),
-    (AggregateSpec.of("build_payload", [("count", None)]), {},
-     "BUILD side"),
+    (AggregateSpec.of(["build_payload", "probe_payload"],
+                      [("count", None)]), {}, "span BOTH sides"),
     (AggregateSpec.of("key", [("sum", "key")]), {}, "join key"),
     (SPEC_KEY, {"skew_threshold": 0.001}, "skew sidecar"),
     (SPEC_KEY, {"build_payload": ["build_payload"]}, "payload lists"),

@@ -1,0 +1,261 @@
+"""The six-way join-type family (docs/QUERY.md) on the 8-virtual-
+device CPU mesh, graded against the pandas oracle.
+
+Contracts:
+
+- **Oracle exactness.** ``inner | left | right | full_outer | semi |
+  anti`` each equal the pandas merge with the probe as the preserved
+  LEFT side — outer types add the ``build#valid`` / ``probe#valid``
+  columns with zero-filled absent payloads, semi/anti emit probe
+  columns only. Covered across duplicate-heavy keys, empty build,
+  all-unmatched probe, string keys, and the single-rank path.
+- **Never wrong rows.** The dup-heavy outer fan-out overflows LOUDLY
+  when capacities are short, and the auto-retry ladder recovers it to
+  the exact oracle.
+- **Serving discipline.** Every type is its own program-cache entry:
+  the warm repeat of each type builds zero new SPMD programs and adds
+  zero traces (CountingComm-locked).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from distributed_join_tpu.ops.join import (
+    BUILD_VALID,
+    JOIN_TYPES,
+    PROBE_VALID,
+)
+from distributed_join_tpu.parallel.communicator import (
+    LocalCommunicator,
+    TpuCommunicator,
+)
+from distributed_join_tpu.parallel.distributed_join import (
+    distributed_inner_join,
+)
+from distributed_join_tpu.service.programs import JoinProgramCache
+from distributed_join_tpu.table import Table
+from distributed_join_tpu.utils.generators import (
+    generate_build_probe_tables,
+)
+from distributed_join_tpu.utils.strings import add_string_column
+from distributed_join_tpu.utils.tpch_host import _merge_oracle
+
+pytestmark = pytest.mark.query
+
+
+@pytest.fixture(scope="module")
+def comm8():
+    return TpuCommunicator(n_ranks=8)
+
+
+class CountingComm(TpuCommunicator):
+    """Counts built SPMD programs — a cache hit must add zero."""
+
+    def __init__(self, n_ranks: int = 8):
+        super().__init__(n_ranks=n_ranks)
+        self.programs_built = 0
+
+    def spmd(self, fn, *, sharded_out=None):
+        self.programs_built += 1
+        return super().spmd(fn, sharded_out=sharded_out)
+
+
+def _tables(seed=31, nb=512, npr=1024, rand_max=512):
+    return generate_build_probe_tables(
+        seed=seed, build_nrows=nb, probe_nrows=npr,
+        rand_max=rand_max, selectivity=0.4,
+    )
+
+
+def _check(res, build, probe, join_type, keys=("key",)):
+    """Grade a typed join result against the whole-frame pandas
+    oracle (sort-normalized multiset equality over every column)."""
+    assert not bool(res.overflow), join_type
+    got = res.table.to_pandas()
+    want = _merge_oracle(probe.to_pandas(), build.to_pandas(),
+                         list(keys), join_type)
+    assert int(res.total) == len(want), join_type
+    cols = sorted(want.columns)
+    assert sorted(got.columns) == cols, (join_type, got.columns)
+    g = got[cols].sort_values(cols).reset_index(drop=True)
+    w = want[cols].sort_values(cols).reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        g.astype("int64"), w.astype("int64"))
+
+
+@pytest.mark.parametrize("join_type", JOIN_TYPES)
+def test_types_match_oracle(comm8, join_type):
+    build, probe = _tables()
+    res = distributed_inner_join(
+        build, probe, comm8, join_type=join_type,
+        out_capacity_factor=4.0)
+    _check(res, build, probe, join_type)
+
+
+def test_empty_build(comm8):
+    """A fully-invalid build side: inner/right/semi emit nothing,
+    left/full_outer/anti preserve every probe row."""
+    build, probe = _tables(seed=32)
+    empty = Table(build.columns, jnp.zeros(build.capacity, bool))
+    n_probe = int(probe.num_valid())
+    for join_type, want_rows in (
+            ("inner", 0), ("right", 0), ("semi", 0),
+            ("left", n_probe), ("full_outer", n_probe),
+            ("anti", n_probe)):
+        res = distributed_inner_join(
+            empty, probe, comm8, join_type=join_type,
+            out_capacity_factor=4.0)
+        assert not bool(res.overflow), join_type
+        assert int(res.total) == want_rows, join_type
+        _check(res, empty, probe, join_type)
+
+
+def test_all_unmatched_probe(comm8):
+    """Disjoint key ranges: anti keeps EVERYTHING, semi keeps
+    nothing, left keeps everything with build#valid all-False."""
+    rng = np.random.default_rng(33)
+    build = Table.from_dense({
+        "key": jnp.asarray(rng.integers(0, 300, 512), jnp.int64),
+        "bval": jnp.asarray(rng.integers(0, 100, 512), jnp.int64)})
+    probe = Table.from_dense({
+        "key": jnp.asarray(rng.integers(1000, 1300, 1024),
+                           jnp.int64),
+        "pval": jnp.asarray(rng.integers(0, 100, 1024), jnp.int64)})
+    anti = distributed_inner_join(build, probe, comm8,
+                                  join_type="anti",
+                                  out_capacity_factor=4.0)
+    assert int(anti.total) == 1024
+    _check(anti, build, probe, "anti")
+    semi = distributed_inner_join(build, probe, comm8,
+                                  join_type="semi",
+                                  out_capacity_factor=4.0)
+    assert int(semi.total) == 0
+    left = distributed_inner_join(build, probe, comm8,
+                                  join_type="left",
+                                  out_capacity_factor=4.0)
+    assert int(left.total) == 1024
+    got = left.table.to_pandas()
+    assert not got[BUILD_VALID].any()
+    assert (got["bval"] == 0).all()  # absent payloads zero-filled
+
+
+def test_dup_heavy_outer_overflow_and_ladder(comm8):
+    """The duplicate-key full_outer fan-out must overflow LOUDLY on a
+    short output block, and the auto-retry ladder must recover it to
+    the exact oracle — never silently dropped rows."""
+    build, probe = _tables(seed=34, nb=1024, npr=2048, rand_max=64)
+    starved = distributed_inner_join(
+        build, probe, comm8, join_type="full_outer",
+        out_capacity_factor=0.25, auto_retry=0)
+    assert bool(starved.overflow)
+    res = distributed_inner_join(
+        build, probe, comm8, join_type="full_outer",
+        out_capacity_factor=0.25, auto_retry=6)
+    assert not bool(res.overflow)
+    assert res.retry_report.attempts, "ladder should have escalated"
+    _check(res, build, probe, "full_outer")
+
+
+def test_string_key_left_join(comm8):
+    """String join keys ride the typed path: unmatched probe rows
+    keep their decoded key with build#valid False and zero-filled
+    build payload."""
+    rng = np.random.default_rng(35)
+    nb, npr = 512, 1024
+    bids = rng.integers(0, 200, nb)
+    pids = rng.integers(100, 400, npr)  # half the probe unmatched
+    bcols = add_string_column(
+        {"bv": jnp.asarray(rng.integers(1, 1000, nb), jnp.int64)},
+        "name", [f"n{i:05d}" for i in bids], 10)
+    pcols = add_string_column(
+        {"pv": jnp.asarray(rng.integers(1, 1000, npr), jnp.int64)},
+        "name", [f"n{i:05d}" for i in pids], 10)
+    build = Table(bcols, jnp.ones(nb, bool))
+    probe = Table(pcols, jnp.ones(npr, bool))
+    res = distributed_inner_join(
+        build, probe, comm8, key="name", join_type="left",
+        out_capacity_factor=4.0)
+    assert not bool(res.overflow)
+    got = res.table.to_pandas()
+    bdf = pd.DataFrame({"name": [f"n{i:05d}" for i in bids],
+                        "bv": np.asarray(bcols["bv"])})
+    pdf = pd.DataFrame({"name": [f"n{i:05d}" for i in pids],
+                        "pv": np.asarray(pcols["pv"])})
+    want = _merge_oracle(pdf, bdf, ["name"], "left")
+    assert int(res.total) == len(want)
+    cols = ["name", "bv", "pv", BUILD_VALID]
+    g = got[cols].sort_values(cols).reset_index(drop=True)
+    w = want[cols].sort_values(cols).reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, w)
+    unmatched = got[~got[BUILD_VALID]]
+    assert len(unmatched) and (unmatched["bv"] == 0).all()
+
+
+@pytest.mark.parametrize("join_type", JOIN_TYPES)
+def test_single_rank_types(join_type):
+    build, probe = _tables(seed=36, nb=400, npr=800, rand_max=400)
+    res = distributed_inner_join(
+        build, probe, LocalCommunicator(), join_type=join_type,
+        out_capacity_factor=4.0)
+    _check(res, build, probe, join_type)
+
+
+def test_outer_validity_columns_by_type(comm8):
+    """Exactly the documented validity columns appear: left ->
+    build#valid, right -> probe#valid, full_outer -> both, inner/
+    semi/anti -> neither."""
+    build, probe = _tables(seed=37)
+    expect = {"inner": set(), "semi": set(), "anti": set(),
+              "left": {BUILD_VALID}, "right": {PROBE_VALID},
+              "full_outer": {BUILD_VALID, PROBE_VALID}}
+    for join_type, want in expect.items():
+        res = distributed_inner_join(
+            build, probe, comm8, join_type=join_type,
+            out_capacity_factor=4.0)
+        have = {c for c in res.table.column_names
+                if c in (BUILD_VALID, PROBE_VALID)}
+        assert have == want, join_type
+
+
+def test_warm_zero_trace_per_type():
+    """Each join type is its own cached program; the warm repeat of
+    every type builds zero new SPMD programs and adds zero traces."""
+    ccomm = CountingComm(n_ranks=8)
+    cache = JoinProgramCache(ccomm)
+    build, probe = _tables(seed=38)
+    for join_type in JOIN_TYPES:
+        distributed_inner_join(
+            build, probe, ccomm, join_type=join_type,
+            out_capacity_factor=4.0, program_cache=cache)
+    built0, traces0 = ccomm.programs_built, cache.traces
+    assert built0 == len(JOIN_TYPES)
+    for join_type in JOIN_TYPES:
+        res = distributed_inner_join(
+            build, probe, ccomm, join_type=join_type,
+            out_capacity_factor=4.0, program_cache=cache)
+        assert not bool(res.overflow)
+    assert ccomm.programs_built == built0
+    assert cache.traces == traces0
+
+
+def test_typed_refusals(comm8):
+    """The documented refusal seams: unknown type, skew sidecar,
+    aggregate pushdown, segmented sort."""
+    from distributed_join_tpu.ops.aggregate import AggregateSpec
+
+    build, probe = _tables(seed=39)
+    with pytest.raises(ValueError, match="join_type"):
+        distributed_inner_join(build, probe, comm8,
+                               join_type="cross")
+    with pytest.raises(ValueError, match="skew"):
+        distributed_inner_join(build, probe, comm8, join_type="left",
+                               skew_threshold=8)
+    with pytest.raises(ValueError, match="aggregate"):
+        distributed_inner_join(
+            build, probe, comm8, join_type="left",
+            aggregate=AggregateSpec.of("key", [("count", None)]))
+    with pytest.raises(ValueError, match="segmented"):
+        distributed_inner_join(build, probe, comm8, join_type="left",
+                               sort_mode="segmented")

@@ -1,0 +1,338 @@
+"""Multi-operator query plans (planning/query.py +
+parallel/query_exec.py) on the 8-virtual-device CPU mesh.
+
+Contracts (docs/QUERY.md):
+
+- **One program per plan.** The whole chain — every join plus the
+  fused terminal aggregate — compiles as ONE SPMD program; the warm
+  repeat through the program cache builds zero new programs and adds
+  zero traces. Intermediates stay sharded on device.
+- **Whole-query oracle exactness.** The canonical TPC-H Q3/Q10 plans
+  (customer ⋈ orders ⋈ lineitem -> group-by) equal the pandas replay
+  of the same DAG (utils/tpch_host.query_oracle) exactly.
+- **Loud refusal.** Malformed plans — unknown refs, DAG fan-out,
+  dangling ops, non-terminal aggregates, payload collisions, unknown
+  knobs — raise ``ValueError("query plan unsupported: ...")`` at plan
+  time, never a wrong answer at run time.
+- **Identity.** ``canonical()``/``from_wire`` round-trip the digest;
+  the digest keys the program cache and the fleet's affinity routing.
+- **Introspection.** ``explain_query`` prices every operator and the
+  join-order candidates; the record passes ``analyze check``.
+- **Serving.** The service's ``query`` op runs the plan under full
+  admission/observability discipline with its own counters and
+  Prometheus gauges.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributed_join_tpu import telemetry
+from distributed_join_tpu.ops.aggregate import (
+    AggregateSpec,
+    frames_equal,
+    groups_frame,
+)
+from distributed_join_tpu.parallel.communicator import (
+    LocalCommunicator,
+    TpuCommunicator,
+)
+from distributed_join_tpu.parallel.query_exec import (
+    QuerySignature,
+    distributed_query,
+)
+from distributed_join_tpu.planning.query import (
+    QueryPlan,
+    TPCH_QUERIES,
+    explain_query,
+    tpch_query_plan,
+)
+from distributed_join_tpu.service.programs import JoinProgramCache
+from distributed_join_tpu.utils.tpch import (
+    generate_tpch_query_tables,
+    query_filters,
+)
+from distributed_join_tpu.utils.tpch_host import query_oracle
+
+pytestmark = pytest.mark.query
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_session():
+    telemetry.finalize()
+    yield
+    telemetry.finalize()
+
+
+@pytest.fixture(scope="module")
+def comm8():
+    return TpuCommunicator(n_ranks=8)
+
+
+@pytest.fixture(scope="module")
+def qtables():
+    return generate_tpch_query_tables(seed=7, scale_factor=0.004)
+
+
+class CountingComm(TpuCommunicator):
+    """Counts built SPMD programs — a cache hit must add zero."""
+
+    def __init__(self, n_ranks: int = 8):
+        super().__init__(n_ranks=n_ranks)
+        self.programs_built = 0
+
+    def spmd(self, fn, *, sharded_out=None):
+        self.programs_built += 1
+        return super().spmd(fn, sharded_out=sharded_out)
+
+
+def _grade(plan, tables, res):
+    spec = plan.aggregate
+    got = groups_frame(res.table, spec, list(spec.group_keys))
+    frames = {k: v.to_pandas() for k, v in tables.items()}
+    want = query_oracle(plan, frames)
+    assert frames_equal(got, want), (len(got), len(want))
+    return got
+
+
+# -- whole-query oracle exactness --------------------------------------
+
+
+@pytest.mark.parametrize("query", TPCH_QUERIES)
+def test_tpch_query_oracle_exact(comm8, qtables, query):
+    plan = tpch_query_plan(query)
+    tables = query_filters(qtables, query)
+    res = distributed_query(tables, plan, comm8, auto_retry=4)
+    assert not bool(res.overflow)
+    got = _grade(plan, tables, res)
+    assert len(got) > 0
+    assert res.plan_digest == plan.digest()
+
+
+def test_query_single_rank(qtables):
+    plan = tpch_query_plan("q3")
+    tables = query_filters(qtables, "q3")
+    res = distributed_query(tables, plan, LocalCommunicator(),
+                            auto_retry=4)
+    assert not bool(res.overflow)
+    _grade(plan, tables, res)
+
+
+def test_whole_plan_is_one_program_and_serves_warm(qtables):
+    """THE composition property: both joins + the fused aggregate
+    lower into ONE SPMD program, and the digest-keyed warm repeat
+    builds zero new programs."""
+    ccomm = CountingComm(n_ranks=8)
+    cache = JoinProgramCache(ccomm)
+    plan = tpch_query_plan("q3")
+    tables = query_filters(qtables, "q3")
+    res = distributed_query(tables, plan, ccomm, auto_retry=4,
+                            program_cache=cache)
+    assert not bool(res.overflow)
+    assert res.retry_attempts == 0
+    assert ccomm.programs_built == 1
+    assert cache.traces == 1
+    assert not res.cache_hit
+    res2 = distributed_query(tables, plan, ccomm, auto_retry=4,
+                             program_cache=cache)
+    assert ccomm.programs_built == 1
+    assert cache.traces == 1
+    assert res2.cache_hit
+    assert int(res2.total) == int(res.total)
+    # per-operator totals ride out as device scalars
+    assert len(res2.op_totals) == len(plan.ops)
+
+
+# -- identity ----------------------------------------------------------
+
+
+def test_digest_roundtrip_and_stability():
+    plan = tpch_query_plan("q3")
+    redone = QueryPlan.from_wire(plan.canonical())
+    assert redone.digest() == plan.digest()
+    assert redone.canonical() == plan.canonical()
+    assert tpch_query_plan("q10").digest() != plan.digest()
+    # option dict ordering is canonicalized away
+    a = QueryPlan.of([{"op": "join", "id": "j", "build": "b",
+                       "probe": "p", "key": "k",
+                       "options": {"over_decomposition": 2,
+                                   "shuffle": "padded"}}])
+    b = QueryPlan.of([{"op": "join", "id": "j", "build": "b",
+                       "probe": "p", "key": "k",
+                       "options": {"shuffle": "padded",
+                                   "over_decomposition": 2}}])
+    assert a.digest() == b.digest()
+
+
+def test_query_signature_keys_on_rung(comm8, qtables):
+    plan = tpch_query_plan("q3")
+    tables = query_filters(qtables, "q3")
+    s0 = QuerySignature.of(comm8, plan, tables, rung=0)
+    s0b = QuerySignature.of(comm8, plan, tables, rung=0)
+    s1 = QuerySignature.of(comm8, plan, tables, rung=1)
+    assert s0.digest() == s0b.digest()
+    assert s0.digest() != s1.digest()
+    assert s0.plan_digest == plan.digest()
+
+
+# -- the refusal matrix ------------------------------------------------
+
+
+def _join(op_id="j1", build="b", probe="p", key="k", **kw):
+    return {"op": "join", "id": op_id, "build": build,
+            "probe": probe, "key": key, **kw}
+
+
+def _refusal(match, ops, tables=None):
+    with pytest.raises(ValueError,
+                       match=f"query plan unsupported: .*{match}"):
+        QueryPlan.of(ops, tables=tables)
+
+
+def test_plan_refusals():
+    spec = AggregateSpec.of("k", [("count", None)])
+    _refusal("empty", [])
+    _refusal("no key", [_join(key=[])])
+    _refusal("join_type", [_join(join_type="cross")])
+    _refusal("plan-settable", [_join(options={"skew": 1})])
+    _refusal("duplicate", [_join(), _join()])
+    _refusal("no join operators",
+             [{"op": "aggregate", "id": "a", "input": "j",
+               "spec": spec}])
+    _refusal("kind", [{"op": "scan", "id": "s"}])
+    _refusal("missing an 'id'", [{"op": "join"}])
+    # aggregate must consume the TERMINAL join
+    _refusal("terminal", [
+        _join("j1"),
+        _join("j2", build="j1", probe="q"),
+        {"op": "aggregate", "id": "a", "input": "j1", "spec": spec}])
+    _refusal("more than one aggregate", [
+        _join("j1"),
+        {"op": "aggregate", "id": "a1", "input": "j1", "spec": spec},
+        {"op": "aggregate", "id": "a2", "input": "j1", "spec": spec}])
+    # wiring: forward refs, self-join on one ref, fan-out, dangling
+    # operators
+    _refusal("neither", [_join("j1", build="j2", probe="p"),
+                         _join("j2", build="b", probe="q")])
+    _refusal("itself", [_join(build="t", probe="t")])
+    _refusal("fan-out", [
+        _join("j1"),
+        _join("j2", build="j1", probe="q"),
+        _join("j3", build="j1", probe="r")])
+    _refusal("dangling", [_join("j1"), _join("j2", build="x",
+                                             probe="y")])
+
+
+def test_schema_refusals(qtables):
+    i64 = ("int64", ())
+    schemas = {"b": {"k": i64, "v": i64},
+               "p": {"k": i64, "v": i64},
+               "q": {"k": ("int32", ()), "w": i64}}
+    plan = QueryPlan.of([_join()])
+    with pytest.raises(ValueError, match="both sides"):
+        plan.infer_schemas(schemas)
+    # semi/anti emit probe columns only: the collision is fine there
+    semi = QueryPlan.of([_join(join_type="semi")])
+    out = semi.infer_schemas(schemas)
+    assert set(out["j1"]) == {"k", "v"}
+    with pytest.raises(ValueError, match="dtype mismatch"):
+        QueryPlan.of([_join(probe="q")]).infer_schemas(schemas)
+    with pytest.raises(ValueError, match="missing"):
+        QueryPlan.of([_join(key="z")]).infer_schemas(schemas)
+    with pytest.raises(ValueError, match="no schema"):
+        plan.infer_schemas({"b": {"k": i64}})
+    # a fused aggregate is mode-checked at PLAN time
+    bad = QueryPlan.of([
+        _join(),
+        {"op": "aggregate", "id": "a", "input": "j1",
+         "spec": AggregateSpec.of("nope", [("count", None)])}])
+    with pytest.raises(Exception):
+        bad.infer_schemas(schemas)
+
+
+def test_unsupported_run_options_refused(comm8, qtables):
+    plan = tpch_query_plan("q3")
+    tables = query_filters(qtables, "q3")
+    with pytest.raises(ValueError):
+        distributed_query(tables, plan, comm8, skew_threshold=8)
+
+
+# -- explain -----------------------------------------------------------
+
+
+def test_explain_record_and_order_pricing(comm8, qtables, tmp_path):
+    plan = tpch_query_plan("q3")
+    doc = explain_query(plan, comm8, qtables)
+    assert doc["kind"] == "queryplan"
+    assert doc["digest"] == plan.digest()
+    assert doc["n_operators"] == 3
+    assert len(doc["operators"]) == 2
+    for orec in doc["operators"]:
+        assert orec["wire"]["build"]["bytes_total"] > 0
+        assert orec["cost"]["total_s"] > 0
+    # all-inner 3-table chain: 4 left-deep candidate orders, exactly
+    # one flagged chosen and one cheapest
+    orders = doc["orders"]
+    assert len(orders) == 4
+    assert sum(1 for o in orders if o.get("chosen")) == 1
+    assert sum(1 for o in orders if o.get("cheapest")) == 1
+    # deterministic: same inputs, same record
+    assert explain_query(plan, comm8, qtables) == doc
+    # and the artifact passes the analyzer's schema check
+    from distributed_join_tpu.telemetry.analyze import check_file
+
+    path = tmp_path / "queryplan.json"
+    path.write_text(json.dumps(doc))
+    assert check_file(str(path)) == []
+
+
+def test_explain_pins_non_inner_orders(comm8, qtables):
+    ops = [
+        {"op": "join", "id": "j1", "build": "customer",
+         "probe": "orders", "key": "custkey", "join_type": "left"},
+        {"op": "join", "id": "j2", "build": "j1",
+         "probe": "lineitem", "key": "orderkey"},
+    ]
+    plan = QueryPlan.of(ops)
+    doc = explain_query(plan, comm8, qtables)
+    orders = doc["orders"]
+    assert len(orders) == 1 and orders[0].get("chosen")
+    assert orders[0].get("note")
+
+
+# -- serving -----------------------------------------------------------
+
+
+def test_service_query_op_and_counters(qtables):
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceConfig,
+    )
+
+    comm = TpuCommunicator(n_ranks=8)
+    svc = JoinService(comm, ServiceConfig(auto_retry=4))
+    plan = tpch_query_plan("q3")
+    tables = query_filters(qtables, "q3")
+    res = svc.query(tables, plan)
+    assert res.request_id and not bool(res.overflow)
+    assert res.groups and res.groups > 0
+    res2 = svc.query(tables, plan)
+    assert res2.new_traces == 0
+    st = svc.stats()
+    assert st["query"] == {"plans": 2, "warm_hits": 1,
+                           "operators_max": 3}
+    assert st["served"] == 2
+    prom = svc.prometheus_metrics()
+    assert "djtpu_query_plans_total 2" in prom
+    assert "djtpu_query_warm_hits_total 1" in prom
+    assert "djtpu_query_operators_max 3" in prom
+
+
+def test_fleet_affinity_routes_by_plan_digest():
+    from distributed_join_tpu.service.fleet import affinity_key
+
+    k_a = affinity_key({"op": "query", "query": "q3"}, 8)
+    k_b = affinity_key({"op": "query", "query": "q3", "seed": 9}, 8)
+    k_c = affinity_key({"op": "query", "query": "q10"}, 8)
+    assert k_a == k_b != k_c
