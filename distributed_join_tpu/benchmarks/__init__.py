@@ -316,6 +316,47 @@ def maybe_stage_profile(args, comm, build, probe, join_opts: dict):
     return prof.summary()
 
 
+def maybe_query_stage_profile(args, comm, plan, tables,
+                              defaults: dict):
+    """Driver seam for ``--stage-profile`` on the QUERY path: run the
+    per-OPERATOR profiling harness (telemetry/stageprof.py's
+    ``profile_query_stages``) — untimed side pass AFTER the timed
+    region — write the kind-stamped ``query_stageprofile.json`` into
+    the telemetry session directory (rank 0), render the dedicated
+    Perfetto track, and return the compact summary the driver embeds
+    under ``"stage_profile"`` (op_ids as the stage keys, so
+    ``history.run_entry`` persists per-operator walls through the
+    existing ``stages`` seam). None when the flag is off."""
+    repeats = getattr(args, "stage_profile", None)
+    if not repeats:
+        return None
+    import json
+    import os
+
+    from distributed_join_tpu import telemetry
+    from distributed_join_tpu.parallel.bootstrap import is_coordinator
+    from distributed_join_tpu.telemetry import stageprof
+
+    prof = stageprof.profile_query_stages(
+        comm, plan, tables, repeats=int(repeats), **dict(defaults))
+    rec = prof.as_record()
+    telemetry.stage_profile(rec)
+    if not is_coordinator():
+        return prof.summary()
+    s = telemetry.sink()
+    out_dir = s.dir if s is not None else "."
+    path = os.path.join(out_dir, "query_stageprofile.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(prof.format())
+    print(f"query stage profile: plan {rec['plan_digest'][:16]} "
+          f"-> {path}")
+    return prof.summary()
+
+
 def maybe_history(args, summary, record=None) -> None:
     """End-of-run ``--history FILE`` hook (next to :func:`maybe_
     diagnose`): append one workload-history entry — workload

@@ -136,13 +136,17 @@ def run(args) -> dict:
             "--auto-tune is wired for tpu-distributed-join, bench.py "
             "and the join service; the tpch driver does not consult "
             "the history store yet")
-    if getattr(args, "stage_profile", None):
-        # The TPC-H paths stage fixed real-schema tables (and the
-        # batched variants re-plan per key-range batch); the stage
-        # harness segments the generator join pipeline only.
+    if getattr(args, "stage_profile", None) \
+            and not getattr(args, "query", None):
+        # The single-join TPC-H paths stage fixed real-schema tables
+        # (and the batched variants re-plan per key-range batch); the
+        # join-stage harness segments the generator join pipeline
+        # only. The --query path IS segmentable — at the OPERATOR
+        # boundary (profile_query_stages) — so it takes the flag.
         raise SystemExit(
-            "--stage-profile is wired for tpu-distributed-join and "
-            "bench.py; profile the equivalent generator workload "
+            "--stage-profile is wired for tpu-distributed-join, "
+            "bench.py, and the tpch --query path; profile the "
+            "equivalent generator workload "
             "(tpu-distributed-join --stage-profile) instead")
     if getattr(args, "sort_mode", None) not in (None, "flat"):
         # The TPC-H joins carry string payload columns end to end;
@@ -525,6 +529,20 @@ def _run_query(args, comm) -> dict:
 
         write_explain(args, doc)
 
+    # Per-operator stage profile (--stage-profile N): untimed side
+    # pass AFTER the timed region — one barriered program per
+    # operator vs the monolithic query program, predictions joined
+    # from the SAME rung-priced explain doc above. The summary lands
+    # in the record under "stage_profile" (op_ids as stage keys), so
+    # history entries carry per-operator walls for the trend/tuner
+    # seam, and analyze explain --record grades them.
+    from distributed_join_tpu.benchmarks import (
+        maybe_query_stage_profile,
+    )
+
+    sp_summary = maybe_query_stage_profile(
+        args, comm, plan, tables, rung_factors)
+
     # ONE deterministic counter signature for the whole plan: every
     # operator's reduced counters under an op-id prefix, so a changed
     # re-shard, wire-column restriction, or fused-aggregate exchange
@@ -562,6 +580,8 @@ def _run_query(args, comm) -> dict:
         "order_candidates": doc["orders"],
         "aggregate": spec.as_record(),
     }
+    if sp_summary is not None:
+        extra["stage_profile"] = sp_summary
     return _report(args, comm, int(orders_tbl.num_valid()),
                    int(lineitem_tbl.num_valid()), rows,
                    int(res.total), bool(res.overflow), sec, extra)

@@ -812,12 +812,20 @@ def fleet_slice(seed: int, trials: int, *, replica_ranks: int = 2,
 
     rng = _trial_rng(seed, 555_000)
     fault = fault or rng.choice(("kill", "hang", "corrupt"))
-    # The victim is the replica AFFINE to trial 0's workload (the
-    # router's routing is deterministic given the spec), so the armed
-    # fault is guaranteed to face traffic — an rng-drawn index could
-    # land on a replica the whole soak never routes to.
+    # The victim is the replica AFFINE to the workload that will face
+    # the fault (the router's routing is deterministic given the
+    # spec) — an rng-drawn index could land on a replica the whole
+    # soak never routes to. hang/corrupt arm a FaultPlan at spawn, so
+    # trial 0's replica faces it; the kill lands right before the
+    # MIDPOINT trial's dispatch, so THAT trial's replica is the
+    # victim — the router's first post-kill attempt is then
+    # guaranteed to hit the dead backend, producing the failed-
+    # attempt/failover-retry pair the trace-continuity gate walks.
     trial0 = _fleet_trial_spec(seed, 0)
-    victim = fleet_mod.affine_replica(trial0, replica_ranks, 2)
+    victim = fleet_mod.affine_replica(
+        _fleet_trial_spec(seed, trials // 2) if fault == "kill"
+        else trial0,
+        replica_ranks, 2)
     workdir = tempfile.mkdtemp(prefix="djtpu_fleet_soak_")
     cfg = fleet_mod.FleetConfig(
         n_replicas=2,
@@ -885,6 +893,7 @@ def fleet_slice(seed: int, trials: int, *, replica_ranks: int = 2,
     records, failures = [], []
     pre_fault_spec = None
     fault_seen_at: Optional[float] = None
+    kill_send_at: Optional[float] = None
 
     def grade(resp, expected, k: int) -> TrialOutcome:
         if resp.get("ok"):
@@ -912,12 +921,20 @@ def fleet_slice(seed: int, trials: int, *, replica_ranks: int = 2,
             spec = _fleet_trial_spec(seed, k)
             if pre_fault_spec is None:
                 pre_fault_spec = dict(spec)
-            if fault == "kill" and k == kill_at:
-                router.replicas[victim].backend.kill()
-                fault_seen_at = time.monotonic()
             build, probe = _tables_from_spec(spec)
             expected = len(_oracle_frame(build, probe))
+            if fault == "kill" and k == kill_at:
+                # SIGKILL right before THIS dispatch (the oracle is
+                # already computed): the victim is this trial's
+                # affine replica, so the router's next attempt dials
+                # the dead backend unless the 0.5s prober wins the
+                # microsecond race (detected below via drained_at
+                # and excused by the trace-continuity gate).
+                router.replicas[victim].backend.kill()
+                fault_seen_at = time.monotonic()
             t_send = time.monotonic()
+            if fault == "kill" and k == kill_at:
+                kill_send_at = t_send
             t0 = time.perf_counter()
             try:
                 resp = client.send(spec)
@@ -960,6 +977,7 @@ def fleet_slice(seed: int, trials: int, *, replica_ranks: int = 2,
 
         drain_replace = {"required": fault in ("kill", "hang")}
         post_replacement_new_traces = None
+        trace_continuity = {"required": False}
         if fault in ("kill", "hang"):
             rep = router.replicas[victim]
             replaced = router.wait_replaced(
@@ -1008,6 +1026,56 @@ def fleet_slice(seed: int, trials: int, *, replica_ranks: int = 2,
                         "response": {kk: replay.get(kk) for kk in
                                      ("ok", "error", "message",
                                       "new_traces", "matches")}})
+        # Distributed-tracing continuity through the kill
+        # (docs/OBSERVABILITY.md "Distributed tracing"): every
+        # failed join-dispatch attempt the flight ring recorded must
+        # share its trace_id with the SAME request's final served
+        # record — the victim hop and the winning failover retry are
+        # ONE causal trace, never two. The scripted SIGKILL
+        # guarantees at least one mid-soak failover, so an empty
+        # attempt ring here means trace stamping broke, not a quiet
+        # soak.
+        if fault == "kill":
+            ring = router.recorder.snapshot()["records"]
+            failed_attempts = [
+                r for r in ring
+                if r.get("outcome") == "attempt_failed"
+                and r.get("op") == "join"
+                and (r.get("trace") or {}).get("trace_id")]
+            served_by_rid = {
+                r.get("request_id"): r for r in ring
+                if r.get("outcome") == "served"}
+            broken = []
+            for r in failed_attempts:
+                final = served_by_rid.get(r.get("request_id"))
+                f_tid = (r.get("trace") or {}).get("trace_id")
+                s_tid = ((final or {}).get("trace")
+                         or {}).get("trace_id")
+                if final is None or f_tid != s_tid:
+                    broken.append({"request_id": r.get("request_id"),
+                                   "attempt_trace": f_tid,
+                                   "served_trace": s_tid})
+            # The prober can (rarely) drain the freshly killed victim
+            # in the microseconds between the SIGKILL and the
+            # midpoint dispatch — then the router routes straight to
+            # the sibling and no attempt ever fails. Observable as
+            # drained_at preceding the dispatch; excused, because
+            # there was no failover whose continuity COULD be graded.
+            rep = router.replicas[victim]
+            prober_won = bool(
+                not failed_attempts
+                and rep.drained_at is not None
+                and kill_send_at is not None
+                and rep.drained_at <= kill_send_at)
+            trace_continuity = {
+                "required": True,
+                "failed_attempts": len(failed_attempts),
+                "broken": broken,
+                "prober_won_race": prober_won,
+            }
+            if broken or (not failed_attempts and not prober_won):
+                failures.append({"gate": "trace_continuity",
+                                 **trace_continuity})
     finally:
         client.close()
         server.shutdown()
@@ -1042,6 +1110,7 @@ def fleet_slice(seed: int, trials: int, *, replica_ranks: int = 2,
         "failure_records": failures,
         "drain_replace": drain_replace,
         "post_replacement_new_traces": post_replacement_new_traces,
+        "trace_continuity": trace_continuity,
         "fleet_stats": router.stats(),
         "records": records,
     }

@@ -106,6 +106,13 @@ def make_query_step(comm, plan, *, defaults: Optional[dict] = None,
                          metrics_static)
     names = tuple(plan.tables)
     ops = plan.ops
+    # Per-operator named scopes: label each operator's region of the
+    # lowered program ``query.<op_id>`` so device profiles and the
+    # stage profiler's spans attribute wall time to operators. Gated
+    # at BUILD time on instrumentation — with telemetry off and
+    # with_metrics off the emitted program is the byte-identical seed
+    # lowering (the tracing-off parity lock).
+    op_scopes = bool(with_metrics or telemetry.enabled())
 
     def step(*tables):
         if len(tables) != len(names):
@@ -118,7 +125,11 @@ def make_query_step(comm, plan, *, defaults: Optional[dict] = None,
         overflow = jnp.bool_(False)
         res = None
         for op, op_step in zip(ops, op_steps):
-            out = op_step(env[op.build], env[op.probe])
+            if op_scopes:
+                with jax.named_scope(f"query.{op.op_id}"):
+                    out = op_step(env[op.build], env[op.probe])
+            else:
+                out = op_step(env[op.build], env[op.probe])
             if with_metrics:
                 res, m = out
                 metrics.append(m)

@@ -118,6 +118,7 @@ from distributed_join_tpu.service.server import (
 )
 from distributed_join_tpu.telemetry import history as tel_history
 from distributed_join_tpu.telemetry import live as tel_live
+from distributed_join_tpu.telemetry import tracectx
 
 
 class FleetError(RuntimeError):
@@ -785,7 +786,10 @@ class FleetRouter:
             client = ServiceClient(
                 *rep.addr(), timeout_s=self.config.probe_timeout_s)
             try:
-                st = client.send({"op": "stats"})
+                # Probes root their own (tiny) trace so a probe-
+                # triggered drain is causally linkable to the probe.
+                st = client.send(tracectx.attach(
+                    {"op": "stats"}, tracectx.mint()))
             finally:
                 client.close()
         except (OSError, ValueError) as exc:
@@ -942,6 +946,12 @@ class FleetRouter:
         op = req.get("op", "?")
         rid = self._mint_request_id(req.get("request_id"))
         key = self.affinity_key(req)
+        # The router is the trace ROOT when the client sent no
+        # context; a client-minted context makes this dispatch a
+        # child hop, so the whole fleet hop chain shares the
+        # client's trace_id (docs/OBSERVABILITY.md "Distributed
+        # tracing").
+        ctx = tracectx.child_of_wire(req) or tracectx.mint()
         t0 = time.perf_counter()
         # The duplicate-dispatch fence: one id, one in-flight dispatch
         # at a time. A resend that arrives while the original is still
@@ -964,16 +974,22 @@ class FleetRouter:
                 # is the one path that bypasses _observe's fan-out.
                 self.recorder.record(
                     request_id=rid, op=op, signature=key,
-                    outcome="rejected", reason="duplicate_fence")
+                    outcome="rejected", reason="duplicate_fence",
+                    trace=tracectx.stamp(ctx) or None)
                 return {"ok": False, "error": "FleetError",
                         "message": f"request id {rid!r} still in "
                                    "flight past the request deadline "
                                    "(duplicate fenced)",
-                        "request_id": rid}
+                        "request_id": rid,
+                        tracectx.TRACE_FIELD: tracectx.to_wire(ctx)}
             time.sleep(0.05)
-        state = {"attempts": 0, "failovers": 0, "replica": None}
+        state = {"attempts": 0, "failovers": 0, "replica": None,
+                 "trace": ctx}
         outcome = "failed"
         resp = None
+        scope = telemetry.request_scope(None, trace=tracectx.stamp(ctx)
+                                        or None)
+        scope.__enter__()
         try:
             if self._replicated and op in ("register", "append",
                                            "drop"):
@@ -1044,6 +1060,12 @@ class FleetRouter:
                 self._inflight_ids.discard(rid)
             self._observe(rid, op, key, outcome, state,
                           time.perf_counter() - t0, resp)
+            scope.__exit__(None, None, None)
+            if isinstance(resp, dict):
+                # Echo the router's span on the wire so the client
+                # can parent its own follow-up spans on this hop.
+                resp.setdefault(tracectx.TRACE_FIELD,
+                                tracectx.to_wire(ctx))
 
     def _dispatch_attempts(self, req, rid, key, state,
                            retry_with_backoff, allowed=None):
@@ -1082,14 +1104,24 @@ class FleetRouter:
                     f"{self.config.max_inflight_per_replica}"
                     " or p95/QPS shed policy); retry with backoff")
             state["replica"] = rep
+            # Every attempt — including the ones that fail and fail
+            # over — is its own child span of the dispatch, carried
+            # on the wire to the replica, so one trace shows the
+            # whole causal chain victim-attempt included.
+            attempt_ctx = tracectx.child(state.get("trace"))
+            telemetry.event(
+                "fleet_attempt", request_id=rid,
+                op=req.get("op"), replica=rep.index,
+                attempt=state["attempts"],
+                **tracectx.stamp(attempt_ctx))
             gen0 = rep.generation
             try:
                 remaining = max(deadline - time.monotonic(), 0.1)
                 client = ServiceClient(*rep.addr(),
                                        timeout_s=remaining)
                 try:
-                    resp = client.send(
-                        {**req, "request_id": rid})
+                    resp = client.send(tracectx.attach(
+                        {**req, "request_id": rid}, attempt_ctx))
                 finally:
                     # Superseded attempts are abandoned with their
                     # connection — a late answer is never read.
@@ -1099,6 +1131,10 @@ class FleetRouter:
                     rep, f"request {rid}: "
                          f"{type(exc).__name__}: {exc}")
                 last_failed[rep.index] = gen0
+                self._record_attempt_failed(
+                    rid, req, key, rep, gen0, state["attempts"],
+                    attempt_ctx,
+                    f"{type(exc).__name__}: {exc}")
                 raise _AttemptFailed(
                     f"replica {rep.index} connection failed: "
                     f"{type(exc).__name__}: {exc}") from exc
@@ -1137,6 +1173,10 @@ class FleetRouter:
                     # attempt elsewhere, but stay re-eligible on the
                     # fallback pass.
                     soft_failed.add(rep.index)
+                self._record_attempt_failed(
+                    rid, req, key, rep, gen0, state["attempts"],
+                    attempt_ctx,
+                    f"{fault}: {resp.get('message') or resp.get('error')}")
                 raise _AttemptFailed(
                     f"replica {rep.index} {fault}: "
                     f"{resp.get('message') or resp.get('error')}")
@@ -1241,6 +1281,29 @@ class FleetRouter:
         }
         return resp
 
+    def _record_attempt_failed(self, rid, req, key, rep, gen0,
+                               attempt, attempt_ctx, error) -> None:
+        """A failed dispatch attempt lands in the flight ring WITH
+        its replica/trace pair — before this, only the final attempt
+        was visible postmortem, so a failover's victim hop could not
+        be tied to the retry that served. Never fails the dispatch."""
+        try:
+            telemetry.event(
+                "fleet_attempt_failed", request_id=rid,
+                op=req.get("op"), replica=rep.index,
+                attempt=attempt, error=error,
+                **tracectx.stamp(attempt_ctx))
+            self.recorder.record(
+                request_id=rid, op=req.get("op", "?"),
+                signature=key, outcome="attempt_failed",
+                attempt=attempt, error=error,
+                replica={"index": rep.index, "generation": gen0},
+                trace=tracectx.stamp(attempt_ctx) or None)
+        except Exception as exc:  # noqa: BLE001 - bookkeeping boundary
+            telemetry.event("fleet_observability_error",
+                            request_id=rid,
+                            error=f"{type(exc).__name__}: {exc}")
+
     def _observe(self, rid, op, key, outcome, state, elapsed_s,
                  resp):
         """Fleet-side accounting fan-out (live metrics, flight ring,
@@ -1253,11 +1316,23 @@ class FleetRouter:
                       "port": rep.backend.port}
                      if rep is not None else None)
             resident = self._resident_stamp(resp)
+            trace = tracectx.stamp(state.get("trace")) or None
             with self._lock:
                 if outcome == "served":
                     self.served += 1
                 elif outcome == "failed":
                     self.failed += 1
+            # The router's own dispatch span — the hop the fleet
+            # timeline hangs admission/route/failover walls on.
+            telemetry.span_complete(
+                "fleet_dispatch", time.perf_counter() - elapsed_s,
+                elapsed_s, request_id=rid, op=op, outcome=outcome,
+                attempts=state.get("attempts", 0),
+                failovers=state.get("failovers", 0),
+                replica=(state["replica"].index
+                         if state.get("replica") is not None
+                         else None),
+                **(trace or {}))
             self.live.record_request(
                 op, outcome,
                 latency_s=elapsed_s if outcome == "served" else None,
@@ -1271,6 +1346,7 @@ class FleetRouter:
                 failovers=state.get("failovers", 0),
                 replica=stamp,
                 resident=resident,
+                trace=trace,
                 error=(None if (resp or {}).get("ok")
                        else (resp or {}).get("message")))
             if self.history is not None and op not in ("ping",
@@ -1285,7 +1361,7 @@ class FleetRouter:
                     error=(None if (resp or {}).get("ok")
                            else str((resp or {}).get("message"))),
                     resident=resident,
-                    replica=stamp))
+                    replica=stamp, trace=trace))
         except Exception as exc:  # noqa: BLE001 - bookkeeping boundary
             telemetry.event("fleet_observability_error",
                             request_id=rid,
@@ -1325,11 +1401,14 @@ class FleetRouter:
         return [(start + k) % n for k in range(n)]
 
     def _send_table_op(self, rep: _Replica, req: dict,
-                       rid: str) -> Optional[dict]:
+                       rid: str,
+                       trace: Optional[dict] = None
+                       ) -> Optional[dict]:
         """One table-op leg of a fan-out: direct wire send to one
         holder. ``None`` = connection-dead (struck, failover-able);
         a dict is the holder's answer, structured refusals
-        included."""
+        included. ``trace`` (a per-leg child context) rides the
+        wire so the holder's spans join the fan-out's trace."""
         with self._lock:
             rep.inflight += 1
         gen0 = rep.generation
@@ -1338,7 +1417,8 @@ class FleetRouter:
                 *rep.addr(),
                 timeout_s=self.config.request_deadline_s)
             try:
-                return client.send({**req, "request_id": rid})
+                return client.send(tracectx.attach(
+                    {**req, "request_id": rid}, trace))
             finally:
                 client.close()
         except (OSError, ValueError) as exc:
@@ -1379,14 +1459,19 @@ class FleetRouter:
                     continue
             state["attempts"] += 1
             state["replica"] = rep
-            resp = self._send_table_op(rep, req, rid)
+            leg = tracectx.child(state.get("trace"))
+            telemetry.event("fleet_fanout_leg", op="register",
+                            table=name, replica=rep.index,
+                            request_id=rid, **tracectx.stamp(leg))
+            resp = self._send_table_op(rep, req, rid, trace=leg)
             if resp is None:
                 continue
             if not resp.get("ok"):
                 for prep, _ in results:
                     self._send_table_op(
                         prep, {"op": "drop", "name": name},
-                        f"{rid}-rollback")
+                        f"{rid}-rollback",
+                        trace=tracectx.child(state.get("trace")))
                 return {**resp, "request_id": rid}
             results.append((rep, resp))
         if not results:
@@ -1447,7 +1532,12 @@ class FleetRouter:
                 continue
             state["attempts"] += 1
             state["replica"] = rep
-            outcomes[idx] = self._send_table_op(rep, req, rid)
+            leg = tracectx.child(state.get("trace"))
+            telemetry.event("fleet_fanout_leg", op="append",
+                            table=name, replica=rep.index,
+                            request_id=rid, **tracectx.stamp(leg))
+            outcomes[idx] = self._send_table_op(rep, req, rid,
+                                                trace=leg)
         ok_items = {i: r for i, r in outcomes.items()
                     if r is not None and r.get("ok")}
         if not ok_items:
@@ -1519,7 +1609,11 @@ class FleetRouter:
                 continue
             state["attempts"] += 1
             state["replica"] = rep
-            resp = self._send_table_op(rep, req, rid)
+            leg = tracectx.child(state.get("trace"))
+            telemetry.event("fleet_fanout_leg", op="drop",
+                            table=name, replica=rep.index,
+                            request_id=rid, **tracectx.stamp(leg))
+            resp = self._send_table_op(rep, req, rid, trace=leg)
             if resp is not None and resp.get("ok"):
                 dropped.append(idx)
         self._drop_manifest(name)
@@ -1577,9 +1671,15 @@ class FleetRouter:
                 return
             holder["state"] = "rebuilding"
         self._save_directory()
+        # Rebuilds have no client: the router roots a fresh trace so
+        # the manifest replay's spans (router legs + holder-side
+        # register/append spans) assemble into one causal chain in
+        # the fleet timeline.
+        ctx = tracectx.mint()
         telemetry.event("fleet_holder_rebuilding", table=name,
                         replica=rep.index,
-                        generation_target=entry["generation"])
+                        generation_target=entry["generation"],
+                        **tracectx.stamp(ctx))
         manifest = (load_table_manifest(self._coord_dir, name)
                     if self._coord_dir else None)
         if manifest is None:
@@ -1605,7 +1705,8 @@ class FleetRouter:
                 rid = (f"rebuild-{_table_slug(name)}-r{rep.index}"
                        f"g{rep.generation}-{step}")
                 step += 1
-                resp = self._send_table_op(rep, op_req, rid)
+                resp = self._send_table_op(
+                    rep, op_req, rid, trace=tracectx.child(ctx))
                 if resp is None or not resp.get("ok"):
                     with self._lock:
                         holder["state"] = "stale"
@@ -1650,9 +1751,11 @@ class FleetRouter:
         telemetry.event("fleet_holder_rebuilt", table=name,
                         replica=rep.index, generation=gen,
                         state=holder["state"],
-                        elapsed_s=round(elapsed, 3))
+                        elapsed_s=round(elapsed, 3),
+                        **tracectx.stamp(ctx))
         self.recorder.record(
             request_id=f"rebuild-{_table_slug(name)}-r{rep.index}",
+            trace=tracectx.stamp(ctx) or None,
             op="rebuild",
             signature=self.affinity_key({"op": "register",
                                          "name": name}),
@@ -1674,7 +1777,8 @@ class FleetRouter:
                 replica={"index": rep.index,
                          "generation": rep.generation,
                          "port": getattr(rep.backend, "port",
-                                         None)}))
+                                         None)},
+                trace=tracectx.stamp(ctx) or None))
         self._save_directory()
 
     # -- the durable router directory + HA adoption -------------------
@@ -1927,20 +2031,54 @@ class FleetRouter:
                                                        "primary")
                             else 0),
         })
-        if not st["tables"]:
-            return text
-        # Labeled per-table gauge: serving-holder count (the fleet's
-        # effective replication factor per table, live).
-        lines = [text.rstrip("\n"),
-                 "# TYPE djtpu_fleet_resident_holders gauge"]
-        for name in sorted(st["tables"]):
-            holders = st["tables"][name]["holders"]
-            serving = sum(1 for h in holders.values()
-                          if h.get("state") == "serving")
-            lines.append(
-                f'djtpu_fleet_resident_holders{{table="{name}"}} '
-                f"{serving}")
-        return "\n".join(lines) + "\n"
+        if st["tables"]:
+            # Labeled per-table gauge: serving-holder count (the
+            # fleet's effective replication factor per table, live).
+            lines = [text.rstrip("\n"),
+                     "# TYPE djtpu_fleet_resident_holders gauge"]
+            for name in sorted(st["tables"]):
+                holders = st["tables"][name]["holders"]
+                serving = sum(1 for h in holders.values()
+                              if h.get("state") == "serving")
+                lines.append(
+                    f'djtpu_fleet_resident_holders'
+                    f'{{table="{name}"}} {serving}')
+            text = "\n".join(lines) + "\n"
+        # One scrape sees the whole fleet: the replica metrics
+        # fan-out merged into per-replica-labeled counters plus the
+        # bucket-wise-summed latency histogram.
+        return text + tel_live.fleet_prometheus(
+            self._replica_metrics())
+
+    def _replica_metrics(self) -> dict:
+        """Best-effort ``metrics`` fan-out to every LIVE replica:
+        index -> LiveMetrics snapshot (None for a slot that is
+        drained/failed or did not answer — the fleet exposition
+        reports it ``replica_up 0`` rather than stalling the
+        scrape)."""
+        with self._lock:
+            reps = list(self.replicas)
+        out: dict = {}
+        for rep in reps:
+            with self._lock:
+                live = rep.state in ("healthy", "suspect")
+            snap = None
+            if live:
+                try:
+                    client = ServiceClient(
+                        *rep.addr(),
+                        timeout_s=self.config.probe_timeout_s)
+                    try:
+                        resp = client.send(tracectx.attach(
+                            {"op": "metrics"}, tracectx.mint()))
+                    finally:
+                        client.close()
+                    if resp.get("ok"):
+                        snap = resp.get("metrics")
+                except (OSError, ValueError):
+                    snap = None
+            out[rep.index] = snap
+        return out
 
     def metrics_snapshot(self) -> dict:
         snap = self.live.snapshot()
@@ -1948,6 +2086,11 @@ class FleetRouter:
         snap["flight_records"] = len(self.recorder)
         snap["history_path"] = (self.history.path
                                 if self.history is not None else None)
+        per_replica = self._replica_metrics()
+        snap["replicas"] = {str(i): s for i, s
+                            in per_replica.items()}
+        snap["fleet"] = tel_live.merge_snapshots(
+            [s for s in per_replica.values() if s is not None])
         return snap
 
     def drain_replica(self, index: int,
@@ -2566,6 +2709,242 @@ class FleetSmokeError(RuntimeError):
         self.record = record
 
 
+def run_tracing_smoke(args) -> dict:
+    """The ``tracing`` lane's acceptance protocol
+    (docs/OBSERVABILITY.md "Distributed tracing"): ONE causal
+    timeline across the router and real subprocess replicas, through
+    one scripted SIGKILL.
+
+    1. 2 subprocess replicas, each writing its OWN telemetry session
+       dir; the router (and the harness client) run an in-process
+       session beside them — three per-process JSONL streams;
+    2. a cold join Q and its warm repeat flow end to end (client mint
+       -> router child span -> replica adoption);
+    3. ONE SCRIPTED SIGKILL of the affine replica, then the repeat of
+       Q under a harness-minted trace context: the router's FAILED
+       attempt and the winning failover retry must share that ONE
+       trace_id — in the flight ring (per-attempt records) and in the
+       merged timeline (``trace_ids_for_request``);
+    4. the per-process session dirs assemble into ONE Perfetto
+       timeline (``telemetry/timeline.py``) whose focus trace spans
+       both surviving processes with >= 1 cross-process hop and a
+       non-empty critical path; both artifacts must pass
+       ``analyze check``;
+    5. the record (kind ``tracing_smoke``) carries the deterministic
+       counter signature the perfgate lane gates against
+       ``results/baselines/tracing_smoke.json``.
+    """
+    import tempfile
+
+    from distributed_join_tpu.telemetry import timeline
+    from distributed_join_tpu.telemetry.analyze import check_file
+
+    violations: list = []
+    workdir_owned = args.persist_dir is None
+    workdir = args.persist_dir or tempfile.mkdtemp(
+        prefix="djtpu_tracing_smoke_")
+    tel_root = os.path.join(workdir, "telemetry")
+    router_dir = os.path.join(tel_root, "router")
+    rep_dirs = {i: os.path.join(tel_root, f"replica{i}")
+                for i in range(2)}
+    cfg = FleetConfig(
+        n_replicas=2,
+        replica_ranks=args.replica_ranks,
+        persist_dir=os.path.join(workdir, "programs"),
+        history_dir=(args.history_dir
+                     or os.path.join(workdir, "history")),
+        # Same rationale as run_fleet_smoke: the REQUEST path (not
+        # the prober) must discover the scripted kill, so the failed
+        # attempt actually happens and lands on the trace.
+        probe_interval_s=max(args.probe_interval_s, 5.0),
+        retry_budget=2,
+        max_inflight_per_replica=args.max_inflight,
+        spawn_timeout_s=args.spawn_timeout_s,
+    )
+    # DISTINCT per-slot session dirs (generation 0 only — a
+    # replacement must never append into its predecessor's stream).
+    overrides = {i: {"extra_args": ["--telemetry", rep_dirs[i]]}
+                 for i in rep_dirs}
+    router = FleetRouter(
+        process_fleet_factory(cfg, platform=args.platform or "cpu",
+                              replica_overrides=overrides),
+        cfg)
+    sink = telemetry.configure(router_dir, rank=0)
+    router.start()
+    server, port = start_router_daemon(router)
+    client = ServiceClient("127.0.0.1", port, retries=2)
+
+    q = {"op": "join", "build_nrows": 2048, "probe_nrows": 2048,
+         "seed": 17, "selectivity": 0.4, "rand_max": 1024,
+         "out_capacity_factor": 3.0}
+    rid = "tracing-failover"
+    root = tracectx.mint()
+    root_tid = root["trace_id"]
+
+    try:
+        cold = client.send(q)
+        if not cold.get("ok"):
+            raise RuntimeError(f"cold query failed: {cold}")
+        warm = client.send(q)
+        if not warm.get("ok"):
+            raise RuntimeError(f"warm query failed: {warm}")
+        # The response must echo the trace the client minted — the
+        # wire-propagation contract, asserted before any fault.
+        for name, resp in (("cold", cold), ("warm", warm)):
+            if not (resp.get(tracectx.TRACE_FIELD) or {}) \
+                    .get("trace_id"):
+                violations.append(
+                    f"{name} response carries no trace context")
+
+        # THE scripted kill, then the repeat under a KNOWN root
+        # trace: the failed attempt and the failover retry must both
+        # be children of it.
+        victim = router.replicas[cold["fleet"]["replica"]]
+        victim_index = victim.index
+        victim.backend.kill()
+        failover = client.send(
+            tracectx.attach({**q, "request_id": rid}, root))
+        if not failover.get("ok"):
+            violations.append(
+                f"failover repeat was not served: {failover}")
+        else:
+            if failover["fleet"]["replica"] == victim_index:
+                violations.append(
+                    "failover answered from the killed replica")
+            if failover["matches"] != cold["matches"]:
+                violations.append(
+                    f"failover matches {failover['matches']} != "
+                    f"cold {cold['matches']}")
+            if (failover.get(tracectx.TRACE_FIELD) or {}) \
+                    .get("trace_id") != root_tid:
+                violations.append(
+                    "failover response does not echo the "
+                    "harness-minted trace id")
+
+        # Flight-ring continuity: every per-attempt record for rid —
+        # the failed dispatch included — must resolve to the ONE
+        # root trace id.
+        ring = [r for r in router.recorder.snapshot()["records"]
+                if r.get("request_id") == rid]
+        failed = [r for r in ring
+                  if r.get("outcome") == "attempt_failed"]
+        ring_tids = {(r.get("trace") or {}).get("trace_id")
+                     for r in ring}
+        if not failed:
+            violations.append(
+                "no attempt_failed flight record for the killed "
+                "dispatch — the failed attempt left no trace")
+        if ring_tids != {root_tid}:
+            violations.append(
+                f"flight records for {rid!r} carry trace ids "
+                f"{sorted(map(str, ring_tids))} != the one root "
+                f"{root_tid}")
+    finally:
+        client.close()
+        server.shutdown()
+        server.server_close()
+        router.stop()
+        if telemetry.sink() is sink:
+            telemetry.finalize()
+
+    # -- assemble the fleet timeline from the per-process streams ----
+    tl_record: dict = {}
+    n_timeline_procs = 0
+    focus_procs: list = []
+    check_problems: list = []
+    tids: set = set()
+    try:
+        asm = timeline.assemble(
+            [router_dir] + [rep_dirs[i] for i in sorted(rep_dirs)],
+            trace_id=root_tid)
+        n_timeline_procs = len(asm["procs"])
+        tids = timeline.trace_ids_for_request(asm, rid)
+        if tids != {root_tid}:
+            violations.append(
+                f"timeline records for {rid!r} resolve to trace ids "
+                f"{sorted(map(str, tids))} != the one root")
+        agg = asm["traces"].get(root_tid)
+        focus_procs = sorted(agg["procs"]) if agg else []
+        if len(focus_procs) < 2:
+            violations.append(
+                "the failover trace does not span 2 processes "
+                f"(saw {focus_procs}) — no cross-process causal "
+                "chain")
+        if not asm["hops"]:
+            violations.append(
+                "no cross-process hop detected in the merged "
+                "timeline")
+        if not asm["critical_path"]:
+            violations.append("empty cross-process critical path")
+
+        trace_path = os.path.join(tel_root,
+                                  "fleet_timeline.trace.json")
+        timeline.write_perfetto(asm, trace_path)
+        tl_record = timeline.as_record(asm, trace_file=trace_path)
+        tl_path = os.path.join(tel_root, "fleet_timeline.json")
+        with open(tl_path, "w") as f:
+            json.dump(tl_record, f, indent=1, sort_keys=True)
+            f.write("\n")
+        for p in (tl_path, trace_path):
+            probs = check_file(p)
+            if probs:
+                check_problems.extend(
+                    f"{os.path.basename(p)}: {x}" for x in probs)
+        if check_problems:
+            violations.append(
+                "analyze check rejected the timeline artifacts: "
+                + "; ".join(check_problems))
+    except (OSError, ValueError) as exc:
+        violations.append(
+            f"timeline assembly failed: {type(exc).__name__}: {exc}")
+
+    record = {
+        "kind": "tracing_smoke",
+        "benchmark": "tracing_smoke",
+        "n_ranks": cfg.replica_ranks,
+        "replicas": cfg.n_replicas,
+        "killed_replica": victim_index,
+        "root_trace_id": root_tid,
+        "failover_attempts": (failover.get("fleet", {})
+                              .get("attempts")),
+        "attempt_failed_records": len(failed),
+        "timeline_processes": n_timeline_procs,
+        "focus_trace_processes": focus_procs,
+        "timeline": {k: tl_record.get(k)
+                     for k in ("n_spans", "n_events", "n_traces",
+                               "hops", "skew_bound_us")},
+        "violations": violations,
+        # Integer gates only: counts that depend on load/timing
+        # (span totals, hop totals, skew) stay outside the signature.
+        "counter_signature": {
+            "signature_version": 1,
+            "n_ranks": cfg.replica_ranks,
+            "counters": {
+                "replicas": cfg.n_replicas,
+                "matches_cold": cold["matches"],
+                "matches_warm": warm["matches"],
+                "matches_failover": failover.get("matches", -1),
+                "warm_new_traces": warm["new_traces"],
+                "failover_trace_ids": len(tids),
+                "failed_attempt_on_trace": int(bool(
+                    failed and ring_tids == {root_tid})),
+                "timeline_processes": n_timeline_procs,
+                "focus_trace_processes": len(focus_procs),
+            },
+        },
+    }
+    if violations:
+        record["workdir"] = workdir
+        raise FleetSmokeError(
+            "tracing smoke violations: " + "; ".join(violations),
+            record)
+    if workdir_owned:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return record
+
+
 def run_fleet_ha_smoke(args) -> dict:
     """The ``fleet_ha`` lane's acceptance protocol (docs/FLEET.md
     "Replication & HA"), end to end through subprocess replicas, the
@@ -2832,8 +3211,33 @@ def run_fleet_ha_smoke(args) -> dict:
                                   + 30.0):
             raise RuntimeError(
                 "standby router never took over the lease")
-        after = client.send({**q,
-                             "request_id": "ha-after-takeover"})
+        # The resend rides ONE trace across the takeover
+        # (docs/OBSERVABILITY.md "Distributed tracing"):
+        # ServiceClient.send mints once per LOGICAL send, before its
+        # reconnect loop, so every retry against the dead primary and
+        # the attempt the standby finally serves carry this context.
+        ha_ctx = tracectx.mint()
+        after = client.send(tracectx.attach(
+            {**q, "request_id": "ha-after-takeover"}, ha_ctx))
+        after_tid = (after.get(tracectx.TRACE_FIELD)
+                     or {}).get("trace_id")
+        if after_tid != ha_ctx["trace_id"]:
+            violations.append(
+                "post-takeover resend left its original trace: "
+                f"response trace {after_tid} != minted "
+                f"{ha_ctx['trace_id']}")
+        ha_ring = [r for r in
+                   standby_router.recorder.snapshot()["records"]
+                   if r.get("request_id") == "ha-after-takeover"]
+        ha_ring_tids = {(r.get("trace") or {}).get("trace_id")
+                        for r in ha_ring}
+        if not ha_ring or ha_ring_tids != {ha_ctx["trace_id"]}:
+            violations.append(
+                "standby flight ring does not tie the takeover "
+                f"resend to its trace: ids "
+                f"{sorted(map(str, ha_ring_tids))} over "
+                f"{len(ha_ring)} record(s), wanted exactly "
+                f"{ha_ctx['trace_id']}")
         if not after.get("ok"):
             violations.append(
                 f"post-takeover resend was not served: {after}")
@@ -3036,6 +3440,12 @@ def parse_args(argv=None):
                         "CPU-mesh fleet, scripted replica kill, "
                         "oracle/drain/replace/shed gates) instead of "
                         "serving; JSON record on stdout")
+    p.add_argument("--tracing-smoke", action="store_true",
+                   help="run the distributed-tracing acceptance "
+                        "protocol (2-replica fleet with per-slot "
+                        "telemetry dirs, scripted kill, one-trace "
+                        "failover continuity, merged fleet timeline) "
+                        "instead of serving; JSON record on stdout")
     p.add_argument("--ha-smoke", action="store_true",
                    help="run the replication/HA acceptance protocol "
                         "(K=2 resident table, scripted holder kill "
@@ -3069,6 +3479,24 @@ def main(argv=None) -> int:
     from distributed_join_tpu.benchmarks import report
 
     args = parse_args(argv)
+    if args.tracing_smoke:
+        try:
+            record = run_tracing_smoke(args)
+        except FleetSmokeError as exc:
+            report("tracing smoke FAILED", exc.record,
+                   args.json_output)
+            print(str(exc), file=sys.stderr)
+            return 1
+        report(
+            f"tracing smoke: {record['replicas']} replicas, kill -> "
+            f"failover in {record['failover_attempts']} attempt(s) "
+            f"sharing trace {record['root_trace_id'][:18]}, "
+            f"{record['attempt_failed_records']} failed attempt(s) "
+            "on-trace, timeline over "
+            f"{record['timeline_processes']} process(es) with "
+            f"{record['timeline']['hops']} hop(s)",
+            record, args.json_output)
+        return 0
     if args.ha_smoke:
         try:
             record = run_fleet_ha_smoke(args)

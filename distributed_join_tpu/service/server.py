@@ -70,6 +70,7 @@ from distributed_join_tpu.service import batching
 from distributed_join_tpu.service.programs import JoinProgramCache
 from distributed_join_tpu.telemetry import history as tel_history
 from distributed_join_tpu.telemetry import live as tel_live
+from distributed_join_tpu.telemetry import tracectx
 
 
 class AdmissionError(RuntimeError):
@@ -998,6 +999,12 @@ class JoinService:
             rung_path = None
             matches = None
             overflow = None
+            # The distributed-trace stamp: the handler installed the
+            # wire-carried context for this request's scope; None for
+            # in-process callers without one. Flight records and
+            # history lines carry it so a postmortem groups by
+            # trace_id across every process of the fleet.
+            trace = telemetry.current_trace()
             tuned = (getattr(res, "tuned", None)
                      if res is not None else None)
             if res is not None and outcome == "served":
@@ -1028,7 +1035,8 @@ class JoinService:
                 overflow=overflow, new_traces=new_traces,
                 cache_hits=cache_hits, rung_path=rung_path,
                 tuned=tel_history.tuned_summary(tuned),
-                resident=resident, aggregate=aggregate, error=error)
+                resident=resident, aggregate=aggregate, error=error,
+                trace=trace)
             if self.history is not None or self.tuner is not None:
                 tel = (getattr(res, "telemetry", None)
                        if res is not None else None)
@@ -1041,7 +1049,7 @@ class JoinService:
                     predicted_wall_s=predicted_wall_s,
                     tuned=tuned, platform=_backend_platform(),
                     resident=resident, aggregate=aggregate,
-                    error=error)
+                    error=error, trace=trace)
                 if self.history is not None:
                     self.history.append(entry)
                 if self.tuner is not None:
@@ -1069,7 +1077,11 @@ class JoinService:
                     or self.config.persist_dir or ".")
             path = os.path.join(base, tel_live.FLIGHT_RECORDER_FILENAME)
         try:
-            path = self.recorder.dump(path, reason)
+            # A poison dump cut while a traced request is active
+            # carries that request's trace context, so the postmortem
+            # joins the fleet timeline of the request that hung.
+            path = self.recorder.dump(
+                path, reason, trace=telemetry.current_trace())
         except OSError as exc:
             telemetry.event("flightrecorder_dump_failed", path=path,
                             error=f"{type(exc).__name__}: {exc}")
@@ -1393,14 +1405,29 @@ class _Handler(socketserver.StreamRequestHandler):
             if not line:
                 continue
             req = None
+            ctx = None
             try:
                 req = json.loads(line)
-                resp = self._dispatch(req)
+                # Distributed tracing (docs/OBSERVABILITY.md): adopt
+                # the wire-carried trace as this process's scope — a
+                # fresh span id parented on the SENDER's span, so
+                # every event/span/flight/history record this request
+                # emits here carries the cross-process causal key.
+                # None when the request carries no trace (tracing is
+                # always optional, and off = the exact old path).
+                ctx = tracectx.child_of_wire(req)
+                with telemetry.request_scope(None, trace=ctx):
+                    resp = self._dispatch(req)
             except Exception as exc:  # noqa: BLE001 - wire boundary:
                 # a bad request must answer THAT client, not kill the
                 # daemon serving everyone else.
                 resp = {"ok": False, "error": type(exc).__name__,
                         "message": str(exc)}
+            if ctx is not None and isinstance(resp, dict):
+                # Echo the server-side span so the caller can record
+                # the edge without grepping this process's files.
+                resp.setdefault(tracectx.TRACE_FIELD,
+                                tracectx.to_wire(ctx))
             self.wfile.write(
                 (json.dumps(resp) + "\n").encode("utf-8"))
             self.wfile.flush()
@@ -1713,12 +1740,30 @@ class ServiceClient:
                 delay *= 2
 
     def send(self, payload: dict) -> dict:
+        # Distributed tracing (docs/OBSERVABILITY.md): every wire op
+        # carries a trace context. A caller-supplied one (the router's
+        # per-attempt child span, a console's --trace-id) rides
+        # untouched; otherwise THIS client is the trace root and mints
+        # it here — once per logical send, so a reconnect-and-resend
+        # (including the HA-takeover resend) JOINS the original trace
+        # instead of starting a new one.
+        if payload.get(tracectx.TRACE_FIELD) is None:
+            payload = tracectx.attach(payload, tracectx.mint())
+        ctx = tracectx.from_wire(payload)
         resendable = payload.get("op") in self.RESENDABLE_OPS
         wrote = {"flag": False}
 
         def once():
             if self._file is None:
                 self._connect()
+            if wrote["flag"]:
+                # Resend of the SAME trace: narrate the link so the
+                # timeline shows the abandoned attempt (no-op with
+                # telemetry off).
+                telemetry.event(
+                    "client_resend", op=payload.get("op"),
+                    request_id=payload.get("request_id"),
+                    **tracectx.stamp(ctx))
             if wrote["flag"] and not resendable:
                 # The earlier attempt's write may have been applied
                 # server-side; a mutating op must not go out twice.
@@ -1753,7 +1798,8 @@ class ServiceClient:
 
 
 def watch(host: str, port: int, interval_s: float = 2.0,
-          count: int = 0, out=None, retries: int = 3) -> int:
+          count: int = 0, out=None, retries: int = 3,
+          trace_id: Optional[str] = None) -> int:
     """Poll a RUNNING daemon's ``metrics`` op and render one console
     line per poll — the operator's ``top`` for the join service. Read
     only: no mesh, no bootstrap, works from any machine that can reach
@@ -1778,7 +1824,14 @@ def watch(host: str, port: int, interval_s: float = 2.0,
 
     try:
         while True:
-            resp = client.send({"op": "metrics"})
+            poll: dict = {"op": "metrics"}
+            if trace_id:
+                # Client-minted trace id, honored end to end (capped
+                # under the request-id prefix+sha256 scheme): every
+                # poll is a fresh root span of the SAME trace, so one
+                # timeline shows the whole watch session.
+                poll = tracectx.attach(poll, tracectx.mint(trace_id))
+            resp = client.send(poll)
             if not resp.get("ok"):
                 print(f"metrics op failed: {resp}", file=out,
                       flush=True)
@@ -1910,6 +1963,12 @@ def parse_args(argv=None):
     p.add_argument("--watch-count", type=int, default=0,
                    help="stop --watch after N polls (0 = until "
                         "interrupted)")
+    p.add_argument("--trace-id", default=None, metavar="ID",
+                   help="client-minted distributed-trace id attached "
+                        "to every --watch poll and smoke request "
+                        "(capped/aliased like long request ids; "
+                        "docs/OBSERVABILITY.md 'Distributed "
+                        "tracing')")
     p.add_argument("--smoke", action="store_true",
                    help="run the CI smoke protocol against an "
                         "in-process daemon instead of serving: warm "
@@ -2263,6 +2322,12 @@ def run_smoke(service: JoinService, args) -> dict:
     violations = []
 
     def send_ok(payload, what):
+        if getattr(args, "trace_id", None):
+            # Client-minted trace id honored end to end (capped under
+            # the request-id scheme): every smoke request is a fresh
+            # root span of the operator's one trace.
+            payload = tracectx.attach(
+                payload, tracectx.mint(args.trace_id))
         resp = client.send(payload)
         if not resp.get("ok"):
             # surface the service's OWN error, not a downstream
@@ -2457,7 +2522,8 @@ def main(argv=None):
             return 2
         return watch(args.host, args.port,
                      interval_s=args.watch_interval_s,
-                     count=args.watch_count)
+                     count=args.watch_count,
+                     trace_id=getattr(args, "trace_id", None))
     # --guard-deadline-s bounds each REQUEST, not the daemon: resolve
     # it now, then zero the flag so run_guarded leaves the (healthy,
     # long-lived) server unguarded. An explicit 0 also stops

@@ -52,10 +52,10 @@ from distributed_join_tpu.telemetry import spans as _spans
 
 __all__ = [
     "Metrics", "MetricsTape", "TelemetrySink",
-    "configure", "configure_from_args", "counter_add", "emit_metrics",
-    "enabled", "event", "finalize", "maybe_start_xla_trace",
-    "request_scope", "session", "sink", "span", "span_complete",
-    "stage_profile", "summary",
+    "configure", "configure_from_args", "counter_add",
+    "current_trace", "emit_metrics", "enabled", "event", "finalize",
+    "maybe_start_xla_trace", "request_scope", "session", "sink",
+    "span", "span_complete", "stage_profile", "summary",
 ]
 
 _active: Optional[TelemetrySink] = None
@@ -184,26 +184,47 @@ def span_complete(name: str, t0_perf: float, dur_s: float, **payload) -> None:
 
 
 @contextlib.contextmanager
-def request_scope(request_id: Optional[str]):
+def request_scope(request_id: Optional[str],
+                  trace: Optional[dict] = None):
     """Tag every event/span recorded inside the scope with a serving
     request id (the correlation key of docs/OBSERVABILITY.md "Live
-    service metrics"): the tag lands in the per-rank JSONL records,
-    the Chrome-trace args, and — because the sink tag is sink-global,
-    not thread-local — in events a request's watchdog/staging worker
-    threads emit too. No-op when telemetry is off or ``request_id`` is
-    None; nests (the previous tag is restored on exit)."""
+    service metrics") and — when ``trace`` carries a ``telemetry/
+    tracectx.py`` context — with ``(trace_id, span_id,
+    parent_span_id)``, the cross-process causal key of
+    docs/OBSERVABILITY.md "Distributed tracing". Tags land in the
+    per-rank JSONL records, the Chrome-trace args, and — because the
+    sink tags are sink-global, not thread-local — in events a
+    request's watchdog/staging worker threads emit too. No-op when
+    telemetry is off or both tags are None; nests (the previous tags
+    are restored on exit)."""
     s = _active
-    if s is None or request_id is None:
+    if s is None or (request_id is None and trace is None):
         yield
         return
-    prev = s.set_request_id(request_id)
+    prev = s.set_request_id(request_id) if request_id is not None \
+        else None
+    prev_trace = s.set_trace(trace) if trace is not None else None
     try:
         yield
     finally:
         # the session may have been finalized mid-request; restoring
         # on the captured sink is still safe (a closed sink just holds
         # the tag, it records nothing)
-        s.set_request_id(prev)
+        if trace is not None:
+            s.set_trace(prev_trace)
+        if request_id is not None:
+            s.set_request_id(prev)
+
+
+def current_trace() -> Optional[dict]:
+    """The trace context installed by the innermost active
+    :func:`request_scope` (None when telemetry is off or no scope set
+    one). Flight-recorder dumps and history writers read it so
+    postmortem artifacts carry the causal key of the request that was
+    active when they were cut."""
+    if _active is None:
+        return None
+    return _active.current_trace()
 
 
 def event(name: str, **payload) -> None:

@@ -762,6 +762,12 @@ def grade_queryplan(doc: dict, record: Optional[dict]) -> dict:
     if record is not None:
         for entry in record.get("wire") or []:
             meas[entry.get("id")] = entry
+    # Per-operator measured WALLS: the record's embedded
+    # query-stage-profile summary (stageprof.profile_query_stages —
+    # wall_s keyed by op_id), when the driver ran --stage-profile.
+    sp = (record or {}).get("stage_profile") or {}
+    sp_walls = sp.get("wall_s") if isinstance(sp, dict) else None
+    sp_walls = sp_walls if isinstance(sp_walls, dict) else {}
     ops = []
     gated = record is not None
     exact = True
@@ -783,8 +789,17 @@ def grade_queryplan(doc: dict, record: Optional[dict]) -> dict:
                 e["match"] = pred == mb
                 exact &= pred == mb
             entry["wire"][side] = e
+        w = sp_walls.get(orec.get("id"))
+        if w is not None:
+            pred_s = (orec.get("cost") or {}).get("total_s")
+            entry["wall"] = {
+                "predicted_s": pred_s,
+                "measured_s": w,
+                "ratio": (round(float(w) / float(pred_s), 6)
+                          if pred_s else None),
+            }
         ops.append(entry)
-    return {
+    grade = {
         "kind": "queryplan_grade",
         "plan_digest": doc.get("digest"),
         "n_operators": doc.get("n_operators"),
@@ -793,6 +808,13 @@ def grade_queryplan(doc: dict, record: Optional[dict]) -> dict:
         "orders": doc.get("orders"),
         "wire_match": (exact if gated else None),
     }
+    if sp_walls:
+        grade["walls"] = {
+            "sum_of_operators_s": sp.get("sum_of_stages_s"),
+            "monolithic_wall_s": sp.get("monolithic_wall_s"),
+            "overlap_fraction": sp.get("overlap_fraction"),
+        }
+    return grade
 
 
 def format_queryplan_grade(grade: dict) -> str:
@@ -812,7 +834,23 @@ def format_queryplan_grade(grade: dict) -> str:
                              f"-> {verdict}")
             else:
                 parts.append(f"{side} {d['predicted_bytes']} B")
+        w = op.get("wall")
+        if w:
+            ratio = (f" -> x{w['ratio']:.3g}"
+                     if w.get("ratio") is not None else "")
+            pred = (f"{w['predicted_s']:.6g}s"
+                    if w.get("predicted_s") is not None else "?")
+            parts.append(f"wall {pred} predicted, "
+                         f"{w['measured_s']:.6g}s measured{ratio}")
         lines.append(f"  {tag}: " + ", ".join(parts))
+    walls = grade.get("walls")
+    if walls:
+        frac = walls.get("overlap_fraction")
+        lines.append(
+            f"  operator walls: sum {walls.get('sum_of_operators_s')}s"
+            f" vs monolithic {walls.get('monolithic_wall_s')}s"
+            + (f" ({frac:.1%} overlapped)" if frac is not None
+               else ""))
     orders = grade.get("orders") or []
     if orders:
         lines.append("  join orders priced:")
@@ -1170,6 +1208,52 @@ def check_file(path: str) -> list:
                 "wall_s" not in doc["monolithic"]:
             problems.append("monolithic missing 'wall_s'")
         return problems
+    elif name.startswith("query_stageprofile") or \
+            doc.get("kind") == "query_stageprofile":
+        # The per-OPERATOR query profiling artifact
+        # (telemetry/stageprof.py profile_query_stages): its own kind
+        # — the join-stage contract's four fixed stage keys do not
+        # apply; the stage keys here are the plan's op_ids, listed in
+        # 'order'.
+        for key in ("schema_version", "kind", "plan_digest",
+                    "n_ranks", "n_operators", "repeats", "order",
+                    "operators", "sum_of_operators_s", "monolithic",
+                    "overlap"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        ops = doc.get("operators")
+        if isinstance(ops, dict):
+            for oid in doc.get("order") or []:
+                if oid not in ops:
+                    problems.append(
+                        f"operators missing {oid!r} (every op in "
+                        "'order' must have an entry)")
+        elif "operators" in doc:
+            problems.append("operators is not an object")
+        if isinstance(doc.get("monolithic"), dict) and \
+                "wall_s" not in doc["monolithic"]:
+            problems.append("monolithic missing 'wall_s'")
+        return problems
+    elif name.startswith("tracing_smoke") or \
+            doc.get("kind") == "tracing_smoke":
+        # The tracing lane's acceptance record (service/fleet.py
+        # run_tracing_smoke): one-trace failover continuity through a
+        # scripted kill plus the merged fleet-timeline census, whose
+        # deterministic counter signature the perfgate lane gates
+        # against results/baselines/tracing_smoke.json.
+        for key in ("kind", "n_ranks", "replicas", "root_trace_id",
+                    "timeline_processes", "focus_trace_processes",
+                    "timeline", "counter_signature"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        sig = doc.get("counter_signature")
+        if isinstance(sig, dict):
+            if not isinstance(sig.get("counters"), dict):
+                problems.append("counter_signature missing "
+                                "'counters'")
+        elif "counter_signature" in doc:
+            problems.append("counter_signature is not an object")
+        return problems
     elif name.startswith("resident_drill") or \
             doc.get("kind") == "resident_drill":
         # The service smoke's resident A/B sub-record (register ->
@@ -1297,6 +1381,23 @@ def check_file(path: str) -> list:
                 problems.append(f"missing required key {key!r}")
         if not isinstance(doc.get("verdicts"), dict):
             problems.append("verdicts is not an object")
+        return problems
+    elif name.startswith("fleet_timeline") or \
+            doc.get("kind") == "fleet_timeline":
+        # The merged fleet-timeline summary (telemetry/timeline.py
+        # via `analyze timeline`): per-process inventory, trace
+        # census, cross-process hop count, skew bound, critical
+        # path. (The sibling .trace.json is a Chrome trace and lands
+        # in the traceEvents branch above.)
+        for key in ("schema_version", "kind", "processes",
+                    "n_spans", "n_traces", "hops",
+                    "skew_bound_us", "critical_path"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        if not isinstance(doc.get("processes"), list):
+            problems.append("processes is not a list")
+        if not isinstance(doc.get("critical_path"), list):
+            problems.append("critical_path is not a list")
         return problems
     elif name == "flightrecorder.json" or \
             doc.get("kind") == "flightrecorder":
@@ -1479,6 +1580,29 @@ def main(argv=None) -> int:
                     help="print the grade JSON instead of the human "
                          "report")
 
+    tl = sub.add_parser(
+        "timeline",
+        help="merge per-process telemetry session dirs into ONE "
+             "fleet timeline: a Perfetto trace with a track per "
+             "process and flow arrows across wire hops, the focus "
+             "trace's critical path, and a fleet_timeline.json "
+             "summary artifact (telemetry/timeline.py, "
+             "docs/OBSERVABILITY.md \"Distributed tracing\")")
+    tl.add_argument("dirs", nargs="+",
+                    help="telemetry session dirs (or explicit "
+                         "events.rank*.jsonl streams), one per "
+                         "process — router + every replica")
+    tl.add_argument("--trace-id", default=None,
+                    help="focus trace (default: the trace touching "
+                         "the most processes)")
+    tl.add_argument("--out", default=None,
+                    help="output directory for fleet_timeline.json "
+                         "+ fleet_timeline.trace.json (default: the "
+                         "first DIR)")
+    tl.add_argument("--json", action="store_true",
+                    help="print the fleet_timeline record instead "
+                         "of the human report")
+
     k = sub.add_parser("check",
                        help="shape-validate telemetry artifacts "
                             "(summary/diagnosis/baseline/trace/"
@@ -1615,6 +1739,32 @@ def main(argv=None) -> int:
                 print(json.dumps(grade_stages(profile), indent=1))
             else:
                 print(format_stages(profile))
+            return 0
+        if args.cmd == "timeline":
+            from distributed_join_tpu.telemetry import (
+                timeline as tl_mod,
+            )
+
+            asm = tl_mod.assemble(args.dirs,
+                                  trace_id=args.trace_id)
+            out_dir = args.out or (
+                args.dirs[0] if os.path.isdir(args.dirs[0])
+                else os.path.dirname(args.dirs[0]) or ".")
+            os.makedirs(out_dir, exist_ok=True)
+            trace_path = tl_mod.write_perfetto(
+                asm, os.path.join(out_dir,
+                                  "fleet_timeline.trace.json"))
+            record = tl_mod.as_record(asm, trace_file=trace_path)
+            rec_path = os.path.join(out_dir, "fleet_timeline.json")
+            with open(rec_path, "w") as f:
+                json.dump(record, f, indent=1)
+            if args.json:
+                print(json.dumps(record, indent=1))
+            else:
+                print(tl_mod.format_report(asm))
+                print(f"\nwrote {rec_path}")
+                print(f"wrote {trace_path} (load in "
+                      "ui.perfetto.dev)")
             return 0
         if args.cmd == "check":
             bad = 0

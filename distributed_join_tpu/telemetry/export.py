@@ -69,6 +69,7 @@ class TelemetrySink:
         self._epoch = time.time()
         self._lock = threading.Lock()
         self._request_id: Optional[str] = None
+        self._trace: Optional[dict] = None
         self._counters: dict = {}
         self._span_stats: dict = {}
         self._metrics: Optional[dict] = None
@@ -108,6 +109,38 @@ class TelemetrySink:
             self._request_id = request_id
         return prev
 
+    def set_trace(self, trace: Optional[dict]) -> Optional[dict]:
+        """Install the distributed trace context (``telemetry/
+        tracectx.py`` dict: ``trace_id``/``span_id``/
+        ``parent_span_id``); every event/span recorded while set
+        carries the three fields (JSONL fields + trace args) — the
+        cross-process correlation key ``telemetry/timeline.py``
+        assembles fleet timelines from. Sink-global like the request
+        id (and for the same reason: a request's worker threads must
+        inherit it). Returns the previous context
+        (``telemetry.request_scope`` restores it)."""
+        with self._lock:
+            prev = self._trace
+            self._trace = dict(trace) if trace else None
+        return prev
+
+    def current_trace(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._trace) if self._trace else None
+
+    def _stamp_trace(self, rec: dict, args: dict) -> None:
+        """Lock-held: stamp the active trace context on one record.
+        Payload-carried fields win (an event narrating ANOTHER span —
+        a link event — names its own ids); the scope fills the rest."""
+        t = self._trace
+        if t is None and "trace_id" not in args:
+            return
+        for k in ("trace_id", "span_id", "parent_span_id"):
+            v = args.get(k, (t or {}).get(k))
+            if v is not None:
+                rec[k] = v
+                args.setdefault(k, v)
+
     def _write_line(self, rec: dict) -> None:
         self._log.write(json.dumps(rec, default=_json_default) + "\n")
 
@@ -134,6 +167,7 @@ class TelemetrySink:
             if rid is not None:
                 rec["request_id"] = rid
                 args.setdefault("request_id", rid)
+            self._stamp_trace(rec, args)
             self._write_line(rec)
             self._push_trace({
                 "name": name, "cat": "event", "ph": "i", "s": "t",
@@ -162,6 +196,7 @@ class TelemetrySink:
             if rid is not None:
                 rec["request_id"] = rid
                 args.setdefault("request_id", rid)
+            self._stamp_trace(rec, args)
             self._write_line(rec)
             self._push_trace({
                 "name": name, "cat": "span", "ph": "X",
@@ -209,6 +244,13 @@ class TelemetrySink:
         from distributed_join_tpu.telemetry.stageprof import STAGE_KEYS
 
         stages = record.get("stages") or {}
+        ordered = [s for s in STAGE_KEYS if s in stages]
+        if not stages:
+            # query_stageprofile records carry per-OPERATOR entries
+            # (same per-entry shape) keyed by op_id, in plan 'order'.
+            stages = record.get("operators") or {}
+            ordered = [o for o in (record.get("order") or [])
+                       if o in stages]
         with self._lock:
             if self._closed:
                 return
@@ -222,7 +264,7 @@ class TelemetrySink:
                     "args": {"name": label},
                 })
             t_us = base
-            for name in STAGE_KEYS:
+            for name in ordered:
                 info = stages.get(name)
                 if not isinstance(info, dict) or not info.get("ran"):
                     continue

@@ -336,7 +336,8 @@ def request_entry(*, request_id: str, op: str, signature: str,
                   resident: Optional[dict] = None,
                   aggregate: Optional[dict] = None,
                   replica: Optional[dict] = None,
-                  error: Optional[str] = None) -> dict:
+                  error: Optional[str] = None,
+                  trace: Optional[dict] = None) -> dict:
     """One serving request's history line (the JoinService write
     path). ``metrics`` is the request's ``Metrics.to_dict()`` block
     when telemetry rode the program, else None; ``predicted_wall_s``
@@ -382,6 +383,13 @@ def request_entry(*, request_id: str, op: str, signature: str,
         # (None = a single-daemon request; `analyze check` validates
         # the shape).
         "replica": replica,
+        # Distributed-trace stamp (telemetry/tracectx.py): the
+        # (trace_id, span_id, parent_span_id) context active when the
+        # request ran, so `analyze timeline` joins history lines from
+        # every process of a fleet into one causal chain. None = an
+        # untraced request; `analyze check` validates the shape.
+        "trace": (dict(trace) if trace and trace.get("trace_id")
+                  else None),
         "error": error,
     }
 

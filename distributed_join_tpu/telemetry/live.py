@@ -385,6 +385,118 @@ class LiveMetrics:
         return "\n".join(lines) + "\n"
 
 
+def merge_snapshots(snapshots: list) -> dict:
+    """Fold N :meth:`LiveMetrics.snapshot` dicts (one per fleet
+    replica) into ONE fleet-level view: per-op outcome counters
+    summed, latency histograms merged bucket-wise (exact — the bounds
+    are the module constants), QPS summed (replicas serve disjoint
+    traffic), uptime = the longest-lived replica. The shape mirrors a
+    single snapshot's ``ops`` block so readers (the ``--watch``
+    console, ``analyze``) need no second schema."""
+    merged_ops: dict = {}
+    for snap in snapshots:
+        for op, slot in (snap.get("ops") or {}).items():
+            m = merged_ops.setdefault(op, {
+                "outcomes": {}, "cache_hits": 0, "new_traces": 0,
+                "retry_rungs": 0, "integrity_retries": 0,
+                "_hist": LatencyHistogram(),
+            })
+            for outcome, n in (slot.get("outcomes") or {}).items():
+                m["outcomes"][outcome] = (
+                    m["outcomes"].get(outcome, 0) + int(n))
+            for k in ("cache_hits", "new_traces", "retry_rungs",
+                      "integrity_retries"):
+                m[k] += int(slot.get(k) or 0)
+            hist = slot.get("latency_histogram")
+            if hist:
+                m["_hist"].merge(hist)
+    ops = {}
+    for op, m in sorted(merged_ops.items()):
+        hist = m.pop("_hist")
+        ops[op] = {**m, "latency": hist.summary(),
+                   "latency_histogram": hist.snapshot()}
+    return {
+        "replicas": len(snapshots),
+        "uptime_s": round(max(
+            [float(s.get("uptime_s") or 0.0) for s in snapshots],
+            default=0.0), 3),
+        "qps_60s": round(sum(float(s.get("qps_60s") or 0.0)
+                             for s in snapshots), 3),
+        "ops": ops,
+    }
+
+
+def fleet_prometheus(per_replica: dict) -> str:
+    """The fleet-level Prometheus section the router appends to its
+    own exposition: per-replica-labeled request counters plus the
+    MERGED cross-replica latency histogram (bucket counts add — the
+    fixed-bound contract), so one scrape sees the whole fleet.
+    ``per_replica`` maps a replica index to its ``metrics`` snapshot
+    (None for a replica that did not answer — exported as
+    ``djtpu_fleet_replica_up 0``)."""
+    lines = [
+        "# HELP djtpu_fleet_replica_up Replica answered the metrics "
+        "fan-out.",
+        "# TYPE djtpu_fleet_replica_up gauge",
+    ]
+    answered = {}
+    for idx in sorted(per_replica):
+        snap = per_replica[idx]
+        lines.append(
+            f'djtpu_fleet_replica_up{{replica="{idx}"}} '
+            f"{int(snap is not None)}")
+        if snap is not None:
+            answered[idx] = snap
+    lines += [
+        "# HELP djtpu_fleet_replica_requests_total Replica requests "
+        "by op and outcome.",
+        "# TYPE djtpu_fleet_replica_requests_total counter",
+    ]
+    for idx, snap in sorted(answered.items()):
+        for op, slot in sorted((snap.get("ops") or {}).items()):
+            for outcome, n in sorted(
+                    (slot.get("outcomes") or {}).items()):
+                lines.append(
+                    "djtpu_fleet_replica_requests_total"
+                    f'{{replica="{idx}",op="{op}",'
+                    f'outcome="{outcome}"}} {n}')
+    merged = merge_snapshots(list(answered.values()))
+    lines += [
+        "# HELP djtpu_fleet_requests_total Fleet-merged requests by "
+        "op and outcome.",
+        "# TYPE djtpu_fleet_requests_total counter",
+    ]
+    for op, slot in sorted(merged["ops"].items()):
+        for outcome, n in sorted(slot["outcomes"].items()):
+            lines.append(
+                "djtpu_fleet_requests_total"
+                f'{{op="{op}",outcome="{outcome}"}} {n}')
+    lines += [
+        "# HELP djtpu_fleet_request_latency_seconds Fleet-merged "
+        "served request latency (replica histograms added "
+        "bucket-wise).",
+        "# TYPE djtpu_fleet_request_latency_seconds histogram",
+    ]
+    for op, slot in sorted(merged["ops"].items()):
+        hist = slot["latency_histogram"]
+        cum = 0
+        for i, le in enumerate(LATENCY_BUCKETS_S):
+            cum += hist["counts"][i]
+            lines.append(
+                "djtpu_fleet_request_latency_seconds_bucket"
+                f'{{op="{op}",le="{le:g}"}} {cum}')
+        lines.append(
+            "djtpu_fleet_request_latency_seconds_bucket"
+            f'{{op="{op}",le="+Inf"}} {hist["count"]}')
+        lines.append(
+            "djtpu_fleet_request_latency_seconds_sum"
+            f'{{op="{op}"}} {hist["sum_s"]:.6f}')
+        lines.append(
+            "djtpu_fleet_request_latency_seconds_count"
+            f'{{op="{op}"}} {hist["count"]}')
+    return "\n".join(lines) + "\n"
+
+
 class FlightRecorder:
     """Bounded ring of the last-N per-request records — the resident
     server's postmortem buffer.
@@ -415,11 +527,12 @@ class FlightRecorder:
         with self._lock:
             return len(self._ring)
 
-    def snapshot(self, reason: str = "snapshot") -> dict:
+    def snapshot(self, reason: str = "snapshot",
+                 trace: Optional[dict] = None) -> dict:
         with self._lock:
             records = [dict(r) for r in self._ring]
             total = self._recorded_total
-        return {
+        doc = {
             "schema_version": FLIGHT_RECORDER_SCHEMA_VERSION,
             "kind": "flightrecorder",
             "reason": reason,
@@ -429,10 +542,17 @@ class FlightRecorder:
             "dropped": max(total - len(records), 0),
             "records": records,
         }
+        if trace:
+            # The trace context active when the dump was cut (a
+            # poisoned replica's hung request): the postmortem joins
+            # that request's fleet timeline by trace_id.
+            doc["trace"] = dict(trace)
+        return doc
 
-    def dump(self, path: str, reason: str) -> str:
+    def dump(self, path: str, reason: str,
+             trace: Optional[dict] = None) -> str:
         """Atomically write the ring to ``path`` and return it."""
-        doc = self.snapshot(reason)
+        doc = self.snapshot(reason, trace=trace)
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)

@@ -722,3 +722,286 @@ def profile_join_stages(comm, build, probe, key="key", repeats: int = 3,
     )
 
 
+# -- query-chain profiling (per-OPERATOR walls) ------------------------
+
+
+@dataclasses.dataclass
+class QueryStageProfile:
+    """One profiled multi-operator query: per-OPERATOR walls (each
+    operator compiled as its own barriered SPMD program), the
+    monolithic ``make_query_step`` wall (the exact program
+    ``distributed_query`` dispatches), and the derived cross-operator
+    overlap credit. The segmentation boundary here is the OPERATOR —
+    the same resolution ``explain_query`` prices (one ``cost.predict``
+    verdict per op), so predicted-vs-measured grading joins on op_id
+    exactly like the join-stage profile joins on stage name.
+
+    ``as_record()`` is the ``query_stageprofile.json`` artifact (its
+    own kind — ``analyze check``'s ``stageprofile`` contract requires
+    the four join-stage keys, which do not apply here); ``summary()``
+    is shaped for ``history.stages_block`` with op_ids as the stage
+    keys, so per-operator walls flow into history trends unchanged."""
+
+    plan_digest: str
+    n_ranks: int
+    n_operators: int
+    repeats: int
+    platform: str
+    overflow: bool
+    operators: dict              # op_id -> stage dict (_stage_entry)
+    order: list                  # op_ids in plan order
+    monolithic_walls_s: list
+    predicted_total_s: Optional[float]
+    cost_model: Optional[dict] = None
+
+    @property
+    def monolithic_wall_s(self) -> float:
+        return _median(self.monolithic_walls_s)
+
+    @property
+    def monolithic_wall_min_s(self) -> float:
+        return min(self.monolithic_walls_s) \
+            if self.monolithic_walls_s else 0.0
+
+    @property
+    def sum_of_operators_s(self) -> float:
+        return sum(s["wall_s"] for s in self.operators.values())
+
+    @property
+    def overlap(self) -> dict:
+        total = self.sum_of_operators_s
+        credit = total - self.monolithic_wall_s
+        return {
+            "credit_s": _round_s(credit),
+            "fraction": (_round_s(credit / total) if total > 0
+                         else None),
+            "note": ("sum-of-operators minus monolithic wall: "
+                     "scheduling XLA hides across operator boundaries "
+                     "that the per-op programs pay serially"),
+        }
+
+    def as_record(self) -> dict:
+        return {
+            "schema_version": STAGE_PROFILE_SCHEMA_VERSION,
+            "kind": "query_stageprofile",
+            "pipeline": "query",
+            "plan_digest": self.plan_digest,
+            "n_ranks": self.n_ranks,
+            "n_operators": self.n_operators,
+            "repeats": self.repeats,
+            "platform": self.platform,
+            "overflow": self.overflow,
+            "order": list(self.order),
+            "operators": {k: dict(v)
+                          for k, v in self.operators.items()},
+            "sum_of_operators_s": _round_s(self.sum_of_operators_s),
+            "monolithic": {
+                "wall_s": _round_s(self.monolithic_wall_s),
+                "wall_min_s": _round_s(self.monolithic_wall_min_s),
+                "walls_s": [_round_s(w)
+                            for w in self.monolithic_walls_s],
+            },
+            "overlap": self.overlap,
+            "cost_model": self.cost_model,
+            "predicted_total_s": self.predicted_total_s,
+        }
+
+    def summary(self) -> dict:
+        """The compact per-record block — ``history.stages_block``
+        reads ``wall_s``/``ratio`` dicts without caring that the keys
+        are op_ids instead of join-stage names, so query records'
+        per-operator walls land in ``analyze history`` trends through
+        the existing seam."""
+        return {
+            "plan_digest": self.plan_digest,
+            "pipeline": "query",
+            "repeats": self.repeats,
+            "platform": self.platform,
+            "overflow": self.overflow,
+            "wall_s": {k: v["wall_s"]
+                       for k, v in self.operators.items()},
+            "ratio": {k: v["ratio"] for k, v in self.operators.items()
+                      if v.get("ratio") is not None},
+            "sum_of_stages_s": _round_s(self.sum_of_operators_s),
+            "monolithic_wall_s": _round_s(self.monolithic_wall_s),
+            "overlap_fraction": self.overlap["fraction"],
+        }
+
+    def format(self) -> str:
+        return format_query_stage_record(self.as_record())
+
+
+def format_query_stage_record(record: dict) -> str:
+    """THE one human rendering of a query stage-profile record —
+    shared by the driver's ``--query --stage-profile`` printout and
+    ``analyze``'s query_stageprofile surfaces."""
+    ops = record.get("operators") or {}
+    lines = [
+        f"query stage profile {str(record.get('plan_digest'))[:16]}: "
+        f"{record.get('n_operators')} operator(s), "
+        f"{record.get('n_ranks')} rank(s), "
+        f"{record.get('repeats')} repeat(s), "
+        f"platform={record.get('platform')}"
+        + ("  [OVERFLOW — walls belong to a clamped run]"
+           if record.get("overflow") else ""),
+        f"  {'operator':<14} {'measured':>12} {'predicted':>12} "
+        f"{'ratio':>9}",
+    ]
+    order = [o for o in (record.get("order") or []) if o in ops] + \
+        sorted(o for o in ops if o not in (record.get("order") or []))
+    for name in order:
+        s = ops[name]
+        if not s.get("ran"):
+            lines.append(f"  {name:<14} {'-':>12} "
+                         f"{s.get('predicted_s')!s:>12} {'-':>9}")
+            continue
+        ratio = (f"x{s['ratio']:.3g}" if s.get("ratio") is not None
+                 else "-")
+        pred = s.get("predicted_s")
+        pred_txt = f"{pred:>12.6f}" if pred else f"{'-':>12}"
+        lines.append(f"  {name:<14} {s['wall_s']:>12.6f} "
+                     f"{pred_txt} {ratio:>9}")
+    ov = record.get("overlap") or {}
+    mono = (record.get("monolithic") or {}).get("wall_s")
+    if record.get("sum_of_operators_s") is not None \
+            and mono is not None:
+        lines.append(
+            f"  sum-of-operators {record['sum_of_operators_s']:.6f}s "
+            f"vs monolithic {mono:.6f}s -> overlap credit "
+            f"{ov.get('credit_s'):.6f}s"
+            + (f" ({ov['fraction']:.1%} of per-op work hidden)"
+               if ov.get("fraction") is not None else ""))
+    return "\n".join(lines)
+
+
+def profile_query_stages(comm, plan, tables, repeats: int = 3,
+                         cost_model=None,
+                         **defaults) -> QueryStageProfile:
+    """Profile one multi-operator :class:`~..planning.query.QueryPlan`
+    operator by operator.
+
+    Each operator compiles as its OWN ``make_join_step`` program (the
+    exact per-op step ``make_query_step`` chains, via the shared
+    ``_op_steps`` seam — same keys, join type, fused aggregate, and
+    per-op options), dispatched against the intermediates the warm
+    chain produced, with a fetch-one-scalar barrier and N-repeat
+    median per op. The monolithic comparator is the ONE
+    ``make_query_step`` program ``distributed_query`` times — so
+    ``sum(op walls) - monolithic wall`` is the measured cross-operator
+    overlap credit. Per-op predictions come from ``explain_query``'s
+    ``cost.predict`` verdicts at the same defaults, joining measured
+    to predicted at the op_id resolution.
+
+    ``defaults`` are ``distributed_query``-shaped executor defaults
+    (per-op plan options win, exactly as in execution). Intended as an
+    untimed side pass AFTER any timed region, never inside one.
+    """
+    import jax
+
+    from distributed_join_tpu import telemetry
+    from distributed_join_tpu.parallel.distributed_join import (
+        JOIN_SHARDED_OUT,
+        _round_up,
+    )
+    from distributed_join_tpu.parallel.query_exec import (
+        _op_steps,
+        make_query_step,
+        query_sharded_out,
+    )
+    from distributed_join_tpu.planning.query import explain_query
+    from distributed_join_tpu.telemetry.spans import fetch_one_scalar
+
+    if repeats < 1:
+        raise ValueError("repeats must be >= 1")
+    defaults = dict(defaults)
+
+    # Predictions first (no tracing): one cost.predict verdict per
+    # operator at the SAME defaults the profiled programs compile with.
+    doc = explain_query(plan, comm, dict(tables),
+                        cost_model=cost_model, defaults=defaults,
+                        orders=False)
+    predicted = {o["id"]: ((o.get("cost") or {}).get("total_s"))
+                 for o in doc.get("operators") or []}
+
+    n = comm.n_ranks
+    missing = [name for name in plan.tables if name not in tables]
+    if missing:
+        raise ValueError(
+            f"plan references base tables {missing} not supplied "
+            f"(have {sorted(tables)})")
+    padded = {
+        name: tables[name].pad_to(
+            _round_up(tables[name].capacity, n))
+        for name in plan.tables
+    }
+    if hasattr(comm, "device_put_sharded"):
+        padded = comm.device_put_sharded(padded)
+
+    # -- per-operator programs (the _op_steps seam) -------------------
+
+    steps = _op_steps(comm, plan, defaults, False, None)
+    op_fns = [comm.spmd(s, sharded_out=JOIN_SHARDED_OUT)
+              for s in steps]
+
+    # Warm chain: run each op program once, threading intermediates
+    # exactly as make_query_step's env does — the captured per-op
+    # inputs are what the timed repeats re-dispatch.
+    overflow_seen = False
+    env = dict(padded)
+    op_inputs = []
+    for op, fn in zip(plan.ops, op_fns):
+        fargs = (env[op.build], env[op.probe])
+        res = fn(*fargs)
+        fetch_one_scalar(res.total)
+        overflow_seen = overflow_seen or bool(res.overflow)
+        env[op.op_id] = res.table
+        op_inputs.append((op.op_id, fn, fargs))
+
+    # The monolithic comparator: the exact program distributed_query
+    # dispatches (with_metrics=False — the seed hot path).
+    mono_step = make_query_step(comm, plan, defaults=defaults)
+    fn_mono = comm.spmd(
+        mono_step, sharded_out=query_sharded_out(plan, False))
+    margs = tuple(padded[name] for name in plan.tables)
+    warm = fn_mono(*margs)
+    fetch_one_scalar(warm.total)
+    overflow_seen = overflow_seen or bool(warm.overflow)
+
+    # -- timed repeats (fetch-one-scalar barrier per op) --------------
+
+    walls: dict = {op_id: [] for op_id, *_ in op_inputs}
+    mono_walls = []
+    for _ in range(repeats):
+        for op_id, fn, fargs in op_inputs:
+            t0 = time.perf_counter()
+            res = fn(*fargs)
+            fetch_one_scalar(res.total)
+            dt = time.perf_counter() - t0
+            walls[op_id].append(dt)
+            telemetry.span_complete(f"query_profile.{op_id}", t0, dt)
+        t0 = time.perf_counter()
+        res = fn_mono(*margs)
+        fetch_one_scalar(res.total)
+        dt = time.perf_counter() - t0
+        mono_walls.append(dt)
+        telemetry.span_complete("query_profile.monolithic", t0, dt)
+
+    operators = {
+        op_id: _stage_entry(True, walls[op_id], None,
+                            predicted.get(op_id) or 0.0)
+        for op_id, *_ in op_inputs
+    }
+    return QueryStageProfile(
+        plan_digest=doc.get("digest") or plan.digest(),
+        n_ranks=n,
+        n_operators=len(plan.ops),
+        repeats=repeats,
+        platform=jax.default_backend(),
+        overflow=overflow_seen,
+        operators=operators,
+        order=[op.op_id for op in plan.ops],
+        monolithic_walls_s=mono_walls,
+        predicted_total_s=doc.get("total_s"),
+    )
+
+

@@ -152,6 +152,30 @@
 #                                   # mid-soak: zero wrong rows,
 #                                   # failover within budget, rebuild
 #                                   # + fenced zero-trace replay)
+#   scripts/run_tier1.sh tracing    # fleet-wide distributed tracing
+#                                   # (docs/OBSERVABILITY.md
+#                                   # "Distributed tracing"):
+#                                   # tests/test_tracing.py (trace-
+#                                   # context mint/child/wire
+#                                   # adoption, sink stamping,
+#                                   # request-scope restore, fleet
+#                                   # timeline assembly + critical
+#                                   # path on synthetic streams,
+#                                   # tracing-off parity) + the
+#                                   # --tracing-smoke subprocess
+#                                   # protocol (2 replicas with per-
+#                                   # slot telemetry dirs, scripted
+#                                   # SIGKILL -> the failed attempt
+#                                   # and the failover retry share
+#                                   # ONE trace_id in the flight
+#                                   # ring AND the merged Perfetto
+#                                   # fleet timeline; both timeline
+#                                   # artifacts schema-checked;
+#                                   # counter signature gated vs
+#                                   # results/baselines/
+#                                   # tracing_smoke.json) + `analyze
+#                                   # timeline` over the smoke's
+#                                   # per-process session dirs
 #   scripts/run_tier1.sh tuner      # autotuner: -m tuner suite + a
 #                                   # cold/warm driver A/B (warm run
 #                                   # must start at the escalated
@@ -384,6 +408,23 @@ PY
       "$tmp/fleet_ha_smoke.json"
     python -m distributed_join_tpu.telemetry.analyze compare \
       "$tmp/fleet_ha_smoke.json" --baseline fleet_ha_smoke
+    # The tracing smoke's counter signature is part of the same gate
+    # (docs/OBSERVABILITY.md "Distributed tracing"): the scripted-
+    # kill protocol's deterministic one-trace failover continuity
+    # (the failed attempt and the winning retry share ONE trace_id)
+    # plus the merged fleet-timeline process census — a changed
+    # trace-context mint/attach/adopt seam, flight-ring stamping, or
+    # timeline assembler moves them. The hop/critical-path shape
+    # gates live in the tracing lane.
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.service.fleet --tracing-smoke \
+      --platform cpu --replica-ranks 2 \
+      --json-output "$tmp/tracing_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/tracing_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/tracing_smoke.json" --baseline tracing_smoke
     exit $?
     ;;
   agg)
@@ -809,6 +850,50 @@ PY
     # no exec: the EXIT trap must still clean $tmp
     python -m distributed_join_tpu.telemetry.analyze check \
       "$tmp/fleet_ha_soak.json"
+    ;;
+  tracing)
+    # Fleet-wide distributed tracing (docs/OBSERVABILITY.md
+    # "Distributed tracing"). 1. tests/test_tracing.py: trace-context
+    # minting/capping, wire attach (copy semantics) + receiver-side
+    # adoption (child_of_wire), sink event stamping, request_scope
+    # save/restore, fleet timeline assembly on synthetic per-process
+    # streams (clock anchoring, cross-process hops, critical path,
+    # Perfetto export), and tracing-off parity (no trace fields, no
+    # extra events). 2. the --tracing-smoke subprocess protocol: 2
+    # replicas each with its OWN telemetry session dir, cold/warm
+    # serving under client-minted trace contexts, then one scripted
+    # SIGKILL of the affine replica — the router's failed dispatch
+    # attempt and the winning failover retry must share ONE trace_id
+    # in the flight ring AND in the merged timeline; the three
+    # per-process JSONL streams assemble into ONE Perfetto fleet
+    # timeline whose focus trace spans both surviving processes with
+    # >= 1 cross-process hop and a non-empty critical path; both
+    # timeline artifacts are schema-checked and the counter
+    # signature is gated vs results/baselines/tracing_smoke.json.
+    # 3. `analyze timeline` renders the merged causal report from
+    # the smoke's kept session dirs.
+    set -e
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+      tests/test_tracing.py -q --continue-on-collection-errors \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+    tmp="$(mktemp -d /tmp/djtpu_tracing.XXXXXX)"
+    trap 'rm -rf "$tmp"' EXIT
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.service.fleet --tracing-smoke \
+      --platform cpu --replica-ranks 2 \
+      --persist-dir "$tmp/work" \
+      --json-output "$tmp/tracing_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/tracing_smoke.json" \
+      "$tmp/work/telemetry/fleet_timeline.json"
+    python -m distributed_join_tpu.telemetry.analyze compare \
+      "$tmp/tracing_smoke.json" --baseline tracing_smoke
+    python -m distributed_join_tpu.telemetry.analyze timeline \
+      "$tmp/work/telemetry/router" \
+      "$tmp/work/telemetry/replica0" \
+      "$tmp/work/telemetry/replica1" \
+      --out "$tmp/timeline"
     ;;
   tuner)
     # History-driven autotuner (docs/OBSERVABILITY.md "Autotuner").

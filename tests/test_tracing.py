@@ -1,0 +1,396 @@
+"""Distributed tracing (docs/OBSERVABILITY.md "Distributed tracing").
+
+Three contracts:
+
+- **The context algebra is exact.** ``telemetry/tracectx.py``: a mint
+  is a root, a child shares the trace and parents on the minter's
+  span, the wire carries exactly ``{trace_id, span_id}``, the
+  receiver adopts by parenting a FRESH span on the sender's
+  (``child_of_wire`` — the cross-process edge), ``attach`` copies
+  (a retry must never see a previous attempt's span id), and long
+  client-supplied ids cap under the request-id prefix+sha256 scheme
+  without aliasing.
+- **The sink stamps honestly.** ``telemetry.request_scope`` installs
+  the context for exactly its extent (nested scopes restore), every
+  event/span recorded inside carries the three trace fields, records
+  outside carry none, and payload-carried fields (link events naming
+  ANOTHER span) win over the scope.
+- **The timeline is one causal view.** ``telemetry/timeline.py``
+  merges per-process JSONL streams onto a common wall clock, finds
+  the cross-process hops by parent/child span ownership, bounds the
+  residual skew by wire causality, walks the focus trace's critical
+  path, tolerates exactly a torn FINAL line (the SIGKILLed-victim
+  artifact), and exports a Perfetto trace + an ``analyze check``-
+  valid ``fleet_timeline`` record.
+
+With tracing OFF nothing changes: no session means ``request_scope``
+is a no-op and ``attach`` with no context returns the request
+untouched (the compiled-program parity locks live in
+tests/test_telemetry.py).
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_join_tpu import telemetry
+from distributed_join_tpu.telemetry import timeline, tracectx
+from distributed_join_tpu.telemetry.analyze import check_file
+
+pytestmark = pytest.mark.tracing
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_session():
+    """Telemetry state is process-global; a test that dies mid-session
+    must not flip every later test into the instrumented path."""
+    telemetry.finalize()
+    yield
+    telemetry.finalize()
+
+
+# -- the context algebra ----------------------------------------------
+
+
+def test_mint_is_a_root():
+    ctx = tracectx.mint()
+    assert ctx["trace_id"].startswith("t-")
+    assert len(ctx["trace_id"]) == 2 + 32  # 128-bit hex
+    assert len(ctx["span_id"]) == 16       # 64-bit hex
+    assert ctx["parent_span_id"] is None
+
+
+def test_mint_honors_client_supplied_trace_id():
+    assert tracectx.mint("my-trace")["trace_id"] == "my-trace"
+    # Long ids cap under the request-id scheme...
+    long = "x" * 100
+    capped = tracectx.mint(long)["trace_id"]
+    assert len(capped) == tracectx.MAX_ID_LEN
+    assert capped.startswith("x" * 48)
+    # ...WITHOUT aliasing: same 64-char prefix, distinct ids.
+    other = "x" * 99 + "y"
+    assert tracectx.mint(other)["trace_id"] != capped
+
+
+def test_cap_id_identity_below_bound():
+    s = "a" * tracectx.MAX_ID_LEN
+    assert tracectx.cap_id(s) == s
+
+
+def test_child_parents_on_the_minter_span():
+    root = tracectx.mint()
+    c = tracectx.child(root)
+    assert c["trace_id"] == root["trace_id"]
+    assert c["parent_span_id"] == root["span_id"]
+    assert c["span_id"] != root["span_id"]
+    assert tracectx.child(None) is None
+    assert tracectx.child({}) is None
+
+
+def test_wire_round_trip_and_receiver_adoption():
+    root = tracectx.mint()
+    wire = tracectx.to_wire(root)
+    # The wire carries exactly what the receiver needs: the trace and
+    # the sender's span (the receiver's parent) — never the sender's
+    # own parent edge.
+    assert wire == {"trace_id": root["trace_id"],
+                    "span_id": root["span_id"]}
+    req = tracectx.attach({"op": "join"}, root)
+    parsed = tracectx.from_wire(req)
+    assert parsed["trace_id"] == root["trace_id"]
+    assert parsed["span_id"] == root["span_id"]
+    adopted = tracectx.child_of_wire(req)
+    assert adopted["trace_id"] == root["trace_id"]
+    assert adopted["parent_span_id"] == root["span_id"]
+    assert adopted["span_id"] != root["span_id"]
+
+
+def test_from_wire_rejects_malformed():
+    assert tracectx.from_wire({}) is None
+    assert tracectx.from_wire({"trace": "not-a-dict"}) is None
+    assert tracectx.from_wire({"trace": {"span_id": "x"}}) is None
+    assert tracectx.from_wire("not-a-request") is None
+    assert tracectx.child_of_wire({}) is None
+
+
+def test_attach_copies_and_passes_through():
+    req = {"op": "join", "seed": 7}
+    ctx = tracectx.mint()
+    attached = tracectx.attach(req, ctx)
+    # A COPY: the original must never see the attempt's span id — the
+    # router's retry loop re-attaches a FRESH child to the same dict.
+    assert tracectx.TRACE_FIELD not in req
+    assert attached is not req
+    assert attached[tracectx.TRACE_FIELD]["span_id"] == ctx["span_id"]
+    # No context -> the request rides untouched (tracing-off path).
+    assert tracectx.attach(req, None) is req
+
+
+def test_retry_attempts_get_fresh_spans_same_trace():
+    """The router idiom: one dispatch context, a fresh child PER
+    attempt — the failed attempt and the winning retry share the
+    trace but are distinct spans (the timeline draws both hops)."""
+    dispatch = tracectx.mint()
+    attempts = [tracectx.child(dispatch) for _ in range(3)]
+    assert {a["trace_id"] for a in attempts} == {dispatch["trace_id"]}
+    assert len({a["span_id"] for a in attempts}) == 3
+    assert {a["parent_span_id"] for a in attempts} \
+        == {dispatch["span_id"]}
+
+
+def test_stamp_shape():
+    assert tracectx.stamp(None) == {}
+    assert tracectx.stamp({}) == {}
+    ctx = tracectx.mint()
+    st = tracectx.stamp(ctx)
+    assert set(st) == set(tracectx.TRACE_KEYS)
+    assert st["trace_id"] == ctx["trace_id"]
+
+
+# -- sink stamping ----------------------------------------------------
+
+
+def _read_events(session_dir):
+    path = os.path.join(session_dir, "events.rank0.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_request_scope_stamps_and_restores(tmp_path):
+    outer = tracectx.mint()
+    inner = tracectx.child(outer)
+    telemetry.configure(str(tmp_path / "s"), rank=0)
+    try:
+        telemetry.event("before_scope")
+        with telemetry.request_scope("req-1", trace=outer):
+            telemetry.event("outer_event")
+            assert telemetry.current_trace() == outer
+            with telemetry.request_scope("req-1", trace=inner):
+                telemetry.event("inner_event")
+                assert telemetry.current_trace() == inner
+            # nested scope exit restores the OUTER context
+            assert telemetry.current_trace() == outer
+            telemetry.span_complete("outer_span", 0.0, 0.001)
+        assert telemetry.current_trace() is None
+        telemetry.event("after_scope")
+    finally:
+        telemetry.finalize()
+    recs = {r["name"]: r for r in _read_events(tmp_path / "s")}
+    for name in ("before_scope", "after_scope"):
+        assert "trace_id" not in recs[name]
+    assert recs["outer_event"]["trace_id"] == outer["trace_id"]
+    assert recs["outer_event"]["span_id"] == outer["span_id"]
+    assert recs["inner_event"]["span_id"] == inner["span_id"]
+    assert recs["inner_event"]["parent_span_id"] == outer["span_id"]
+    assert recs["outer_span"]["kind"] == "span"
+    assert recs["outer_span"]["trace_id"] == outer["trace_id"]
+    assert recs["outer_event"]["request_id"] == "req-1"
+
+
+def test_link_event_payload_wins_over_scope(tmp_path):
+    """An event narrating ANOTHER span (the router's attempt-failed
+    link events) names its own ids; the scope must not overwrite
+    them."""
+    scope_ctx = tracectx.mint()
+    attempt = tracectx.child(scope_ctx)
+    telemetry.configure(str(tmp_path / "s"), rank=0)
+    try:
+        with telemetry.request_scope("req-1", trace=scope_ctx):
+            telemetry.event("attempt_failed",
+                            **tracectx.stamp(attempt))
+    finally:
+        telemetry.finalize()
+    recs = {r["name"]: r for r in _read_events(tmp_path / "s")}
+    assert recs["attempt_failed"]["span_id"] == attempt["span_id"]
+    assert recs["attempt_failed"]["parent_span_id"] \
+        == scope_ctx["span_id"]
+
+
+def test_tracing_off_is_a_noop():
+    assert not telemetry.enabled()
+    with telemetry.request_scope("req-1", trace=tracectx.mint()):
+        assert telemetry.current_trace() is None
+    telemetry.event("dropped")  # no session: must not raise
+
+
+# -- timeline assembly ------------------------------------------------
+
+
+T0_EPOCH = 1_700_000_000.0
+
+
+def _write_stream(dirpath, records, epoch_s=T0_EPOCH, torn_tail=None):
+    """A synthetic per-process session stream: the session_start
+    clock anchor timeline.py aligns on, then the given records."""
+    os.makedirs(dirpath, exist_ok=True)
+    lines = [{"kind": "event", "name": "session_start", "ts_us": 0.0,
+              "rank": 0, "payload": {"epoch_s": epoch_s}}]
+    lines += records
+    path = os.path.join(dirpath, "events.rank0.jsonl")
+    with open(path, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+        if torn_tail is not None:
+            f.write(torn_tail)  # no newline: a SIGKILL mid-write
+    return path
+
+
+def _two_proc_fleet(tmp_path, *, replica_epoch=T0_EPOCH,
+                    torn_tail=None):
+    """router + replica, one request crossing the wire: the router's
+    dispatch span, a failed-attempt link event, and the replica's
+    adopted request span."""
+    trace = "t-feed"
+    router = {"span": "r1", "attempt": "r2", "retry": "r3"}
+    _write_stream(tmp_path / "router", [
+        {"kind": "span", "name": "fleet_dispatch", "ts_us": 100.0,
+         "dur_us": 900.0, "rank": 0, "request_id": "q1",
+         "trace_id": trace, "span_id": router["span"]},
+        {"kind": "event", "name": "fleet_attempt_failed",
+         "ts_us": 300.0, "rank": 0, "request_id": "q1",
+         "trace_id": trace, "span_id": router["attempt"],
+         "parent_span_id": router["span"]},
+        {"kind": "event", "name": "retry", "ts_us": 400.0, "rank": 0,
+         "request_id": "q1", "trace_id": trace,
+         "span_id": router["retry"],
+         "parent_span_id": router["span"]},
+    ])
+    _write_stream(tmp_path / "replica0", [
+        {"kind": "span", "name": "service_request", "ts_us": 500.0,
+         "dur_us": 300.0, "rank": 0, "request_id": "q1",
+         "trace_id": trace, "span_id": "s1",
+         "parent_span_id": router["retry"]},
+    ], epoch_s=replica_epoch, torn_tail=torn_tail)
+    return trace, [str(tmp_path / "router"),
+                   str(tmp_path / "replica0")]
+
+
+def test_assemble_two_process_trace(tmp_path):
+    trace, dirs = _two_proc_fleet(tmp_path)
+    asm = timeline.assemble(dirs)
+    assert len(asm["procs"]) == 2
+    assert asm["procs"][0]["label"] == "router:r0"
+    # ONE cross-process hop: the replica span parented on the
+    # router's retry event.
+    assert len(asm["hops"]) == 1
+    hop = asm["hops"][0]
+    assert (hop["from"], hop["to"]) == (0, 1)
+    assert hop["parent_span_id"] == "r3"
+    # Same epoch, child after parent: zero residual skew.
+    assert asm["skew_bound_us"] == 0.0
+    # Default focus: the trace touching the most processes.
+    assert asm["focus_trace"] == trace
+    assert sorted(asm["traces"][trace]["procs"]) == [0, 1]
+    # Continuity probe: every q1 record resolves to ONE trace.
+    assert timeline.trace_ids_for_request(asm, "q1") == {trace}
+    assert timeline.trace_ids_for_request(asm, "nope") == set()
+    # The critical path crosses into the replica (its span settles
+    # last: 500+300 lands inside the 100..1000 dispatch, but the
+    # chain walks dispatch -> retry -> replica span).
+    path_names = [n["rec"]["name"] for n in asm["critical_path"]]
+    assert path_names[0] == "fleet_dispatch"
+    assert "service_request" in path_names
+
+
+def test_skew_is_bounded_by_wire_causality(tmp_path):
+    # The replica's clock runs 2ms EARLY: its adopted span lands
+    # before the router-side parent — the inversion IS the bound.
+    _trace, dirs = _two_proc_fleet(
+        tmp_path, replica_epoch=T0_EPOCH - 0.002)
+    asm = timeline.assemble(dirs)
+    assert asm["skew_bound_us"] > 0.0
+    assert asm["skew_bound_us"] <= 2000.0
+
+
+def test_torn_final_line_is_tolerated(tmp_path):
+    trace, dirs = _two_proc_fleet(
+        tmp_path, torn_tail='{"kind": "event", "name": "half')
+    asm = timeline.assemble(dirs)  # must not raise
+    assert asm["focus_trace"] == trace
+
+
+def test_torn_middle_line_raises(tmp_path):
+    _trace, dirs = _two_proc_fleet(tmp_path)
+    path = os.path.join(dirs[1], "events.rank0.jsonl")
+    with open(path) as f:
+        lines = f.readlines()
+    lines.insert(1, '{"kind": "event", "name": "half\n')
+    with open(path, "w") as f:
+        f.writelines(lines)
+    with pytest.raises(ValueError, match="unparseable line"):
+        timeline.assemble(dirs)
+
+
+def test_unanchored_stream_is_kept_but_excluded(tmp_path):
+    trace, dirs = _two_proc_fleet(tmp_path)
+    lost = tmp_path / "lost"
+    os.makedirs(lost)
+    with open(lost / "events.rank0.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "event", "name": "orphan",
+                            "ts_us": 1.0, "rank": 0,
+                            "trace_id": trace,
+                            "span_id": "zz"}) + "\n")
+    asm = timeline.assemble(dirs + [str(lost)])
+    assert len(asm["procs"]) == 3
+    assert not asm["procs"][2]["anchored"]
+    # the orphan's records never land on the common clock
+    assert all(pid != 2 for _t, pid, _r in asm["merged"])
+    # ...and a fleet of ONLY unanchored streams refuses loudly.
+    with pytest.raises(ValueError, match="clock anchor"):
+        timeline.assemble([str(lost)])
+
+
+def test_not_a_session_dir_refuses(tmp_path):
+    empty = tmp_path / "empty"
+    os.makedirs(empty)
+    with pytest.raises(ValueError, match="no events"):
+        timeline.assemble([str(empty)])
+    with pytest.raises(ValueError, match="no such file"):
+        timeline.assemble([str(tmp_path / "missing")])
+
+
+def test_perfetto_export_and_record_schema(tmp_path):
+    trace, dirs = _two_proc_fleet(tmp_path)
+    asm = timeline.assemble(dirs, trace_id=trace)
+    trace_path = timeline.write_perfetto(
+        asm, str(tmp_path / "fleet_timeline.trace.json"))
+    with open(trace_path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    # one named track per process + flow arrows on the hop
+    names = {(e.get("ph"), e.get("name")) for e in evs}
+    assert ("M", "process_name") in names
+    flows = [e for e in evs if e.get("cat") == "trace_hop"]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    # the receiver-side flow end never renders before its start
+    starts = {e["id"]: e["ts"] for e in flows if e["ph"] == "s"}
+    for e in flows:
+        if e["ph"] == "f":
+            assert e["ts"] >= starts[e["id"]]
+    record = timeline.as_record(asm, trace_file=trace_path)
+    assert record["kind"] == "fleet_timeline"
+    assert record["hops"] == 1
+    assert record["focus_trace"] == trace
+    assert record["focus_trace_processes"] == [0, 1]
+    assert record["critical_path"]
+    rec_path = tmp_path / "fleet_timeline.json"
+    with open(rec_path, "w") as f:
+        json.dump(record, f)
+    assert check_file(str(rec_path)) == []
+
+
+def test_real_sink_stream_assembles(tmp_path):
+    """End to end through the REAL writer: a session's stream carries
+    the anchor and stamped spans timeline.py can assemble."""
+    ctx = tracectx.mint()
+    telemetry.configure(str(tmp_path / "s"), rank=0)
+    try:
+        with telemetry.request_scope("req-9", trace=ctx):
+            telemetry.span_complete("serve", 0.0, 0.005)
+    finally:
+        telemetry.finalize()
+    asm = timeline.assemble([str(tmp_path / "s")])
+    assert asm["focus_trace"] == ctx["trace_id"]
+    assert timeline.trace_ids_for_request(asm, "req-9") \
+        == {ctx["trace_id"]}
