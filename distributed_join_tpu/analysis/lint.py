@@ -1,26 +1,34 @@
 """joinlint CLI — ``python -m distributed_join_tpu.analysis.lint``.
 
-Runs both levels (docs/STATIC_ANALYSIS.md):
+Runs all three levels (docs/STATIC_ANALYSIS.md):
 
   python -m distributed_join_tpu.analysis.lint
-      AST rules over the production tree + the jaxpr
-      collective-schedule check against results/schedules/. Exit 0
-      when clean (modulo the committed suppressions), 1 on findings
-      or schedule violations, 2 on configuration errors.
+      AST rules (DJL001-010) over the production tree + the
+      wire-protocol contract check against results/contracts/
+      wire_ops.json + the jaxpr collective-schedule check against
+      results/schedules/. Exit 0 when clean (modulo the committed
+      suppressions), 1 on findings or contract/schedule violations,
+      2 on configuration errors.
 
   python -m distributed_join_tpu.analysis.lint --rules-only [PATHS]
       Level 1 only (no jax import — milliseconds; PATHS default to
       the production tree).
 
+  python -m distributed_join_tpu.analysis.lint --contracts-only
+      Level 3 only: the statically-extracted wire-op tables, the
+      Prometheus/doc gauge parity, and the artifact-kind registry
+      (pure ast — no jax import, milliseconds).
+
   python -m distributed_join_tpu.analysis.lint --schedules-only
-      Level 2 only.
+      Level 2 only (the jaxpr tracing level).
 
   python -m distributed_join_tpu.analysis.lint --update-schedules
-      Re-trace the key programs and rewrite the goldens under
-      results/schedules/ (the baselines-style regen workflow: commit
-      the diff, review sees the schedule change). The unconditional
-      invariants (no callback in a telemetry-off program, no
-      cond-divergent collectives) still gate the regen.
+  python -m distributed_join_tpu.analysis.lint --update-contracts
+      Re-derive and rewrite the corresponding goldens (the
+      baselines-style regen workflow: commit the diff, review sees
+      the change). The unconditional invariants — no callback in a
+      telemetry-off program, no cond-divergent collectives, the
+      wire-table cross-checks and gauge parity — still gate a regen.
 
 The schedule half forces the 8-virtual-device CPU mesh before any jax
 backend initializes (``benchmarks.force_cpu_platform`` — the same
@@ -73,12 +81,23 @@ def parse_args(argv=None):
                     help="level 1 only: AST rules, no jax import")
     ap.add_argument("--schedules-only", action="store_true",
                     help="level 2 only: the jaxpr schedule check")
+    ap.add_argument("--contracts-only", action="store_true",
+                    help="level 3 only: the wire-protocol contract "
+                         "check (pure ast, no jax import)")
     ap.add_argument("--update-schedules", action="store_true",
                     help="re-trace the key programs and rewrite the "
                          "golden schedules (commit the diff)")
+    ap.add_argument("--update-contracts", action="store_true",
+                    help="re-extract the wire contract and rewrite "
+                         "results/contracts/wire_ops.json (commit "
+                         "the diff)")
     ap.add_argument("--schedule-dir", default=None,
                     help="golden schedule directory (default: "
                          "results/schedules under the root)")
+    ap.add_argument("--contract-path", default=None,
+                    help="wire-contract golden path (default: "
+                         "results/contracts/wire_ops.json under the "
+                         "root)")
     return ap.parse_args(argv)
 
 
@@ -114,6 +133,23 @@ def run_rules(args, root: str) -> int:
     return 1 if result.findings else 0
 
 
+def run_contracts(args, root: str) -> int:
+    from distributed_join_tpu.analysis.wirecheck import (
+        check_wire_contract,
+    )
+
+    path = args.contract_path or None
+    violations, contract = check_wire_contract(
+        root, path=path, update=args.update_contracts)
+    for v in violations:
+        print(f"joinlint contract: {v}")
+    verb = "updated" if args.update_contracts else "checked"
+    n_ops = len(contract["daemon_ops"])
+    print(f"joinlint contracts: {n_ops} daemon op(s) {verb}, "
+          f"{len(violations)} violation(s)")
+    return 1 if violations else 0
+
+
 def run_schedules(args, root: str) -> int:
     # Force the 8-virtual-device CPU mesh BEFORE any backend
     # initializes — the one blessed seam for that.
@@ -139,18 +175,34 @@ def run_schedules(args, root: str) -> int:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
-    if args.rules_only and (args.schedules_only
-                            or args.update_schedules):
-        print("joinlint: --rules-only excludes the schedule flags",
-              file=sys.stderr)
+    only = (args.rules_only, args.schedules_only, args.contracts_only)
+    if sum(map(bool, only)) > 1:
+        print("joinlint: choose at most one of --rules-only/"
+              "--schedules-only/--contracts-only", file=sys.stderr)
+        return 2
+    if args.rules_only and (args.update_schedules
+                            or args.update_contracts):
+        print("joinlint: --rules-only excludes the schedule and "
+              "contract flags", file=sys.stderr)
         return 2
     root = os.path.abspath(args.root) if args.root else repo_root()
+    update_mode = args.update_schedules or args.update_contracts
+    do_rules = not (args.schedules_only or args.contracts_only
+                    or update_mode)
+    do_contracts = (args.contracts_only or args.update_contracts
+                    or not (args.rules_only or args.schedules_only
+                            or args.update_schedules))
+    do_schedules = (args.schedules_only or args.update_schedules
+                    or not (args.rules_only or args.contracts_only
+                            or args.update_contracts))
     rc = 0
-    if not args.schedules_only and not args.update_schedules:
+    if do_rules:
         rc = run_rules(args, root)
         if rc == 2:
             return rc
-    if not args.rules_only:
+    if do_contracts:
+        rc = max(rc, run_contracts(args, root))
+    if do_schedules:
         rc = max(rc, run_schedules(args, root))
     return rc
 

@@ -26,12 +26,19 @@ import os
 import re
 from typing import List, Optional, Sequence
 
+from distributed_join_tpu.analysis import rules as _rules
+from distributed_join_tpu.analysis.concurrency import CONCURRENCY_RULES
 from distributed_join_tpu.analysis.rules import (
-    ALL_RULES,
     Finding,
     ParsedModule,
     annotate_parents,
 )
+
+# The full rule set: the SPMD/compiler-contract rules (DJL001-006)
+# plus the host-concurrency tier (DJL007-010). Combined here rather
+# than in rules.py so concurrency.py can import rules.py's AST
+# helpers without a cycle.
+ALL_RULES = tuple(_rules.ALL_RULES) + tuple(CONCURRENCY_RULES)
 
 # What `python -m distributed_join_tpu.analysis.lint` scans when no
 # explicit paths are given: the production tree. tests/ is excluded by

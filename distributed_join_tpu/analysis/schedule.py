@@ -2,10 +2,13 @@
 
 The AST rules see syntax; this module sees the TRUTH the compiler will
 schedule. Under the 8-virtual-device CPU mesh it traces the key
-compiled programs (the three shuffle modes, the join step with and
-without metrics, the skew path) with abstract inputs — trace only,
-never compiled or run — and extracts each jaxpr's ordered sequence of
-collective primitives. Three checks:
+compiled programs — the full program family: the three shuffle modes,
+the join step with and without metrics, the skew path, the typed
+joins (left/full_outer/anti), the segmented sort, the hierarchical
+2×4 mesh, aggregate pushdown in key and probe mode, the probe-only
+resident dispatch, and the Q3 multi-operator query plan — with
+abstract inputs (trace only, never compiled or run) and extracts each
+jaxpr's ordered sequence of collective primitives. Three checks:
 
 1. **golden schedule** — the sequence must equal the committed fixture
    in ``results/schedules/<program>.json``. Any reordering, any added
@@ -148,20 +151,43 @@ def cond_divergences(jaxpr) -> List[str]:
 # -- the key programs -------------------------------------------------
 
 
-def _abstract_tables():
+def _abstract_table(cols):
+    """An abstract (never-allocated) global Table: ``cols`` is
+    (name, dtype) pairs, every column ROWS long plus the bool valid
+    mask."""
     import jax
     import jax.numpy as jnp
 
     from distributed_join_tpu.table import Table
 
+    c = {name: jax.ShapeDtypeStruct((ROWS,), dt) for name, dt in cols}
+    return Table(c, jax.ShapeDtypeStruct((ROWS,), jnp.bool_))
+
+
+def _abstract_tables():
+    import jax.numpy as jnp
+
     def side(payload_name):
-        cols = {
-            "key": jax.ShapeDtypeStruct((ROWS,), jnp.int64),
-            payload_name: jax.ShapeDtypeStruct((ROWS,), jnp.int32),
-        }
-        return Table(cols, jax.ShapeDtypeStruct((ROWS,), jnp.bool_))
+        return _abstract_table((("key", jnp.int64),
+                                (payload_name, jnp.int32)))
 
     return side("build_payload"), side("probe_payload")
+
+
+def _abstract_tpch_q3_tables():
+    """Minimal abstract customer/orders/lineitem triple for the Q3
+    plan, matching utils/tpch.py's unified key names and dtypes
+    (int64 keys/prices, int32 dates)."""
+    import jax.numpy as jnp
+
+    customer = _abstract_table((("custkey", jnp.int64),
+                                ("c_acctbal", jnp.int64)))
+    orders = _abstract_table((("custkey", jnp.int64),
+                              ("orderkey", jnp.int64),
+                              ("o_orderdate", jnp.int32)))
+    lineitem = _abstract_table((("orderkey", jnp.int64),
+                                ("l_extendedprice", jnp.int64)))
+    return customer, orders, lineitem
 
 
 def key_programs(comm=None) -> Dict[str, dict]:
@@ -201,6 +227,90 @@ def key_programs(comm=None) -> Dict[str, dict]:
     progs["join_step_skew"] = {
         "fn": spmd(make_join_step(comm, skew_threshold=0.2, **payloads)),
         "args": args, "telemetry_off": True,
+    }
+    # The typed-join family (docs/JOIN_TYPES.md): same shuffle spine,
+    # different settle programs — left/full_outer emit the unmatched
+    # sides, anti emits only build rows with no probe match.
+    for join_type in ("left", "full_outer", "anti"):
+        # Anti emits probe rows only — a build payload cannot be
+        # honored and make_join_step refuses it loudly.
+        pl = (dict(probe_payload=["probe_payload"])
+              if join_type == "anti" else payloads)
+        progs[f"join_step_{join_type}"] = {
+            "fn": spmd(make_join_step(comm, join_type=join_type,
+                                      **pl)),
+            "args": args, "telemetry_off": True,
+        }
+    # Segmented local sort (docs/ROOFLINE.md §9): hash classes sorted
+    # per segment — the CI sort lane's sort_segments=8 configuration.
+    progs["join_step_segmented"] = {
+        "fn": spmd(make_join_step(comm, sort_mode="segmented",
+                                  sort_segments=8, **payloads)),
+        "args": args, "telemetry_off": True,
+    }
+    # Aggregate pushdown (docs/AGGREGATION.md), both fused settle
+    # paths: key mode (group == join key, co-located by the shuffle)
+    # and probe mode (probe-side group column, partials exchanged).
+    # No explicit payload kwargs: the spec resolves wire columns.
+    from distributed_join_tpu.ops.aggregate import AggregateSpec
+
+    agg_key = AggregateSpec.of(
+        "key", [("sum", "probe_payload", "probe_sum"),
+                ("count", None, "n_rows")])
+    progs["join_step_agg_key"] = {
+        "fn": spmd(make_join_step(comm, aggregate=agg_key)),
+        "args": args, "telemetry_off": True,
+    }
+    agg_probe = AggregateSpec.of(
+        "probe_payload", [("sum", "build_payload", "build_sum"),
+                          ("count", None, "n_rows")])
+    progs["join_step_agg_probe"] = {
+        "fn": spmd(make_join_step(comm, aggregate=agg_probe)),
+        "args": args, "telemetry_off": True,
+    }
+    # Probe-only dispatch against a resident build image
+    # (service/resident.py): the build side arrives pre-prepped
+    # (key-sorted valid-prefix, same columns), only the probe side
+    # shuffles.
+    from distributed_join_tpu.parallel.distributed_join import (
+        make_probe_join_step,
+    )
+
+    progs["probe_join_step"] = {
+        "fn": comm.spmd(
+            make_probe_join_step(comm,
+                                 build_payload=["build_payload"],
+                                 probe_payload=["probe_payload"]),
+            sharded_out=JOIN_SHARDED_OUT),
+        "args": args, "telemetry_off": True,
+    }
+    # Hierarchical 2×4 (slice, chip) mesh (docs/HIERARCHY.md): the
+    # same join step lowered over the two-axis communicator — the
+    # scale-out schedule the DCN seams route through.
+    from distributed_join_tpu.parallel.communicator import (
+        HierarchicalTpuCommunicator,
+    )
+
+    hier = HierarchicalTpuCommunicator(n_slices=2, n_ranks=N_RANKS)
+    progs["join_step_hier_2x4"] = {
+        "fn": hier.spmd(make_join_step(hier, shuffle="hierarchical",
+                                       **payloads),
+                        sharded_out=JOIN_SHARDED_OUT),
+        "args": args, "telemetry_off": True,
+    }
+    # The Q3 multi-operator query plan (docs/QUERY.md): two chained
+    # joins + the fused group-by as ONE compiled program.
+    from distributed_join_tpu.parallel.query_exec import (
+        make_query_step,
+        query_sharded_out,
+    )
+    from distributed_join_tpu.planning.query import tpch_query_plan
+
+    q3 = tpch_query_plan("q3")
+    progs["query_plan_q3"] = {
+        "fn": comm.spmd(make_query_step(comm, q3),
+                        sharded_out=query_sharded_out(q3)),
+        "args": _abstract_tpch_q3_tables(), "telemetry_off": True,
     }
     return progs
 

@@ -1415,6 +1415,48 @@ def check_file(path: str) -> list:
                         f"records[{i}] missing required "
                         "request_id/op/outcome keys")
         return problems
+    elif name.startswith("tuner") or doc.get("kind") == "tune":
+        # The autotuner's decision snapshot (planning/tuner.py
+        # summarize/`analyze tune`): per-signature recommendation
+        # derived from the workload history.
+        for key in ("schema_version", "kind", "history",
+                    "n_signatures", "signatures"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        if not isinstance(doc.get("signatures"), dict):
+            problems.append("signatures is not an object")
+        return problems
+    elif name == "router_lease.json" or \
+            doc.get("kind") == "router_lease":
+        # The HA router's fenced leadership lease (service/fleet.py
+        # RouterLease): owner + epoch + TTL; a standby adopts the
+        # directory only after winning this file.
+        for key in ("kind", "owner", "epoch", "ttl_s",
+                    "renewed_unix_s", "addr"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        return problems
+    elif doc.get("kind") == "queryplan_grade":
+        # `analyze queryplan` verdict: the committed queryplan golden
+        # re-priced and diffed (operators, orders, wire agreement).
+        for key in ("kind", "plan_digest", "n_operators", "total_s",
+                    "operators", "orders", "wire_match"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        if not isinstance(doc.get("operators"), list):
+            problems.append("operators is not a list")
+        return problems
+    elif doc.get("kind") == "stages_grade":
+        # `analyze stages` verdict: a stageprofile graded against the
+        # cost model's per-stage predictions.
+        for key in ("kind", "plan_digest", "shuffle", "n_ranks",
+                    "platform", "overflow", "stages",
+                    "sum_of_stages_s", "monolithic_wall_s"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        if not isinstance(doc.get("stages"), dict):
+            problems.append("stages is not an object")
+        return problems
     elif "signature" in doc:
         required = _BASELINE_REQUIRED
     else:

@@ -329,21 +329,28 @@ class TelemetrySink:
             if rank == self.rank or self._closed:
                 return
             old_events = self.events_path
+            old_log = self._log
             self.rank = rank
             self.events_path = os.path.join(
                 self.dir, f"events.rank{rank}.jsonl")
             self.trace_path = os.path.join(
                 self.dir, f"trace.rank{rank}.json")
-            self._log.close()
-            try:
-                os.replace(old_events, self.events_path)
-            except OSError:
-                # Shared output dir: another process may own the old
-                # name — start the ranked log fresh rather than steal.
-                pass
-            self._log = open(self.events_path, "a", buffering=1)
             for ev in self._trace_events:
                 ev["pid"] = rank
+        # The rename + reopen run UNLOCKED: rebind happens in the
+        # single-threaded bootstrap window (see above), and file I/O
+        # inside the region would stall every event writer behind one
+        # filesystem syscall (DJL008).
+        old_log.close()
+        try:
+            os.replace(old_events, self.events_path)
+        except OSError:
+            # Shared output dir: another process may own the old
+            # name — start the ranked log fresh rather than steal.
+            pass
+        log = open(self.events_path, "a", buffering=1)
+        with self._lock:
+            self._log = log
 
     # -- XLA device profile -------------------------------------------
 
@@ -397,10 +404,9 @@ class TelemetrySink:
         """Write the Chrome trace (+ rank-0 summary.json), close the
         log; returns the final summary. Idempotent."""
         self._stop_xla_trace()
+        trace = None
         with self._lock:
-            if self._closed:
-                pass
-            else:
+            if not self._closed:
                 self._closed = True
                 trace = {
                     "displayTimeUnit": "ms",
@@ -412,11 +418,16 @@ class TelemetrySink:
                     },
                     "traceEvents": self._trace_events,
                 }
-                tmp = self.trace_path + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump(trace, f, default=_json_default)
-                os.replace(tmp, self.trace_path)
                 self._log.close()
+        if trace is not None:
+            # Dumped UNLOCKED: once _closed is set every writer (and
+            # rebind_rank) bails, so trace_path/_trace_events are
+            # frozen, and the json.dump of a large trace must not
+            # stall summary() callers contending on the lock (DJL008).
+            tmp = self.trace_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(trace, f, default=_json_default)
+            os.replace(tmp, self.trace_path)
         s = self.summary()
         if self.rank == 0:
             tmp = os.path.join(self.dir, "summary.json.tmp")

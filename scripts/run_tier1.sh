@@ -14,9 +14,14 @@
 #   scripts/run_tier1.sh perfgate   # deterministic CPU-mesh join vs.
 #                                   # the committed counter-signature
 #                                   # baseline + artifact schema check
-#   scripts/run_tier1.sh lint       # joinlint: AST SPMD-hazard rules
-#                                   # + jaxpr collective-schedule check
-#                                   # vs results/schedules/ goldens
+#                                   # + wire-contract drift gate
+#   scripts/run_tier1.sh lint       # joinlint, all three checkers:
+#                                   # AST SPMD-hazard + concurrency
+#                                   # rules (DJL001-010), wire-protocol
+#                                   # contract vs results/contracts/
+#                                   # wire_ops.json, jaxpr collective-
+#                                   # schedule check vs
+#                                   # results/schedules/ goldens
 #   scripts/run_tier1.sh chaos      # fixed-seed ~20-trial chaos soak
 #                                   # (faults x configs, pandas-oracle
 #                                   # verified, wire digests on) +
@@ -260,6 +265,12 @@ case "$lane" in
     set -e
     tmp="$(mktemp -d /tmp/djtpu_perfgate.XXXXXX)"
     trap 'rm -rf "$tmp"' EXIT
+    # Wire-protocol contract drift gates perf too (a routing or
+    # resend-policy change moves counters): the static wire_ops.json
+    # check first — pure ast, milliseconds, fails fast
+    # (docs/STATIC_ANALYSIS.md "Level 3").
+    timeout -k 10 60 env JAX_PLATFORMS=cpu \
+      python -m distributed_join_tpu.analysis.lint --contracts-only
     timeout -k 10 600 env JAX_PLATFORMS=cpu \
       JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
       python -m distributed_join_tpu.benchmarks.distributed_join \
@@ -593,10 +604,15 @@ PY
     exit $?
     ;;
   lint)
-    # Static analysis (docs/STATIC_ANALYSIS.md): level-1 AST rules
+    # Static analysis (docs/STATIC_ANALYSIS.md), all three checkers:
+    # level-1 AST rules DJL001-010 (SPMD hazards + concurrency lint)
     # over the production tree (exit nonzero on any finding not in
-    # the committed suppressions) + level-2 jaxpr collective-schedule
-    # check against results/schedules/ (re-baseline intentional
+    # the committed suppressions), level-3 wire-protocol contract
+    # check against results/contracts/wire_ops.json (op-table
+    # cross-checks, Prometheus/doc gauge parity, artifact-kind
+    # registry; re-baseline with `analysis.lint --update-contracts`),
+    # and level-2 jaxpr collective-schedule check of all 14 program
+    # families against results/schedules/ (re-baseline intentional
     # schedule changes with `analysis.lint --update-schedules`).
     # DJTPU_VALIDATE_PLANS is cleared: the gate checks the SHIPPING
     # trace, and the debug seam's callback would (correctly) fail the

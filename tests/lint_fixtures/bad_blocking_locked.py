@@ -1,0 +1,23 @@
+"""Known-bad fixture: DJL008 blocking-while-locked.
+
+The admission-slot-releases-before-file-I/O class of bug: a socket
+accept and a file write inside a held-lock region stall every other
+thread contending on the lock.
+"""
+
+import threading
+
+
+class Server:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self.sock = sock
+        self.served = 0
+
+    def serve_one(self, path):
+        with self._lock:
+            conn, _ = self.sock.accept()
+            with open(path, "a") as f:
+                f.write("served\n")
+            self.served += 1
+        return conn

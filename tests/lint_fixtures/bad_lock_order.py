@@ -1,0 +1,27 @@
+"""Known-bad fixture: DJL007 lock-order-inversion.
+
+Two methods of the same class take the same pair of locks in
+opposite orders — the classic ABBA deadlock.
+"""
+
+import threading
+
+
+class Exchange:
+    def __init__(self):
+        self._book = threading.Lock()
+        self._audit = threading.Lock()
+        self.trades = []
+        self.log = []
+
+    def trade(self, order):
+        with self._book:
+            self.trades.append(order)
+            with self._audit:
+                self.log.append(order)
+
+    def audit(self):
+        with self._audit:
+            snapshot = list(self.log)
+            with self._book:
+                return snapshot, list(self.trades)

@@ -1,0 +1,25 @@
+"""Known-bad fixture: DJL010 lock-release-discipline.
+
+A bare acquire() whose release() is not protected by a finally — an
+exception in the critical section leaks the lock forever — and an
+os._exit() issued while a tracked lock is held.
+"""
+
+import os
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        self._lock.acquire()
+        self.value += 1
+        self._lock.release()
+
+    def die(self, code):
+        with self._lock:
+            self.value = -1
+            os._exit(code)

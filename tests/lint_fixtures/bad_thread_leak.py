@@ -1,0 +1,19 @@
+"""Known-bad fixture: DJL009 thread-leak.
+
+A non-daemon thread is started and its handle is dropped on the
+floor — no join() anywhere, so shutdown can never settle it and the
+interpreter hangs at exit.
+"""
+
+import threading
+
+
+def poll(state):
+    while state["running"]:
+        state["ticks"] = state.get("ticks", 0) + 1
+
+
+def start_poller(state):
+    t = threading.Thread(target=poll, args=(state,))
+    t.start()
+    return None

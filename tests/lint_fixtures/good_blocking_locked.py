@@ -1,0 +1,20 @@
+"""Known-good twin of bad_blocking_locked: the blocking work happens
+outside the region; the lock only guards the in-memory counter.
+"""
+
+import threading
+
+
+class Server:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self.sock = sock
+        self.served = 0
+
+    def serve_one(self, path):
+        conn, _ = self.sock.accept()
+        with open(path, "a") as f:
+            f.write("served\n")
+        with self._lock:
+            self.served += 1
+        return conn

@@ -1,0 +1,25 @@
+"""Known-good twin of bad_lock_order: same lock pair, one global
+order (book before audit) on every path — acyclic graph, no finding.
+"""
+
+import threading
+
+
+class Exchange:
+    def __init__(self):
+        self._book = threading.Lock()
+        self._audit = threading.Lock()
+        self.trades = []
+        self.log = []
+
+    def trade(self, order):
+        with self._book:
+            self.trades.append(order)
+            with self._audit:
+                self.log.append(order)
+
+    def audit(self):
+        with self._book:
+            trades = list(self.trades)
+            with self._audit:
+                return list(self.log), trades

@@ -1,0 +1,35 @@
+"""Known-good twin of bad_lock_release: the release lives in a
+finally, the timed acquire releases on its success path, and the
+hard exit happens after the region closes.
+"""
+
+import os
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        self._lock.acquire()
+        try:
+            self.value += 1
+        finally:
+            self._lock.release()
+
+    def try_bump(self, timeout):
+        got = self._lock.acquire(timeout=timeout)
+        if not got:
+            return False
+        try:
+            self.value += 1
+        finally:
+            self._lock.release()
+        return True
+
+    def die(self, code):
+        with self._lock:
+            self.value = -1
+        os._exit(code)

@@ -1,0 +1,43 @@
+"""Known-good twin of bad_thread_leak: every start() is paired with
+a join() (local handle, attribute handle, and list-of-workers), and
+the fire-and-forget helper is daemon=True.
+"""
+
+import threading
+
+
+def poll(state):
+    while state["running"]:
+        state["ticks"] = state.get("ticks", 0) + 1
+
+
+def run_poller(state):
+    t = threading.Thread(target=poll, args=(state,))
+    t.start()
+    state["running"] = False
+    t.join()
+
+
+def start_daemon_poller(state):
+    threading.Thread(target=poll, args=(state,), daemon=True).start()
+
+
+class Pool:
+    def __init__(self, state, n):
+        self.state = state
+        self.watcher = threading.Thread(target=poll, args=(state,))
+        self.workers = []
+        for _ in range(n):
+            self.workers.append(
+                threading.Thread(target=poll, args=(state,)))
+
+    def start(self):
+        self.watcher.start()
+        for w in self.workers:
+            w.start()
+
+    def stop(self):
+        self.state["running"] = False
+        self.watcher.join()
+        for w in self.workers:
+            w.join()

@@ -11,7 +11,13 @@ Three layers, mirroring how the tool is used:
    match a fresh trace, a tampered golden fails loudly, and a host
    callback appearing in a telemetry-off program (exactly what
    ``faults.validate_plans`` weaves in) fails the unconditional
-   invariant even against a freshly-regenerated golden.
+   invariant even against a freshly-regenerated golden;
+4. wire-protocol contract — the committed results/contracts/
+   wire_ops.json matches a fresh static extraction, a perturbed
+   golden fails loudly, --update-contracts round-trips
+   byte-identically, and every ``kind:``-stamped artifact writer has
+   a matching validator in telemetry/analyze.py (the artifact-kind
+   registry).
 
 Marker: ``lint`` (the ``lint`` lane of scripts/run_tier1.sh runs the
 CLI; tier-1 runs this suite).
@@ -43,7 +49,12 @@ SCHEDULE_DIR = os.path.join(REPO, "results", "schedules")
 PROGRAMS = {
     "join_step_padded", "join_step_ragged", "join_step_ppermute",
     "join_step_metrics", "join_step_skew",
+    "join_step_left", "join_step_full_outer", "join_step_anti",
+    "join_step_segmented", "join_step_agg_key", "join_step_agg_probe",
+    "probe_join_step", "join_step_hier_2x4", "query_plan_q3",
 }
+CONTRACT_PATH = os.path.join(REPO, "results", "contracts",
+                             "wire_ops.json")
 
 
 def lint_fixture(name):
@@ -61,6 +72,10 @@ def lint_fixture(name):
     ("bad_recompile.py", "DJL004"),
     ("bad_tape_parity.py", "DJL005"),
     ("bad_unused_import.py", "DJL006"),
+    ("bad_lock_order.py", "DJL007"),
+    ("bad_blocking_locked.py", "DJL008"),
+    ("bad_thread_leak.py", "DJL009"),
+    ("bad_lock_release.py", "DJL010"),
 ])
 def test_known_bad_fixture_flags_its_rule(fixture, rule):
     findings = lint_fixture(fixture)
@@ -72,8 +87,15 @@ def test_known_bad_fixture_flags_its_rule(fixture, rule):
     )
 
 
-def test_known_good_fixture_is_clean():
-    findings = lint_fixture("good_clean.py")
+@pytest.mark.parametrize("fixture", [
+    "good_clean.py",
+    "good_lock_order.py",
+    "good_blocking_locked.py",
+    "good_thread_leak.py",
+    "good_lock_release.py",
+])
+def test_known_good_fixture_is_clean(fixture):
+    findings = lint_fixture(fixture)
     assert findings == [], "; ".join(f.format() for f in findings)
 
 
@@ -289,6 +311,132 @@ def test_update_roundtrip_reproduces_committed(traced_schedules,
         committed = open(
             os.path.join(SCHEDULE_DIR, f"{name}.json")).read()
         assert fresh == committed, f"{name} golden is stale"
+
+
+# -- level 3: the wire-protocol contract checker ----------------------
+
+
+def test_committed_wire_contract_matches_fresh_extraction():
+    """THE wire-contract gate: a fresh static extraction of the op
+    tables, gauge sets, and artifact-kind registry reproduces the
+    committed golden with zero violations."""
+    from distributed_join_tpu.analysis.wirecheck import (
+        check_wire_contract,
+    )
+
+    violations, contract = check_wire_contract(REPO)
+    assert violations == [], "\n".join(violations)
+    assert len(contract["daemon_ops"]) >= 10
+
+
+def test_perturbed_wire_golden_fails(tmp_path):
+    from distributed_join_tpu.analysis.wirecheck import (
+        check_wire_contract,
+    )
+
+    golden = json.load(open(CONTRACT_PATH))
+    golden["daemon_ops"] = [o for o in golden["daemon_ops"]
+                            if o != "join"]
+    golden["resendable_ops"] = [o for o in golden["resendable_ops"]
+                                if o != "join"]
+    path = tmp_path / "wire_ops.json"
+    path.write_text(json.dumps(golden))
+    violations, _ = check_wire_contract(REPO, path=str(path))
+    assert any("daemon_ops" in v and "join" in v
+               for v in violations), violations
+
+
+def test_missing_wire_golden_fails(tmp_path):
+    from distributed_join_tpu.analysis.wirecheck import (
+        check_wire_contract,
+    )
+
+    violations, _ = check_wire_contract(
+        REPO, path=str(tmp_path / "nope.json"))
+    assert any("no committed" in v or "missing" in v
+               for v in violations), violations
+
+
+def test_update_contract_roundtrip_reproduces_committed(tmp_path):
+    """--update-contracts is deterministic AND the committed golden is
+    current: a fresh regen reproduces it byte-identically."""
+    from distributed_join_tpu.analysis.wirecheck import (
+        extract_wire_contract,
+        write_contract,
+    )
+
+    path = str(tmp_path / "wire_ops.json")
+    write_contract(extract_wire_contract(REPO), path)
+    assert open(path).read() == open(CONTRACT_PATH).read(), (
+        "wire_ops.json golden is stale — rerun "
+        "python -m distributed_join_tpu.analysis.lint "
+        "--update-contracts")
+
+
+def test_wire_op_cross_checks_hold():
+    """The mutual-consistency obligations, asserted directly on a
+    fresh extraction (not just via the golden diff)."""
+    from distributed_join_tpu.analysis import wirecheck as W
+
+    daemon = W.daemon_ops(REPO)
+    assert W.resendable_ops(REPO) <= daemon
+    assert W.router_ops(REPO) <= daemon
+    assert W.fanout_ops(REPO) <= daemon
+    assert W.affinity_ops(REPO) <= daemon
+    # fan-out ops mutate every replica; a blind router resend would
+    # double-apply them
+    assert not (W.fanout_ops(REPO) & W.resendable_ops(REPO))
+    assert W.advertised_ops(REPO) == daemon
+    classes, families = W.fault_classification(REPO)
+    assert classes <= W.defined_error_classes(REPO)
+    assert families  # the router actually classifies faults
+
+
+def test_prometheus_gauges_match_docs():
+    """Every djtpu_* series the code emits is documented in
+    docs/OBSERVABILITY.md, and the doc names no phantom series."""
+    from distributed_join_tpu.analysis import wirecheck as W
+
+    emitted = W.emitted_gauges(REPO)
+    documented = W.documented_gauges(REPO)
+    assert emitted, "gauge extraction found nothing"
+    assert emitted - documented == set(), sorted(emitted - documented)
+    assert documented - emitted == set(), sorted(documented - emitted)
+
+
+def test_artifact_kind_registry_is_closed():
+    """Every ``kind:``-stamped artifact writer has a validator branch
+    in telemetry/analyze.py — new result schemas cannot land without
+    a check reading them back."""
+    from distributed_join_tpu.analysis import wirecheck as W
+
+    writers = W.artifact_writer_kinds(REPO)
+    validators = W.artifact_validator_kinds(REPO)
+    assert writers, "writer extraction found nothing"
+    assert writers <= validators, sorted(writers - validators)
+
+
+def test_cli_contracts_only_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    rc = subprocess.run(
+        [sys.executable, "-m", "distributed_join_tpu.analysis.lint",
+         "--contracts-only"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    assert rc.returncode == 0, rc.stdout + rc.stderr
+    assert "joinlint contracts:" in rc.stdout
+    # a drifted golden exits 1 and names the drift
+    golden = json.load(open(CONTRACT_PATH))
+    golden["router_ops"] = golden["router_ops"][:-1]
+    path = tmp_path / "wire_ops.json"
+    path.write_text(json.dumps(golden))
+    rc = subprocess.run(
+        [sys.executable, "-m", "distributed_join_tpu.analysis.lint",
+         "--contracts-only", "--contract-path", str(path)],
+        cwd=REPO, env=env, capture_output=True, text=True,
+    )
+    assert rc.returncode == 1, rc.stdout + rc.stderr
+    assert "router_ops" in rc.stdout
 
 
 def test_callback_in_telemetry_off_program_fails():
