@@ -1116,6 +1116,306 @@ def fleet_slice(seed: int, trials: int, *, replica_ranks: int = 2,
     }
 
 
+# -- the multi-tenant fleet slice -------------------------------------
+
+
+def fleet_tenant_slice(seed: int, trials: int, *,
+                       replica_ranks: int = 2,
+                       repro_out: Optional[str] = None) -> dict:
+    """The ``--tenants`` soak (docs/FLEET.md "Multi-tenancy &
+    autoscaling"): a 2-replica subprocess fleet with two configured
+    tenants — ``noisy`` (QPS-quota'd, priority 1) FLOODS at ~5x its
+    quota while ``quiet`` (no quota, priority 2) runs oracle-graded
+    joins; one replica is SIGKILLed at the midpoint trial.
+
+    Gates (the ISSUE 20 acceptance bar):
+
+    - the quiet tenant's every answer grades pandas-oracle-EXACT and
+      its shed count is ZERO (router-side and response-side): the
+      noisy tenant's flood is shed, never the quiet tenant;
+    - the noisy tenant IS shed — structured ``QuotaExceededError`` /
+      ``ShedError`` refusals naming the bound, with the router's
+      per-tenant shed counters agreeing;
+    - tenant isolation in the tuner namespace: every router history
+      entry carries its sender's tenant stamp and the trend table
+      keys stay ``tenant/signature``-namespaced — the noisy flood
+      never moves the quiet tenant's knobs;
+    - the killed replica is drained and REPLACED, and the
+      replacement serves a pre-kill quiet signature with ZERO new
+      traces (the shared persist dir is the distribution tier — the
+      same warm contract the autoscaler's rotation gate enforces);
+      the router's ``fleet_autoscale`` record stays well-formed with
+      the control loop live the whole soak.
+    """
+    import tempfile
+
+    from distributed_join_tpu.service import fleet as fleet_mod
+    from distributed_join_tpu.service.server import (
+        ServiceClient,
+        _tables_from_spec,
+    )
+    from distributed_join_tpu.telemetry import history as tel_history
+
+    noisy_qps = 2.0
+    flood_per_trial = 10  # ~5x the one-second bucket capacity
+    workdir = tempfile.mkdtemp(prefix="djtpu_tenant_soak_")
+    cfg = fleet_mod.FleetConfig(
+        n_replicas=2,
+        replica_ranks=replica_ranks,
+        persist_dir=os.path.join(workdir, "programs"),
+        history_dir=os.path.join(workdir, "history"),
+        probe_interval_s=0.5,
+        suspect_strikes=2,
+        retry_budget=2,
+        request_deadline_s=120.0,
+        tenants={
+            "noisy": {"qps": noisy_qps, "burst_s": 1.0,
+                      "priority": 1},
+            "quiet": {"priority": 2},
+        },
+        # The control loop runs the whole soak (its record must stay
+        # well-formed under fault); the up bound is out of reach so
+        # the scripted kill's respawn is the one lifecycle event.
+        autoscale=True,
+        autoscale_up_qps=1e9,
+        autoscale_interval_s=0.5,
+    )
+    overrides: dict = {
+        i: {"extra_args": ["--flight-recorder-path",
+                           os.path.join(workdir,
+                                        f"replica{i}_fr.json")]}
+        for i in (0, 1)
+    }
+    kill_at = trials // 2
+    victim = fleet_mod.affine_replica(
+        _fleet_trial_spec(seed, kill_at), replica_ranks, 2)
+    router = fleet_mod.FleetRouter(
+        fleet_mod.process_fleet_factory(
+            cfg, platform="cpu", replica_overrides=overrides), cfg)
+    router.start()
+    server, port = fleet_mod.start_router_daemon(router)
+    client = ServiceClient("127.0.0.1", port)
+
+    records, failures = [], []
+    noisy_counts = {"sent": 0, "ok": 0, "quota_shed": 0,
+                    "priority_shed": 0, "excused": 0, "other": 0}
+    quiet_shed_responses = 0
+    pre_kill_spec = None
+    killed = False
+
+    def send(spec):
+        try:
+            return client.send(spec)
+        except (OSError, ValueError) as exc:
+            return {"ok": False, "error": "RouterLost",
+                    "message": f"{type(exc).__name__}: {exc}"}
+
+    try:
+        for k in range(trials):
+            spec = _fleet_trial_spec(seed, k)
+            build, probe = _tables_from_spec(spec)
+            expected = len(_oracle_frame(build, probe))
+            if k == kill_at:
+                router.replicas[victim].backend.kill()
+                killed = True
+            # The noisy flood rides FIRST each round: back-to-back
+            # sends far over the bucket — the quiet trial right
+            # after must be untouched by it.
+            for j in range(flood_per_trial):
+                nresp = send({**_fleet_trial_spec(seed, k),
+                              "tenant": "noisy",
+                              "request_id":
+                                  f"noisy-{seed}-{k}-{j}"})
+                noisy_counts["sent"] += 1
+                if nresp.get("ok"):
+                    noisy_counts["ok"] += 1
+                elif nresp.get("error") == "QuotaExceededError":
+                    noisy_counts["quota_shed"] += 1
+                elif nresp.get("error") == "ShedError":
+                    noisy_counts["priority_shed"] += 1
+                elif killed and nresp.get("error") in (
+                        "FleetError", "AdmissionError"):
+                    # An ADMITTED noisy request can land on the dead
+                    # backend before the prober drains it — that is
+                    # the scripted kill, not a quota bug.
+                    noisy_counts["excused"] += 1
+                else:
+                    noisy_counts["other"] += 1
+                    failures.append({"gate": "noisy_outcome",
+                                     "trial": k, "flood": j,
+                                     "error": nresp.get("error"),
+                                     "message":
+                                         nresp.get("message")})
+            t0 = time.perf_counter()
+            resp = send({**spec, "tenant": "quiet",
+                         "request_id": f"quiet-{seed}-{k}"})
+            if resp.get("shed"):
+                quiet_shed_responses += 1
+            got = resp.get("matches")
+            failovers = (resp.get("fleet") or {}).get("failovers",
+                                                      0)
+            if resp.get("ok") and got == expected:
+                verdict = "recovered" if failovers else "ok"
+            elif resp.get("ok"):
+                verdict = "FAILED:wrong_result"
+            else:
+                verdict = "FAILED:refused"
+            rec = {"trial": k, "spec": spec, "verdict": verdict,
+                   "expected_total": expected, "got_total": got,
+                   "retries": failovers,
+                   "error": (None if resp.get("ok") else
+                             f"{resp.get('error')}: "
+                             f"{resp.get('message')}"),
+                   "elapsed_s": round(time.perf_counter() - t0, 3)}
+            records.append(rec)
+            print(f"tenant trial {k:3d} -> {verdict} "
+                  f"({rec['elapsed_s']}s)", flush=True)
+            if verdict.startswith("FAILED"):
+                failures.append(rec)
+                if repro_out:
+                    path = f"{repro_out}_tenant_{seed}_{k}.json"
+                    with open(path, "w") as f:
+                        json.dump({**rec, "harness_seed": seed,
+                                   "replay": "python -m distributed"
+                                   "_join_tpu.parallel.chaos "
+                                   f"--tenants {trials} --seed "
+                                   f"{seed}"}, f, indent=2)
+                    print(f"  repro written: {path}", flush=True)
+            if not verdict.startswith("FAILED") \
+                    and k < kill_at:
+                pre_kill_spec = dict(spec)
+
+        st = router.stats()
+        tenants_st = st.get("tenants") or {}
+        quiet_st = tenants_st.get("quiet") or {}
+        noisy_st = tenants_st.get("noisy") or {}
+        # Gate: the quiet tenant was NEVER shed — zero shed answers
+        # on the wire AND a zero router-side shed counter.
+        if quiet_shed_responses \
+                or (quiet_st.get("shed") or 0) != 0:
+            failures.append({
+                "gate": "quiet_never_shed",
+                "shed_responses": quiet_shed_responses,
+                "router_shed": quiet_st.get("shed")})
+        # Gate: the noisy tenant WAS shed, with the router's counter
+        # agreeing that sheds happened.
+        if noisy_counts["quota_shed"] == 0 \
+                or (noisy_st.get("shed") or 0) == 0:
+            failures.append({
+                "gate": "noisy_shed",
+                "counts": dict(noisy_counts),
+                "router_shed": noisy_st.get("shed")})
+        # Gate: tenant isolation in the tuner namespace — every
+        # history entry stamped with its sender's tenant, every
+        # trend key tenant/signature-namespaced.
+        entries, _ = tel_history.load_history(
+            cfg.history_dir)
+        request_entries = [e for e in entries
+                           if e.get("kind") == "request"]
+        unstamped = [e for e in request_entries
+                     if e.get("tenant") not in ("noisy", "quiet")]
+        trend_keys = list(tel_history.trends_of(request_entries))
+        bare = [key for key in trend_keys if "/" not in key]
+        if unstamped or bare:
+            failures.append({
+                "gate": "tenant_namespace",
+                "unstamped_entries": len(unstamped),
+                "bare_trend_keys": bare})
+        # Gate: drain + replace + the warm contract on the
+        # replacement (the autoscaler's own rotation gate).
+        rep = router.replicas[victim]
+        replaced = router.wait_replaced(
+            victim, timeout_s=cfg.spawn_timeout_s)
+        drain_replace = {"required": True,
+                         "drained": rep.drained_at is not None,
+                         "replaced": replaced,
+                         "generation": rep.generation}
+        post_replacement_new_traces = None
+        if not replaced:
+            failures.append({"gate": "drain_replace",
+                             **drain_replace})
+        elif pre_kill_spec is not None:
+            try:
+                direct = ServiceClient(*rep.addr(),
+                                       timeout_s=120.0)
+                try:
+                    replay = direct.send(
+                        {**pre_kill_spec, "tenant": "quiet"})
+                finally:
+                    direct.close()
+            except (OSError, ValueError) as exc:
+                replay = {"ok": False, "error": "RouterLost",
+                          "message":
+                              f"{type(exc).__name__}: {exc}"}
+            post_replacement_new_traces = replay.get("new_traces")
+            if not replay.get("ok") \
+                    or replay.get("new_traces") != 0:
+                failures.append({
+                    "gate": "post_replacement_warm",
+                    "response": {kk: replay.get(kk) for kk in
+                                 ("ok", "error", "message",
+                                  "new_traces", "matches")}})
+        autoscale = router.autoscale_record()
+        from distributed_join_tpu.telemetry.analyze import (
+            check_file,
+        )
+
+        as_path = os.path.join(workdir, "fleet_autoscale.json")
+        with open(as_path, "w") as f:
+            json.dump(autoscale, f, indent=2)
+        as_problems = check_file(as_path)
+        if as_problems:
+            failures.append({"gate": "autoscale_record",
+                             "problems": as_problems})
+    finally:
+        client.close()
+        server.shutdown()
+        server.server_close()
+        router.stop()
+
+    verdicts: dict = {}
+    for rec in records:
+        verdicts[rec["verdict"]] = verdicts.get(rec["verdict"],
+                                                0) + 1
+    if failures:
+        print(f"tenant soak artifacts kept at {workdir}",
+              flush=True)
+    else:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {
+        "kind": "fleet_tenant_soak",
+        "schema_version": 1,
+        "harness_seed": seed,
+        "slice": "tenants",
+        "victim": victim,
+        "replica_ranks": replica_ranks,
+        "trials": len(records),
+        "verdicts": verdicts,
+        "noisy": {"quota_qps": noisy_qps,
+                  "flood_per_trial": flood_per_trial,
+                  **noisy_counts,
+                  "router_shed": noisy_st.get("shed"),
+                  "quota_sheds": noisy_st.get("quota_sheds"),
+                  "priority_sheds": noisy_st.get("priority_sheds")},
+        "quiet": {"trials": len(records),
+                  "shed_responses": quiet_shed_responses,
+                  "router_shed": quiet_st.get("shed") or 0},
+        "failures": len(failures),
+        "failure_records": failures,
+        "drain_replace": drain_replace,
+        "post_replacement_new_traces": post_replacement_new_traces,
+        "autoscale": {"enabled": autoscale.get("enabled"),
+                      "spawns_total":
+                          autoscale.get("spawns_total"),
+                      "drains_total":
+                          autoscale.get("drains_total")},
+        "fleet_stats": st,
+        "records": records,
+    }
+
+
 # -- the resident-kill fleet slice ------------------------------------
 
 
@@ -1488,6 +1788,17 @@ def parse_args(argv=None):
                         "fenced zero-trace replay gated)")
     p.add_argument("--replica-ranks", type=int, default=2,
                    help="mesh size of each fleet replica")
+    p.add_argument("--tenants", type=int, default=None, metavar="N",
+                   help="instead of the main soak: N oracle-graded "
+                        "quiet-tenant trials through a 2-replica "
+                        "fleet while a noisy tenant floods at ~5x "
+                        "its QPS quota and one replica is killed "
+                        "mid-soak — the quiet tenant must stay "
+                        "exact with ZERO sheds, the noisy tenant "
+                        "must be quota-shed, the tuner namespace "
+                        "must stay tenant-isolated, and the "
+                        "replacement must serve warm (docs/FLEET.md "
+                        "\"Multi-tenancy & autoscaling\")")
     p.add_argument("--tuner-slice", type=int, default=None,
                    metavar="N",
                    help="instead of the main soak: N poisoned-history "
@@ -1525,7 +1836,12 @@ def main(argv=None) -> int:
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       0.5)
 
-    if args.fleet and args.fleet_fault == "resident-kill":
+    if args.tenants:
+        summary = fleet_tenant_slice(
+            args.seed, args.tenants,
+            replica_ranks=args.replica_ranks,
+            repro_out=args.repro_out)
+    elif args.fleet and args.fleet_fault == "resident-kill":
         summary = fleet_resident_slice(
             args.seed, args.fleet,
             replica_ranks=args.replica_ranks,

@@ -215,6 +215,12 @@ class JoinTuner:
         self.observed = 0
         self.recommendations = 0
         self.history_hits = 0
+        # The serving layer's per-request tenant (set under the exec
+        # lock right before dispatch): recommend() consults it when no
+        # explicit tenant rides the call, so the deep call sites
+        # inside distributed_inner_join need no signature change.
+        # None = default tenant = the bare-signature lookup.
+        self.active_tenant: Optional[str] = None
         if history:
             self.load(history)
 
@@ -242,9 +248,15 @@ class JoinTuner:
         corrected for the very next request."""
         from distributed_join_tpu.telemetry.history import (
             SignatureTrend,
+            tenant_key,
         )
 
-        sig = entry.get("signature") or "?"
+        # Tenant-NAMESPACED key (docs/FLEET.md "Multi-tenancy"): a
+        # non-default tenant's entries land under tenant/signature, so
+        # its skewed or poisoned history can never pre-size another
+        # tenant's programs. Un-stamped (default-tenant) entries keep
+        # the bare signature — the exact pre-tenancy table.
+        sig = tenant_key(entry.get("signature"), entry.get("tenant"))
         self._trends.setdefault(sig, SignatureTrend()).add(entry)
         self.observed += 1
 
@@ -261,8 +273,8 @@ class JoinTuner:
     # -- the read side (the decision) ----------------------------------
 
     def recommend(self, signature: str, user_opts: Optional[dict] = None,
-                  *, side_geometry: Optional[dict] = None
-                  ) -> TunedConfig:
+                  *, side_geometry: Optional[dict] = None,
+                  tenant: Optional[str] = None) -> TunedConfig:
         """The knob verdict for one workload signature.
 
         ``user_opts`` is the caller's raw option dict (structural
@@ -290,10 +302,17 @@ class JoinTuner:
            to the exact-size ragged wire when the caller didn't
            choose a mode and compression is off.
         """
+        from distributed_join_tpu.telemetry.history import tenant_key
+
         user_opts = user_opts or {}
         self.recommendations += 1
         cfg = TunedConfig(signature=signature)
-        trend = self._trends.get(signature)
+        # Read from the caller's OWN tenant namespace only (None /
+        # default tenant = the bare-signature table, the pre-tenancy
+        # lookup exactly).
+        if tenant is None:
+            tenant = self.active_tenant
+        trend = self._trends.get(tenant_key(signature, tenant))
         if trend is None or trend.entries < self.min_entries:
             cfg.basis["note"] = (
                 f"no history for signature ({trend.entries if trend else 0}"

@@ -104,6 +104,7 @@ import subprocess
 import sys
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, Optional
 
 from distributed_join_tpu import telemetry
@@ -132,6 +133,24 @@ class NoHolderError(FleetError):
     table (replication on): answered as a structured refusal — never
     silently misrouted to a replica that would invent an 'unknown
     table' answer for state the fleet actually owns."""
+
+
+class QuotaExceededError(FleetError):
+    """A tenant crossed one of ITS OWN admission bounds (the
+    ``tenants`` config map: QPS token bucket, per-tenant inflight
+    cap, or per-tenant ``shed_p95_s``): answered as a structured
+    ``shed`` refusal NAMING the bound — the over-quota tenant is shed
+    while within-quota tenants keep being served
+    (docs/FLEET.md "Multi-tenancy & autoscaling")."""
+
+
+class ShedError(FleetError):
+    """Priority-weighted overload shed: under fleet-wide pressure a
+    LOW-PRIORITY tenant's per-replica inflight headroom (its
+    priority's share of ``max_inflight_per_replica``) ran out while
+    higher-priority traffic still fits — the low-priority request is
+    shed FIRST, with a structured refusal naming the priority bound,
+    so the quiet high-priority tenant is never the one refused."""
 
 
 # Durable-state artifact versions (docs/FAILURE_SEMANTICS.md,
@@ -195,6 +214,35 @@ class FleetConfig:
     lease_ttl_s: float = 3.0
     lease_renew_s: float = 0.5
     router_id: Optional[str] = None
+    # Multi-tenant admission (docs/FLEET.md "Multi-tenancy &
+    # autoscaling"): ``tenants`` maps a tenant name to its bounds —
+    # ``{"qps": float, "burst_s": float, "max_inflight": int,
+    # "priority": int, "shed_p95_s": float}`` (every key optional).
+    # Unconfigured tenants (including the implicit default tenant of
+    # unstamped requests) keep the exact pre-tenant behavior: no
+    # quota, full priority. A configured ``shed_p95_s`` is the
+    # per-tenant replacement for the global knob above: the tenant is
+    # shed when even the BEST live replica's probed p95 exceeds it.
+    tenants: Optional[dict] = None
+    # Signature-level autoscaler: a router-side control loop over the
+    # probed per-replica LiveMetrics. Sustained (``autoscale_sustain``
+    # consecutive ticks, ``autoscale_interval_s`` apart) fleet QPS
+    # over ``autoscale_up_qps`` or worst probed p95 over
+    # ``autoscale_up_p95_s`` spawns ONE replica (up to
+    # ``autoscale_max_replicas``), pre-warm verified against the
+    # hottest retained join spec (zero new traces via the shared
+    # persist dir) BEFORE entering rotation; fleet QPS at or below
+    # ``autoscale_down_qps`` sustained for ``autoscale_idle_s``
+    # drains the highest-index scaled-up idle replica (never below
+    # the configured base ``n_replicas``).
+    autoscale: bool = False
+    autoscale_max_replicas: int = 4
+    autoscale_up_qps: Optional[float] = None
+    autoscale_up_p95_s: Optional[float] = None
+    autoscale_down_qps: float = 0.0
+    autoscale_idle_s: float = 30.0
+    autoscale_interval_s: float = 1.0
+    autoscale_sustain: int = 3
 
 
 # -- durable state: table manifests, router directory, HA lease --------
@@ -650,10 +698,68 @@ def affine_replica(req: dict, replica_ranks: int,
     return int(key[:8], 16) % max(n_replicas, 1)
 
 
+# Per-tenant admission state is bounded: unconfigured tenant names
+# seen on the wire get counters up to this cap (configured tenants
+# are never evicted — their quota buckets must not reset under churn).
+MAX_TENANT_STATES = 64
+# Retained warm join specs (newest = hottest) for the autoscaler's
+# pre-warm rotation gate.
+WARM_SPECS_MAX = 32
+AUTOSCALE_EVENTS_MAX = 256
+AUTOSCALE_SCHEMA_VERSION = 1
+
+
+class _TenantState:
+    """Router-side per-tenant admission state: a QPS token bucket,
+    an inflight counter, and shed/served tallies. Mutated only under
+    the router lock."""
+
+    __slots__ = ("name", "quota", "priority", "tokens",
+                 "last_refill_monotonic", "inflight", "served",
+                 "quota_sheds", "priority_sheds")
+
+    def __init__(self, name: str, quota: Optional[dict]):
+        self.name = name
+        self.quota = dict(quota) if quota else {}
+        self.priority = int(self.quota.get("priority", 1) or 1)
+        qps = self.quota.get("qps")
+        burst = float(self.quota.get("burst_s", 1.0) or 1.0)
+        # The bucket starts FULL (one burst window's worth, never
+        # below one token) so a tenant's first requests are not shed
+        # by an empty bucket it never filled.
+        self.tokens = max(float(qps) * burst, 1.0) if qps else 0.0
+        self.last_refill_monotonic = time.monotonic()
+        self.inflight = 0
+        self.served = 0
+        self.quota_sheds = 0
+        self.priority_sheds = 0
+
+    def take_token(self, now: float) -> bool:
+        """Refill-then-take; False = over the QPS quota. Only called
+        when the quota configures ``qps``. Caller holds the lock."""
+        qps = float(self.quota["qps"])
+        cap = max(qps * float(self.quota.get("burst_s", 1.0) or 1.0),
+                  1.0)
+        # now is sampled before the router lock — on the admission
+        # that CREATES this state it can precede the constructor's
+        # refill stamp, and a negative delta must not drain the
+        # fresh bucket below its first token.
+        self.tokens = min(
+            cap,
+            self.tokens
+            + max(now - self.last_refill_monotonic, 0.0) * qps)
+        self.last_refill_monotonic = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+
 class FleetRouter:
     """The thin line-JSON TCP router fronting N replicas. Owns the
     replica set (spawn, probe, drain, replace), the affinity routing,
-    the bounded failover loop, admission/shedding, and the fleet-level
+    the bounded failover loop, admission/shedding (global AND
+    per-tenant), the signature-level autoscaler, and the fleet-level
     observability surfaces."""
 
     def __init__(self, replica_factory: Callable,
@@ -677,6 +783,20 @@ class FleetRouter:
         self.served = 0
         self.failed = 0
         self.rejected = 0
+        # Multi-tenant admission (config.tenants): per-tenant token
+        # buckets / inflight counters / shed tallies, created lazily
+        # per observed tenant name (bounded; configured tenants are
+        # never evicted).
+        self._tenant_states: OrderedDict = OrderedDict()
+        # Signature-level autoscaler (config.autoscale): decision
+        # counters, the bounded event log behind the fleet_autoscale
+        # artifact, and the retained hot join specs its pre-warm
+        # rotation gate replays.
+        self.autoscale_spawns_total = 0
+        self.autoscale_drains_total = 0
+        self._autoscale_events: list = []
+        self._autoscaler: Optional[threading.Thread] = None
+        self._warm_specs: OrderedDict = OrderedDict()
         # Replicated-state tier (table_replication > 1): the in-memory
         # table directory (name -> generation/key/holder set) the
         # durable router_directory.json mirrors; `role` is the HA
@@ -716,6 +836,11 @@ class FleetRouter:
                                         daemon=True,
                                         name="fleet-prober")
         self._prober.start()
+        if self.config.autoscale:
+            self._autoscaler = threading.Thread(
+                target=self._autoscale_loop, daemon=True,
+                name="fleet-autoscaler")
+            self._autoscaler.start()
 
     def stop(self, drain: bool = True) -> None:
         """Stop probing, settle any in-flight replacement, and reap
@@ -724,6 +849,12 @@ class FleetRouter:
         if self._prober is not None:
             self._prober.join(timeout=self.config.probe_interval_s
                               + self.config.probe_timeout_s + 5.0)
+        if self._autoscaler is not None:
+            # A tick mid-spawn holds the thread up to the spawn
+            # timeout; its own _stop checks keep a late backend from
+            # leaking past this reap loop.
+            self._autoscaler.join(
+                timeout=self.config.autoscale_interval_s + 5.0)
         # A _replace thread may be mid-spawn: join it (bounded) so
         # the freshly spawned backend lands in self.replicas and is
         # reaped below instead of leaking past shutdown.
@@ -746,14 +877,21 @@ class FleetRouter:
         module-level :func:`affinity_key`."""
         return affinity_key(req, self.config.replica_ranks)
 
-    def _admittable(self, rep: _Replica) -> bool:
+    def _admittable(self, rep: _Replica,
+                    inflight_bound: Optional[int] = None) -> bool:
         """Admission policy: state + inflight bound + the optional
         p95/QPS bounds read from the replica's probed LiveMetrics
         snapshot (stale by at most one probe interval — shedding is a
-        pressure valve, not an exact gate)."""
+        pressure valve, not an exact gate). ``inflight_bound`` is a
+        TIGHTER per-tenant priority cap (never looser than the fleet
+        bound) — how low-priority tenants shed first under
+        pressure."""
         if rep.state not in ("healthy", "suspect"):
             return False
-        if rep.inflight >= self.config.max_inflight_per_replica:
+        bound = self.config.max_inflight_per_replica
+        if inflight_bound is not None:
+            bound = min(bound, inflight_bound)
+        if rep.inflight >= bound:
             return False
         st = rep.last_stats or {}
         if self.config.shed_p95_s is not None:
@@ -915,6 +1053,331 @@ class FleetRouter:
                                 error=f"{type(exc).__name__}: {exc}")
             self._save_directory()
 
+    # -- multi-tenant admission (config.tenants) ----------------------
+
+    def _tenant_state_locked(self, name: str) -> _TenantState:
+        """Lazily created per-tenant state (caller holds the lock).
+        Bounded: when over the cap, evict one UNCONFIGURED tenant's
+        counters — configured quota buckets never reset under name
+        churn."""
+        st = self._tenant_states.get(name)
+        if st is None:
+            st = _TenantState(name,
+                              (self.config.tenants or {}).get(name))
+            self._tenant_states[name] = st
+            if len(self._tenant_states) > MAX_TENANT_STATES:
+                cfg = self.config.tenants or {}
+                for k in list(self._tenant_states):
+                    if k not in cfg and k != name:
+                        del self._tenant_states[k]
+                        break
+        return st
+
+    def _tenant_admit(self, tenant: Optional[str],
+                      op: str) -> Optional[_TenantState]:
+        """Per-tenant admission (docs/FLEET.md "Multi-tenancy &
+        autoscaling"): the inflight cap, the QPS token bucket, and
+        the per-tenant p95 bound, each refusing with a
+        QuotaExceededError NAMING the bound it enforces. Returns the
+        tenant's state with its inflight slot RESERVED (the dispatch
+        finally releases it); None when no tenant accounting applies
+        (control-plane op, or an unstamped request with no quota
+        configured for the default tenant)."""
+        if op in ("ping", "stats", "metrics"):
+            return None
+        name = (tenant if tenant is not None
+                else tel_history.DEFAULT_TENANT)
+        if tenant is None \
+                and name not in (self.config.tenants or {}):
+            return None
+        now = time.monotonic()
+        with self._lock:
+            st = self._tenant_state_locked(name)
+            q = st.quota
+            if q.get("max_inflight") is not None \
+                    and st.inflight >= int(q["max_inflight"]):
+                st.quota_sheds += 1
+                raise QuotaExceededError(
+                    f"tenant {name!r} over its inflight quota "
+                    f"(max_inflight={int(q['max_inflight'])}, "
+                    f"inflight={st.inflight}); shed — retry with "
+                    "backoff")
+            if q.get("qps") is not None \
+                    and not st.take_token(now):
+                st.quota_sheds += 1
+                raise QuotaExceededError(
+                    f"tenant {name!r} over its QPS quota "
+                    f"(qps={float(q['qps'])}/s, "
+                    f"burst_s={float(q.get('burst_s', 1.0) or 1.0)})"
+                    "; shed — retry with backoff")
+            st.inflight += 1
+        bound = st.quota.get("shed_p95_s")
+        if bound is not None:
+            best = self._best_live_p95()
+            if best is not None and best > float(bound):
+                with self._lock:
+                    st.inflight = max(st.inflight - 1, 0)
+                    st.quota_sheds += 1
+                raise QuotaExceededError(
+                    f"tenant {name!r} shed on its p95 bound "
+                    f"(shed_p95_s={float(bound)}, best live replica "
+                    f"p95={best:.3f}s); retry with backoff")
+        return st
+
+    def _best_live_p95(self) -> Optional[float]:
+        """The BEST probed p95 across live replicas — the latency the
+        fleet could serve a request at right now. A tenant's
+        ``shed_p95_s`` sheds only when even this exceeds its bound
+        (one slow replica must not shed a tenant the others can
+        serve in time)."""
+        with self._lock:
+            reps = list(self.replicas)
+        vals = []
+        for rep in reps:
+            if rep.state not in ("healthy", "suspect"):
+                continue
+            p95 = ((rep.last_stats or {}).get("latency")
+                   or {}).get("p95_s")
+            if p95 is not None:
+                vals.append(float(p95))
+        return min(vals) if vals else None
+
+    def _priority_fraction(
+            self, tstate: Optional[_TenantState]) -> float:
+        """A configured tenant's share of the per-replica inflight
+        bound: its priority over the MAX configured priority.
+        Unconfigured (and default) tenants keep the full bound — the
+        exact pre-tenant admission behavior."""
+        if tstate is None or not tstate.quota:
+            return 1.0
+        cfg = self.config.tenants or {}
+        max_p = max([int((q or {}).get("priority", 1) or 1)
+                     for q in cfg.values()] + [1])
+        if max_p <= 0:
+            return 1.0
+        return min(max(tstate.priority / max_p, 0.0), 1.0)
+
+    # -- signature-level autoscaler (config.autoscale) ----------------
+
+    def _retain_warm_spec(self, key: str, req: dict) -> None:
+        """Retain the wire spec of a served generic join keyed by its
+        affinity signature (LRU, newest = hottest): the autoscaler's
+        pre-warm gate replays the hottest one against a fresh replica
+        before it enters rotation."""
+        spec = {k: v for k, v in req.items()
+                if k not in ("request_id", "tenant",
+                             tracectx.TRACE_FIELD)}
+        with self._lock:
+            self._warm_specs.pop(key, None)
+            self._warm_specs[key] = spec
+            while len(self._warm_specs) > WARM_SPECS_MAX:
+                self._warm_specs.popitem(last=False)
+
+    def _autoscale_loop(self):
+        over = 0
+        idle_since = None
+        while not self._stop.wait(self.config.autoscale_interval_s):
+            try:
+                over, idle_since = self._autoscale_tick(over,
+                                                        idle_since)
+            except Exception as exc:  # noqa: BLE001 - control loop
+                telemetry.event(
+                    "fleet_autoscale_error",
+                    error=f"{type(exc).__name__}: {exc}")
+
+    def _autoscale_tick(self, over, idle_since):
+        """One control-loop decision over the probed per-replica
+        LiveMetrics: sustained fleet QPS / worst-p95 over the up
+        bounds spawns a replica; fleet QPS at/below the down bound
+        with nothing in flight, sustained for ``autoscale_idle_s``,
+        drains one scaled-up replica."""
+        cfg = self.config
+        with self._lock:
+            live = [r for r in self.replicas
+                    if r.state in ("healthy", "suspect")]
+            n_live = len(live)
+            any_inflight = any(r.inflight > 0 for r in live)
+            total_qps = 0.0
+            worst_p95 = None
+            for r in live:
+                st = r.last_stats or {}
+                q = st.get("qps_60s")
+                if q:
+                    total_qps += float(q)
+                p95 = (st.get("latency") or {}).get("p95_s")
+                if p95 is not None and (worst_p95 is None
+                                        or float(p95) > worst_p95):
+                    worst_p95 = float(p95)
+        hot = ((cfg.autoscale_up_qps is not None
+                and total_qps > cfg.autoscale_up_qps)
+               or (cfg.autoscale_up_p95_s is not None
+                   and worst_p95 is not None
+                   and worst_p95 > cfg.autoscale_up_p95_s))
+        now = time.monotonic()
+        if hot:
+            over += 1
+            idle_since = None
+            if over >= max(cfg.autoscale_sustain, 1) \
+                    and n_live < cfg.autoscale_max_replicas:
+                self._autoscale_spawn(total_qps, worst_p95)
+                over = 0
+            return over, idle_since
+        over = 0
+        if total_qps <= cfg.autoscale_down_qps and not any_inflight:
+            if idle_since is None:
+                idle_since = now
+            elif now - idle_since >= cfg.autoscale_idle_s:
+                if self._autoscale_drain_one(total_qps):
+                    idle_since = now
+        else:
+            idle_since = None
+        return over, idle_since
+
+    def _autoscale_spawn(self, qps, p95):
+        """Spawn one scaled-up replica on a fresh index and PRE-WARM
+        VERIFY it BEFORE it enters rotation: the hottest retained
+        join spec replayed directly (it is not routable yet) must be
+        SERVED, and is warm-verified when it cost zero new traces
+        (the shared AOT persist dir did its job). A replica that
+        cannot serve the probe never rotates in."""
+        with self._lock:
+            index = max((r.index for r in self.replicas),
+                        default=-1) + 1
+        reason = (f"sustained load (fleet qps_60s={qps:.2f}, "
+                  f"worst p95={p95})")
+        try:
+            backend = self.factory(index, 0)
+        except Exception as exc:  # noqa: BLE001 - spawn boundary
+            self._autoscale_event(
+                "spawn_failed", index,
+                reason=f"{type(exc).__name__}: {exc}",
+                qps=qps, p95_s=p95)
+            return
+        rep = _Replica(index=index, backend=backend)
+        warm = self._prewarm(rep)
+        if self._stop.is_set() or not warm.get("served"):
+            try:
+                backend.stop()
+            except Exception:  # noqa: BLE001 - reap boundary
+                pass
+            if not self._stop.is_set():
+                self._autoscale_event(
+                    "spawn_failed", index,
+                    reason="pre-warm probe failed: "
+                           f"{warm.get('error')}",
+                    qps=qps, p95_s=p95)
+            return
+        with self._lock:
+            self.replicas.append(rep)
+            self.autoscale_spawns_total += 1
+        self._autoscale_event(
+            "spawn", index, reason=reason, qps=qps, p95_s=p95,
+            warm_verified=warm.get("verified"),
+            new_traces=warm.get("new_traces"),
+            signature=warm.get("signature"))
+
+    def _prewarm(self, rep: _Replica) -> dict:
+        """The rotation gate probe: replay the hottest retained join
+        spec against the fresh replica; fall back to a ping when no
+        spec has been retained yet (served, but not warm-verified)."""
+        with self._lock:
+            sig, spec = (next(reversed(self._warm_specs.items()))
+                         if self._warm_specs else (None, None))
+        probe = dict(spec) if spec else {"op": "ping"}
+        probe["request_id"] = f"autoscale-warm-{rep.index}"
+        try:
+            client = ServiceClient(
+                *rep.addr(), timeout_s=self.config.spawn_timeout_s)
+            try:
+                resp = client.send(tracectx.attach(
+                    probe, tracectx.mint()))
+            finally:
+                client.close()
+        except (OSError, ValueError) as exc:
+            return {"served": False,
+                    "error": f"{type(exc).__name__}: {exc}"}
+        if not resp.get("ok"):
+            return {"served": False,
+                    "error": str(resp.get("message")
+                                 or resp.get("error"))}
+        new_traces = int(resp.get("new_traces") or 0)
+        return {"served": True,
+                "verified": spec is not None and new_traces == 0,
+                "new_traces": (new_traces if spec is not None
+                               else None),
+                "signature": sig}
+
+    def _autoscale_drain_one(self, qps) -> bool:
+        """Scale down: drain the HIGHEST-INDEX idle scaled-up
+        replica — never below the configured base ``n_replicas`` —
+        and reap it with NO respawn (this drain is the point)."""
+        with self._lock:
+            live = [r for r in self.replicas
+                    if r.state in ("healthy", "suspect")]
+            candidates = [r for r in live
+                          if r.index >= self.config.n_replicas
+                          and r.inflight == 0]
+            if len(live) <= self.config.n_replicas \
+                    or not candidates:
+                return False
+            rep = max(candidates, key=lambda r: r.index)
+            rep.state = "drained"
+            rep.drained_reason = "autoscale: idle"
+            rep.drained_at = time.monotonic()
+            self.drains_total += 1
+            self.autoscale_drains_total += 1
+        self._send_drain(rep)
+        try:
+            rep.backend.stop()
+        except Exception as exc:  # noqa: BLE001 - reap boundary
+            telemetry.event("fleet_replica_reap_error",
+                            replica=rep.index, error=str(exc))
+        self._autoscale_event("drain", rep.index,
+                              reason="idle past autoscale_idle_s",
+                              qps=qps)
+        return True
+
+    def _autoscale_event(self, action, replica, *, reason,
+                         qps=None, p95_s=None, warm_verified=None,
+                         new_traces=None, signature=None):
+        event = {"action": action, "replica": int(replica),
+                 "reason": reason, "unix_s": time.time()}
+        if qps is not None:
+            event["qps_60s"] = round(float(qps), 4)
+        if p95_s is not None:
+            event["p95_s"] = round(float(p95_s), 6)
+        if warm_verified is not None:
+            event["warm_verified"] = bool(warm_verified)
+        if new_traces is not None:
+            event["new_traces"] = int(new_traces)
+        if signature is not None:
+            event["signature"] = signature
+        with self._lock:
+            self._autoscale_events.append(event)
+            del self._autoscale_events[:-AUTOSCALE_EVENTS_MAX]
+        telemetry.event("fleet_autoscale_" + action,
+                        replica=int(replica), reason=reason)
+        self.recorder.record(
+            request_id=f"fleet-autoscale-{replica}",
+            op="autoscale", signature=signature,
+            outcome=action, reason=reason)
+
+    def autoscale_record(self) -> dict:
+        """The ``fleet_autoscale`` artifact (``analyze check``
+        validates it): the autoscaler's decision log plus its
+        counters."""
+        with self._lock:
+            return {
+                "kind": "fleet_autoscale",
+                "schema_version": AUTOSCALE_SCHEMA_VERSION,
+                "enabled": bool(self.config.autoscale),
+                "spawns_total": int(self.autoscale_spawns_total),
+                "drains_total": int(self.autoscale_drains_total),
+                "replicas": len(self.replicas),
+                "events": [dict(e)
+                           for e in self._autoscale_events],
+            }
+
     # -- dispatch -----------------------------------------------------
 
     def _mint_request_id(self, request_id) -> str:
@@ -944,6 +1407,13 @@ class FleetRouter:
         )
 
         op = req.get("op", "?")
+        # The optional wire tenant (default tenant = absent field —
+        # every pre-tenant wire contract preserved byte-for-byte):
+        # threaded like request_id through admission, history,
+        # flight records, and Prometheus.
+        tenant = req.get("tenant")
+        if tenant is not None:
+            tenant = str(tenant)
         rid = self._mint_request_id(req.get("request_id"))
         key = self.affinity_key(req)
         # The router is the trace ROOT when the client sent no
@@ -969,7 +1439,8 @@ class FleetRouter:
             if time.monotonic() >= fence_deadline:
                 with self._lock:
                     self.rejected += 1
-                self.live.record_request(op, "rejected")
+                self.live.record_request(op, "rejected",
+                                         tenant=tenant)
                 # Every refusal lands in the postmortem ring — this
                 # is the one path that bypasses _observe's fan-out.
                 self.recorder.record(
@@ -987,10 +1458,17 @@ class FleetRouter:
                  "trace": ctx}
         outcome = "failed"
         resp = None
+        tstate = None
         scope = telemetry.request_scope(None, trace=tracectx.stamp(ctx)
                                         or None)
         scope.__enter__()
         try:
+            # Per-tenant admission FIRST: an over-quota tenant is
+            # shed before it can touch a replica slot or the holder
+            # fan-out — the quiet tenant's capacity is never burned
+            # probing on the noisy tenant's behalf.
+            tstate = self._tenant_admit(tenant, op)
+            tenant_frac = self._priority_fraction(tstate)
             if self._replicated and op in ("register", "append",
                                            "drop"):
                 # Replicated table ops never ride the single-replica
@@ -1029,8 +1507,12 @@ class FleetRouter:
                            "min_generation": entry["generation"]}
             resp = self._dispatch_attempts(
                 req, rid, key, state, retry_with_backoff,
-                allowed=allowed)
+                allowed=allowed, tenant=tenant,
+                tenant_frac=tenant_frac)
             outcome = "served" if resp.get("ok") else "failed"
+            if outcome == "served" and op == "join" \
+                    and not req.get("table"):
+                self._retain_warm_spec(key, req)
             return resp
         except AdmissionError as exc:
             outcome = "rejected"
@@ -1040,6 +1522,28 @@ class FleetRouter:
             resp = {"ok": False, "error": "AdmissionError",
                     "message": str(exc), "shed": True,
                     "request_id": rid,
+                    "fleet": {"attempts": state["attempts"]}}
+            return resp
+        except QuotaExceededError as exc:
+            outcome = "rejected"
+            with self._lock:
+                self.shed_total += 1
+                self.rejected += 1
+            resp = {"ok": False, "error": "QuotaExceededError",
+                    "message": str(exc), "shed": True,
+                    "tenant": tenant, "request_id": rid,
+                    "fleet": {"attempts": state["attempts"]}}
+            return resp
+        except ShedError as exc:
+            outcome = "rejected"
+            with self._lock:
+                self.shed_total += 1
+                self.rejected += 1
+                if tstate is not None:
+                    tstate.priority_sheds += 1
+            resp = {"ok": False, "error": "ShedError",
+                    "message": str(exc), "shed": True,
+                    "tenant": tenant, "request_id": rid,
                     "fleet": {"attempts": state["attempts"]}}
             return resp
         except NoHolderError as exc:
@@ -1058,8 +1562,13 @@ class FleetRouter:
         finally:
             with self._lock:
                 self._inflight_ids.discard(rid)
+                if tstate is not None:
+                    tstate.inflight = max(tstate.inflight - 1, 0)
+                    if outcome == "served":
+                        tstate.served += 1
             self._observe(rid, op, key, outcome, state,
-                          time.perf_counter() - t0, resp)
+                          time.perf_counter() - t0, resp,
+                          tenant=tenant)
             scope.__exit__(None, None, None)
             if isinstance(resp, dict):
                 # Echo the router's span on the wire so the client
@@ -1068,7 +1577,8 @@ class FleetRouter:
                                 tracectx.to_wire(ctx))
 
     def _dispatch_attempts(self, req, rid, key, state,
-                           retry_with_backoff, allowed=None):
+                           retry_with_backoff, allowed=None,
+                           tenant=None, tenant_frac=1.0):
         deadline = time.monotonic() + self.config.request_deadline_s
         # index -> generation at HARD-failure time (dead connection,
         # hang, poison): a later attempt may return to the slot only
@@ -1082,8 +1592,20 @@ class FleetRouter:
 
         def attempt_once():
             state["attempts"] += 1
+            # Priority-weighted headroom: a low-priority tenant sees
+            # only its priority's share of the per-replica inflight
+            # bound (recomputed per attempt — the bound is a live
+            # knob). Full-priority and unconfigured tenants keep the
+            # exact pre-tenant bound.
+            bound = None
+            if tenant_frac < 1.0:
+                bound = max(
+                    1, int(self.config.max_inflight_per_replica
+                           * tenant_frac))
+                if bound >= self.config.max_inflight_per_replica:
+                    bound = None
             rep = self._pick(key, last_failed, soft_failed,
-                             allowed=allowed)
+                             allowed=allowed, inflight_bound=bound)
             if rep is None:
                 if allowed is not None:
                     with self._lock:
@@ -1098,6 +1620,21 @@ class FleetRouter:
                             f"{sorted(allowed)} all dead/drained); "
                             "refusing rather than misrouting to a "
                             "replica without the image")
+                if bound is not None and self._pick(
+                        key, last_failed, soft_failed,
+                        allowed=allowed, reserve=False) is not None:
+                    # A replica WOULD admit at the full fleet bound:
+                    # this is a priority shed, not fleet-wide
+                    # overload — the low-priority tenant yields its
+                    # headroom first, named as such.
+                    raise ShedError(
+                        f"tenant {tenant!r} shed under fleet "
+                        f"pressure: priority weight "
+                        f"{tenant_frac:.2f} caps its per-replica "
+                        f"inflight at {bound} (fleet bound "
+                        f"{self.config.max_inflight_per_replica}); "
+                        "higher-priority tenants keep the remaining "
+                        "headroom")
                 raise AdmissionError(
                     "fleet admission: no admittable replica "
                     f"(inflight bound "
@@ -1206,7 +1743,9 @@ class FleetRouter:
 
     def _pick(self, key: str, exclude: dict,
               soft: Optional[set] = None,
-              allowed: Optional[set] = None) -> Optional[_Replica]:
+              allowed: Optional[set] = None,
+              inflight_bound: Optional[int] = None,
+              reserve: bool = True) -> Optional[_Replica]:
         """Pick AND reserve (inflight slot taken under the one lock,
         so two concurrent dispatches can never both pass the
         admission bound). The caller releases the slot in its
@@ -1218,7 +1757,11 @@ class FleetRouter:
         draining) indices: preferred-against on the first pass,
         re-eligible on the fallback pass. ``allowed`` (replicated
         resident traffic) restricts the walk to the table's holder
-        set — a non-holder never sees the request."""
+        set — a non-holder never sees the request.
+        ``inflight_bound`` tightens the per-replica inflight cap for
+        low-priority tenants; ``reserve=False`` is a dry-run probe
+        (no slot taken) used to tell a priority shed apart from a
+        fleet-wide one."""
         with self._lock:
             n = len(self.replicas)
             if not n:
@@ -1239,8 +1782,9 @@ class FleetRouter:
                     if not second_pass and soft \
                             and rep.index in soft:
                         continue
-                    if self._admittable(rep):
-                        rep.inflight += 1
+                    if self._admittable(rep, inflight_bound):
+                        if reserve:
+                            rep.inflight += 1
                         return rep
         return None
 
@@ -1305,10 +1849,10 @@ class FleetRouter:
                             error=f"{type(exc).__name__}: {exc}")
 
     def _observe(self, rid, op, key, outcome, state, elapsed_s,
-                 resp):
+                 resp, tenant=None):
         """Fleet-side accounting fan-out (live metrics, flight ring,
-        history line stamped with the serving replica). Never fails a
-        request."""
+        history line stamped with the serving replica and, when the
+        wire named one, the tenant). Never fails a request."""
         try:
             rep = state.get("replica")
             stamp = ({"index": rep.index,
@@ -1333,11 +1877,15 @@ class FleetRouter:
                          if state.get("replica") is not None
                          else None),
                 **(trace or {}))
+            tstamp = ({"tenant": tenant} if tenant is not None
+                      else {})
             self.live.record_request(
                 op, outcome,
                 latency_s=elapsed_s if outcome == "served" else None,
                 signature=key,
-                new_traces=int((resp or {}).get("new_traces") or 0))
+                new_traces=int((resp or {}).get("new_traces") or 0),
+                tenant=tenant,
+                shed=bool((resp or {}).get("shed")))
             self.recorder.record(
                 request_id=rid, op=op, signature=key,
                 outcome=outcome, elapsed_s=round(elapsed_s, 6),
@@ -1348,7 +1896,8 @@ class FleetRouter:
                 resident=resident,
                 trace=trace,
                 error=(None if (resp or {}).get("ok")
-                       else (resp or {}).get("message")))
+                       else (resp or {}).get("message")),
+                **tstamp)
             if self.history is not None and op not in ("ping",
                                                        "stats",
                                                        "metrics"):
@@ -1361,7 +1910,8 @@ class FleetRouter:
                     error=(None if (resp or {}).get("ok")
                            else str((resp or {}).get("message"))),
                     resident=resident,
-                    replica=stamp, trace=trace))
+                    replica=stamp, trace=trace,
+                    tenant=tenant))
         except Exception as exc:  # noqa: BLE001 - bookkeeping boundary
             telemetry.event("fleet_observability_error",
                             request_id=rid,
@@ -2010,7 +2560,32 @@ class FleetRouter:
                 "uptime_s": round(self.live.uptime_s(), 3),
                 "latency": self.live.overall_latency(),
                 "replica_detail": reps,
+                "tenants": self._tenant_stats_locked(),
+                "autoscale": {
+                    "enabled": bool(self.config.autoscale),
+                    "spawns_total": self.autoscale_spawns_total,
+                    "drains_total": self.autoscale_drains_total,
+                },
             }
+
+    def _tenant_stats_locked(self) -> dict:
+        """Per-tenant stats block ({} when no tenant has been seen):
+        the router LiveMetrics tenant summary (requests/outcomes/
+        shed/qps/latency — the shape ``--watch`` renders) merged with
+        the admission-side state (inflight, priority, quota,
+        shed-by-kind tallies). Caller holds the router lock."""
+        out = self.live.tenants_summary()
+        for name, st in self._tenant_states.items():
+            t = out.setdefault(name, {"requests": 0, "outcomes": {},
+                                      "shed": 0, "qps_60s": 0.0,
+                                      "latency": {}})
+            t["inflight"] = st.inflight
+            t["priority"] = st.priority
+            t["quota_sheds"] = st.quota_sheds
+            t["priority_sheds"] = st.priority_sheds
+            if st.quota:
+                t["quota"] = dict(st.quota)
+        return out
 
     def prometheus_metrics(self) -> str:
         st = self.stats()
@@ -2030,7 +2605,31 @@ class FleetRouter:
             "router_role": (1 if st["router_role"] in ("single",
                                                        "primary")
                             else 0),
+            "autoscale_enabled": (1 if st["autoscale"]["enabled"]
+                                  else 0),
+            "autoscale_spawns_total":
+                st["autoscale"]["spawns_total"],
+            "autoscale_drains_total":
+                st["autoscale"]["drains_total"],
         })
+        if st["tenants"]:
+            # Labeled per-tenant admission gauges (the request/shed
+            # counter series ride the shared LiveMetrics tenant
+            # exposition above).
+            lines = [text.rstrip("\n"),
+                     "# TYPE djtpu_tenant_inflight gauge"]
+            for name in sorted(st["tenants"]):
+                t = st["tenants"][name]
+                lines.append(
+                    f'djtpu_tenant_inflight{{tenant="{name}"}} '
+                    f'{t.get("inflight") or 0}')
+            lines.append("# TYPE djtpu_tenant_priority gauge")
+            for name in sorted(st["tenants"]):
+                t = st["tenants"][name]
+                lines.append(
+                    f'djtpu_tenant_priority{{tenant="{name}"}} '
+                    f'{t.get("priority") or 1}')
+            text = "\n".join(lines) + "\n"
         if st["tables"]:
             # Labeled per-table gauge: serving-holder count (the
             # fleet's effective replication factor per table, live).
@@ -2707,6 +3306,308 @@ class FleetSmokeError(RuntimeError):
     def __init__(self, msg, record):
         super().__init__(msg)
         self.record = record
+
+
+def run_tenant_smoke(args) -> dict:
+    """The ``fleet`` lane's multi-tenancy + autoscaling acceptance
+    protocol (docs/FLEET.md "Multi-tenancy & autoscaling"), end to
+    end through real subprocess replicas and the router TCP loop:
+
+    1. two configured tenants — ``gold`` (priority 2, no quota) and
+       ``bronze`` (priority 1, 0.5 QPS token bucket); gold's cold +
+       warm query Q is oracle-graded and must repeat with zero new
+       traces, its responses and the replica's own stats carrying
+       the tenant stamp;
+    2. QUOTA REFUSAL: a back-to-back bronze burst must shed with a
+       structured ``QuotaExceededError`` naming the QPS bound (and
+       ``shed: true`` + the tenant echoed on the wire);
+    3. PRIORITY SHED ORDER: with every replica's inflight pinned at
+       bronze's priority share of the bound, a bronze request must
+       shed with ``ShedError`` while the SAME-instant gold request
+       is served — the low-priority tenant yields first, never the
+       quiet one;
+    4. AUTOSCALE SPAWN, WARM: the control loop (low QPS bound, short
+       sustain) must spawn a third replica whose pre-warm rotation
+       gate replayed the hottest retained signature with ZERO new
+       traces (``warm_verified``) BEFORE entering rotation;
+    5. the per-tenant + autoscale Prometheus series are emitted and
+       the ``fleet_autoscale`` record is well-formed.
+
+    Returns the JSON record (kind ``fleet_tenant_smoke``) for
+    ``analyze check`` in the fleet lane.
+    """
+    import tempfile
+
+    violations: list = []
+    workdir_owned = args.persist_dir is None
+    workdir = args.persist_dir or tempfile.mkdtemp(
+        prefix="djtpu_tenant_smoke_")
+    cfg = FleetConfig(
+        n_replicas=2,
+        replica_ranks=args.replica_ranks,
+        persist_dir=os.path.join(workdir, "programs"),
+        history_dir=(args.history_dir
+                     or os.path.join(workdir, "history")),
+        probe_interval_s=0.5,
+        retry_budget=2,
+        max_inflight_per_replica=2,
+        flight_recorder_path=args.flight_recorder_path,
+        spawn_timeout_s=args.spawn_timeout_s,
+        tenants={
+            "gold": {"priority": 2},
+            "bronze": {"qps": 0.5, "burst_s": 1.0, "priority": 1},
+        },
+        autoscale=True,
+        autoscale_max_replicas=3,
+        autoscale_up_qps=0.01,
+        autoscale_interval_s=0.5,
+        autoscale_sustain=2,
+    )
+    router = FleetRouter(
+        process_fleet_factory(cfg, platform=args.platform or "cpu"),
+        cfg)
+    router.start()
+    server, port = start_router_daemon(router)
+    client = ServiceClient("127.0.0.1", port, retries=2)
+
+    q = {"op": "join", "build_nrows": 2048, "probe_nrows": 2048,
+         "seed": 17, "selectivity": 0.4, "rand_max": 1024,
+         "out_capacity_factor": 3.0}
+
+    def oracle_matches():
+        from distributed_join_tpu.service.server import (
+            _tables_from_spec,
+        )
+
+        build, probe = _tables_from_spec(q)
+        return len(build.to_pandas().merge(probe.to_pandas(),
+                                           on="key"))
+
+    bronze_refused = gold_pressure = None
+    autoscale = {}
+    try:
+        expected = oracle_matches()
+        cold = client.send({**q, "tenant": "gold"})
+        if not cold.get("ok"):
+            raise RuntimeError(f"gold cold query failed: {cold}")
+        warm = client.send({**q, "tenant": "gold"})
+        if not warm.get("ok"):
+            raise RuntimeError(f"gold warm query failed: {warm}")
+        for name, resp in (("cold", cold), ("warm", warm)):
+            if resp["matches"] != expected:
+                violations.append(
+                    f"gold {name} matches {resp['matches']} != "
+                    f"pandas oracle {expected}")
+        if warm["new_traces"] != 0:
+            violations.append(
+                f"gold warm repeat traced {warm['new_traces']} new "
+                "program(s)")
+        # The tenant stamp rides the wire to the REPLICA: its own
+        # stats must account the gold traffic per-tenant.
+        serving = router.replicas[cold["fleet"]["replica"]]
+        try:
+            direct = ServiceClient(*serving.addr(), timeout_s=30.0)
+            try:
+                rep_stats = direct.send(
+                    tracectx.attach({"op": "stats"},
+                                    tracectx.mint()))
+            finally:
+                direct.close()
+        except (OSError, ValueError) as exc:
+            rep_stats = {}
+            violations.append(
+                "serving replica unreachable for the tenant-stamp "
+                f"check: {type(exc).__name__}: {exc}")
+        if "gold" not in (rep_stats.get("tenants") or {}):
+            violations.append(
+                "replica stats carry no 'gold' tenant slot — the "
+                "tenant field did not ride the wire to the replica")
+
+        # Quota refusal: bronze's 0.5 QPS bucket holds ONE token —
+        # back-to-back sends must shed with the bound named.
+        bronze_results = []
+        for i in range(6):
+            bronze_results.append(client.send(
+                {**q, "tenant": "bronze",
+                 "request_id": f"bronze-burst-{i}"}))
+        bronze_refused = [
+            r for r in bronze_results
+            if r.get("error") == "QuotaExceededError"]
+        if not bronze_refused:
+            violations.append(
+                "bronze burst of 6 over a 0.5 QPS quota was never "
+                "quota-refused")
+        for r in bronze_refused:
+            if not r.get("shed") or r.get("tenant") != "bronze":
+                violations.append(
+                    "quota refusal missing shed/tenant stamps: "
+                    f"{r}")
+            if "QPS quota" not in str(r.get("message")):
+                violations.append(
+                    "quota refusal does not name the QPS bound: "
+                    f"{r.get('message')}")
+        leaked = [r for r in bronze_results
+                  if not r.get("ok")
+                  and r.get("error") not in ("QuotaExceededError",
+                                             "ShedError")]
+        if leaked:
+            violations.append(
+                f"bronze burst leaked unstructured errors: "
+                f"{leaked[:2]}")
+
+        # Autoscale spawn: the sustained (tiny) QPS bound must spawn
+        # replica 2, pre-warm verified with zero new traces BEFORE
+        # rotation. Waiting for it FIRST also settles the fleet at
+        # autoscale_max_replicas so the priority-shed gate below
+        # pins a stable replica set.
+        deadline = time.monotonic() + cfg.spawn_timeout_s
+        while time.monotonic() < deadline:
+            with router._lock:
+                if router.autoscale_spawns_total >= 1:
+                    break
+            # Keep the probed qps_60s above the bound while waiting.
+            client.send({**q, "tenant": "gold",
+                         "request_id":
+                             f"keepwarm-{int(time.monotonic())}"})
+            time.sleep(0.5)
+        autoscale = router.autoscale_record()
+        spawns = [e for e in autoscale["events"]
+                  if e["action"] == "spawn"]
+        if not spawns:
+            violations.append(
+                "autoscaler never spawned under sustained load "
+                f"(events: {autoscale['events']})")
+        else:
+            ev = spawns[0]
+            if not ev.get("warm_verified"):
+                violations.append(
+                    f"autoscale spawn was not warm-verified: {ev}")
+            if ev.get("new_traces") != 0:
+                violations.append(
+                    "autoscale pre-warm replay traced "
+                    f"{ev.get('new_traces')} new program(s)")
+            with router._lock:
+                scaled = [r for r in router.replicas
+                          if r.index == ev["replica"]
+                          and r.state in ("healthy", "suspect")]
+            if not scaled:
+                violations.append(
+                    f"spawned replica {ev['replica']} is not in "
+                    "rotation")
+
+        # Priority shed order: pin every replica's inflight at
+        # bronze's share (priority 1 of max 2 -> bound 1 of 2). The
+        # SAME pressure must shed bronze with ShedError and still
+        # serve gold.
+        time.sleep(2.5)  # refill bronze's bucket past one token
+        with router._lock:
+            pinned = [r for r in router.replicas
+                      if r.state in ("healthy", "suspect")]
+            for r in pinned:
+                r.inflight += 1
+        try:
+            bronze_pressure = client.send(
+                {**q, "tenant": "bronze",
+                 "request_id": "bronze-pressure"})
+            gold_pressure = client.send(
+                {**q, "tenant": "gold",
+                 "request_id": "gold-pressure"})
+        finally:
+            with router._lock:
+                for r in pinned:
+                    r.inflight = max(r.inflight - 1, 0)
+        if bronze_pressure.get("error") != "ShedError":
+            violations.append(
+                "bronze under pressure was not priority-shed "
+                f"(ShedError): {bronze_pressure}")
+        elif "priority" not in str(
+                bronze_pressure.get("message")):
+            violations.append(
+                "priority shed does not name the priority bound: "
+                f"{bronze_pressure.get('message')}")
+        if not gold_pressure.get("ok") \
+                or gold_pressure.get("matches") != expected:
+            violations.append(
+                "gold under the SAME pressure was not served "
+                f"exactly: {gold_pressure}")
+
+        prom = router.prometheus_metrics()
+        for series in ("djtpu_tenant_requests_total",
+                       "djtpu_tenant_shed_total",
+                       "djtpu_tenant_inflight",
+                       "djtpu_tenant_priority",
+                       "djtpu_autoscale_enabled",
+                       "djtpu_autoscale_spawns_total",
+                       "djtpu_autoscale_drains_total"):
+            if series not in prom:
+                violations.append(
+                    f"prometheus exposition missing {series}")
+        stats = router.stats()
+        for name in ("gold", "bronze"):
+            if name not in (stats.get("tenants") or {}):
+                violations.append(
+                    f"router stats missing tenant {name!r}")
+        if (stats["tenants"].get("gold") or {}).get("shed"):
+            violations.append(
+                "gold (the quiet tenant) was shed "
+                f"{stats['tenants']['gold']['shed']} time(s)")
+    finally:
+        client.close()
+        server.shutdown()
+        server.server_close()
+        router.stop()
+
+    record = {
+        "kind": "fleet_tenant_smoke",
+        "benchmark": "fleet_tenant_smoke",
+        "n_ranks": cfg.replica_ranks,
+        "replicas": cfg.n_replicas,
+        "matches_expected": expected,
+        "tenants": stats.get("tenants"),
+        "autoscale": {
+            "enabled": autoscale.get("enabled"),
+            "spawns_total": autoscale.get("spawns_total"),
+            "drains_total": autoscale.get("drains_total"),
+            "events": autoscale.get("events"),
+        },
+        "stats": stats,
+        "history_path": (router.history.path
+                         if router.history is not None else None),
+        "violations": violations,
+        # Deterministic gate body: indicator counters only (shed
+        # COUNTS are timing-dependent and stay outside).
+        "counter_signature": {
+            "signature_version": 1,
+            "n_ranks": cfg.replica_ranks,
+            "counters": {
+                "replicas": cfg.n_replicas,
+                "matches_gold_cold": cold["matches"],
+                "matches_gold_warm": warm["matches"],
+                "gold_warm_new_traces": warm["new_traces"],
+                "bronze_quota_refused":
+                    int(bool(bronze_refused)),
+                "bronze_priority_shed": int(
+                    bronze_pressure.get("error") == "ShedError"),
+                "gold_served_under_pressure": int(
+                    bool(gold_pressure
+                         and gold_pressure.get("ok"))),
+                "autoscale_spawned": int(bool(spawns)),
+                "autoscale_warm_verified": int(
+                    bool(spawns
+                         and spawns[0].get("warm_verified"))),
+            },
+        },
+    }
+    if violations:
+        record["workdir"] = workdir
+        raise FleetSmokeError(
+            "tenant smoke violations: " + "; ".join(violations),
+            record)
+    if workdir_owned:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return record
 
 
 def run_tracing_smoke(args) -> dict:
@@ -3440,6 +4341,12 @@ def parse_args(argv=None):
                         "CPU-mesh fleet, scripted replica kill, "
                         "oracle/drain/replace/shed gates) instead of "
                         "serving; JSON record on stdout")
+    p.add_argument("--tenant-smoke", action="store_true",
+                   help="run the multi-tenancy + autoscaling "
+                        "acceptance protocol (two-tenant 2-replica "
+                        "fleet: quota refusal, priority shed order, "
+                        "autoscale spawn with warm-serve gate) "
+                        "instead of serving; JSON record on stdout")
     p.add_argument("--tracing-smoke", action="store_true",
                    help="run the distributed-tracing acceptance "
                         "protocol (2-replica fleet with per-slot "
@@ -3513,6 +4420,24 @@ def main(argv=None) -> int:
             f"warm ({sig['rebuilt_replay_new_traces']} traces), "
             f"router kill -> takeover #{record['takeovers_total']} "
             f"warm ({sig['takeover_new_traces']} traces)",
+            record, args.json_output)
+        return 0
+    if args.tenant_smoke:
+        try:
+            record = run_tenant_smoke(args)
+        except FleetSmokeError as exc:
+            report("tenant smoke FAILED", exc.record,
+                   args.json_output)
+            print(str(exc), file=sys.stderr)
+            return 1
+        sig = record["counter_signature"]["counters"]
+        report(
+            f"tenant smoke: {record['replicas']} replicas + "
+            f"{sig['autoscale_spawned']} autoscaled (warm-verified="
+            f"{sig['autoscale_warm_verified']}), bronze quota-"
+            f"refused={sig['bronze_quota_refused']} priority-shed="
+            f"{sig['bronze_priority_shed']}, gold served exactly "
+            "under the same pressure",
             record, args.json_output)
         return 0
     if args.smoke:

@@ -282,17 +282,20 @@ class JoinService:
         return f"req-{self._id_stamp}-{self._request_seq:06d}"
 
     def _admit(self, op: str, request_id=None) -> str:
+        tenant = tel_history.current_tenant()
+        tstamp = {"tenant": tenant} if tenant is not None else {}
         with self._admit_lock:
             rid = self._mint_request_id(request_id)
             if self.poisoned is not None:
                 self.rejected += 1
                 telemetry.event("request_rejected", reason="poisoned",
                                 request_id=rid)
-                self.live.record_request(op, "rejected")
+                self.live.record_request(op, "rejected",
+                                         tenant=tenant)
                 self.recorder.record(request_id=rid, op=op,
                                      signature=None,
                                      outcome="rejected",
-                                     reason="poisoned")
+                                     reason="poisoned", **tstamp)
                 raise AdmissionError(
                     "mesh poisoned by a hung request "
                     f"({self.poisoned}); restart the server"
@@ -301,11 +304,12 @@ class JoinService:
                 self.rejected += 1
                 telemetry.event("request_rejected", reason="draining",
                                 request_id=rid)
-                self.live.record_request(op, "rejected")
+                self.live.record_request(op, "rejected",
+                                         tenant=tenant)
                 self.recorder.record(request_id=rid, op=op,
                                      signature=None,
                                      outcome="rejected",
-                                     reason="draining")
+                                     reason="draining", **tstamp)
                 raise DrainingError(
                     f"service draining ({self.draining}); "
                     "retry on another replica"
@@ -314,11 +318,12 @@ class JoinService:
                 self.rejected += 1
                 telemetry.event("request_rejected", reason="pending",
                                 pending=self._pending, request_id=rid)
-                self.live.record_request(op, "rejected")
+                self.live.record_request(op, "rejected",
+                                         tenant=tenant)
                 self.recorder.record(request_id=rid, op=op,
                                      signature=None,
                                      outcome="rejected",
-                                     reason="pending")
+                                     reason="pending", **tstamp)
                 raise AdmissionError(
                     f"{self._pending} requests already pending "
                     f"(max_pending={self.config.max_pending}); "
@@ -336,12 +341,27 @@ class JoinService:
     # -- the request paths --------------------------------------------
 
     def join(self, build, probe, key="key", *, request_id=None,
-             op: str = "join", **opts):
+             op: str = "join", tenant: Optional[str] = None, **opts):
         """One admitted, watchdog-guarded, span-wrapped join through
         the program cache. Returns the ``JoinResult`` (with
         ``retry_report`` / ``integrity_report`` attributes exactly as
         ``distributed_inner_join`` attaches them, plus the host-side
-        ``new_traces`` and ``request_id``)."""
+        ``new_traces`` and ``request_id``). ``tenant`` (None = the
+        wire handler's scope, or the default tenant) stamps the
+        request's accounting and selects the tuner namespace — it
+        never reaches the compiled program (the shared program cache
+        is tenant-free by construction: workload signatures cover
+        shapes/dtypes/knobs only, so two tenants with one workload
+        share one executable)."""
+        with tel_history.tenant_scope(
+                tenant if tenant is not None
+                else tel_history.current_tenant()):
+            return self._join_scoped(build, probe, key,
+                                     request_id=request_id, op=op,
+                                     **opts)
+
+    def _join_scoped(self, build, probe, key="key", *,
+                     request_id=None, op: str = "join", **opts):
         from distributed_join_tpu.parallel.distributed_join import (
             distributed_inner_join,
         )
@@ -381,6 +401,15 @@ class JoinService:
                         raise AdmissionError(
                             "mesh poisoned by a hung request "
                             f"({self.poisoned}); restart the server")
+
+                if self.tuner is not None:
+                    # Pin the tuner's READ namespace to this request's
+                    # tenant while the exec lock serializes dispatch:
+                    # recommend() deep inside distributed_inner_join
+                    # (and the watchdog's worker thread, which cannot
+                    # see this thread's scope) consults it.
+                    self.tuner.active_tenant = \
+                        tel_history.current_tenant()
 
                 def run_once():
                     return distributed_inner_join(
@@ -1005,6 +1034,11 @@ class JoinService:
             # history lines carry it so a postmortem groups by
             # trace_id across every process of the fleet.
             trace = telemetry.current_trace()
+            # Tenant stamp (docs/FLEET.md "Multi-tenancy"): installed
+            # by the wire handler's tenant_scope (None for in-process
+            # callers without one) — rides live counters, the flight
+            # ring, and the history line like the trace context.
+            tenant = tel_history.current_tenant()
             tuned = (getattr(res, "tuned", None)
                      if res is not None else None)
             if res is not None and outcome == "served":
@@ -1023,9 +1057,11 @@ class JoinService:
                 signature=sig, cache_hits=cache_hits,
                 new_traces=new_traces,
                 retry_rungs=max(counts["n_attempts"] - 1, 0),
-                integrity_retries=counts["integrity_retries"])
+                integrity_retries=counts["integrity_retries"],
+                tenant=tenant)
             self.recorder.record(
                 request_id=rid, op=op, signature=sig,
+                **({"tenant": tenant} if tenant is not None else {}),
                 # The first-rung program-cache key (truncated) — a
                 # postmortem record correlates directly with explain
                 # artifacts and cache entries; distinct from the
@@ -1049,7 +1085,7 @@ class JoinService:
                     predicted_wall_s=predicted_wall_s,
                     tuned=tuned, platform=_backend_platform(),
                     resident=resident, aggregate=aggregate,
-                    error=error, trace=trace)
+                    error=error, trace=trace, tenant=tenant)
                 if self.history is not None:
                     self.history.append(entry)
                 if self.tuner is not None:
@@ -1184,6 +1220,10 @@ class JoinService:
             },
             "tuner": (self.tuner.stats() if self.tuner is not None
                       else None),
+            # Per-tenant served/shed/QPS/latency (docs/FLEET.md
+            # "Multi-tenancy"): {} until some request carries a
+            # tenant — the --watch console's per-tenant segment.
+            "tenants": self.live.tenants_summary(),
         }
 
     def metrics_snapshot(self) -> dict:
@@ -1416,7 +1456,13 @@ class _Handler(socketserver.StreamRequestHandler):
                 # None when the request carries no trace (tracing is
                 # always optional, and off = the exact old path).
                 ctx = tracectx.child_of_wire(req)
-                with telemetry.request_scope(None, trace=ctx):
+                # Multi-tenancy (docs/FLEET.md): the optional wire
+                # `tenant` field scopes this request's accounting —
+                # admission refusals, live counters, flight records,
+                # history lines — exactly like the trace context.
+                # Absent = default tenant = the pre-tenancy records.
+                with telemetry.request_scope(None, trace=ctx), \
+                        tel_history.tenant_scope(req.get("tenant")):
                     resp = self._dispatch(req)
             except Exception as exc:  # noqa: BLE001 - wire boundary:
                 # a bad request must answer THAT client, not kill the
@@ -1859,6 +1905,17 @@ def watch(host: str, port: int, interval_s: float = 2.0,
                 line += (f"  {opname}[{ms(ol.get('p50_s'))}/"
                          f"{ms(ol.get('p95_s'))}/"
                          f"{ms(ol.get('p99_s'))}]")
+            # Per-tenant segment (docs/FLEET.md "Multi-tenancy"):
+            # QPS, shed count, p95 per tenant — absent entirely for
+            # tenant-free traffic, so the pre-tenancy line survives
+            # byte-identical.
+            for tname, ts in sorted(
+                    (st.get("tenants") or {}).items()):
+                tlat = ts.get("latency") or {}
+                line += (f"  {tname}{{qps "
+                         f"{ts.get('qps_60s') or 0:.2f} shed "
+                         f"{ts.get('shed') or 0} p95 "
+                         f"{ms(tlat.get('p95_s'))}}}")
             if st.get("poisoned"):
                 line += f"  POISONED: {st['poisoned']}"
             print(line, file=out, flush=True)

@@ -1091,6 +1091,16 @@ def check_file(path: str) -> list:
                             problems.append(
                                 f"line {i}: replica stamp missing "
                                 "index/generation keys")
+                    # Tenant stamp (telemetry/history.py): entries
+                    # from a named non-default tenant carry it;
+                    # default-tenant entries omit it (byte-identical
+                    # to the pre-tenant format).
+                    ten_stamp = ev.get("tenant")
+                    if ten_stamp is not None and \
+                            not isinstance(ten_stamp, str):
+                        problems.append(
+                            f"line {i}: tenant stamp is not a "
+                            "string")
                 elif kind not in ("event", "span"):
                     problems.append(f"line {i}: bad kind {kind!r}")
             # A torn FINAL line is the advertised killed-run artifact
@@ -1382,6 +1392,67 @@ def check_file(path: str) -> list:
         if not isinstance(doc.get("verdicts"), dict):
             problems.append("verdicts is not an object")
         return problems
+    elif name.startswith("fleet_tenant_soak") or \
+            doc.get("kind") == "fleet_tenant_soak":
+        # The multi-tenant chaos soak summary (parallel/chaos.py
+        # --tenants): a noisy tenant floods at a multiple of its
+        # quota while a quiet tenant runs oracle-graded joins — the
+        # quiet tenant's answers must stay exact with ZERO sheds and
+        # its tuner namespace untouched.
+        for key in ("kind", "harness_seed", "trials", "noisy",
+                    "quiet", "failures"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        for side in ("noisy", "quiet"):
+            block = doc.get(side)
+            if block is not None and not isinstance(block, dict):
+                problems.append(f"{side} is not an object")
+        return problems
+    elif name.startswith("fleet_autoscale") or \
+            doc.get("kind") == "fleet_autoscale":
+        # The signature-level autoscaler's decision log
+        # (service/fleet.py autoscale_record): spawn/drain events
+        # with the load figures that triggered them and, for spawns,
+        # the pre-warm verification verdict.
+        for key in ("kind", "schema_version", "enabled",
+                    "spawns_total", "drains_total", "events"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        evs = doc.get("events")
+        if not isinstance(evs, list):
+            problems.append("events is not a list")
+        else:
+            for j, ev in enumerate(evs):
+                if not isinstance(ev, dict) or \
+                        not {"action", "replica", "reason"} <= \
+                        set(ev):
+                    problems.append(
+                        f"events[{j}] missing required "
+                        "action/replica/reason keys")
+                elif ev["action"] not in ("spawn", "spawn_failed",
+                                          "drain"):
+                    problems.append(
+                        f"events[{j}] bad action "
+                        f"{ev['action']!r}")
+        return problems
+    elif name.startswith("fleet_tenant_smoke") or \
+            doc.get("kind") == "fleet_tenant_smoke":
+        # The fleet lane's two-tenant CI smoke record
+        # (service/fleet.py run_tenant_smoke): quota refusal,
+        # priority shed ordering, and an autoscale spawn whose fresh
+        # replica must serve the hot signature warm.
+        for key in ("kind", "n_ranks", "replicas",
+                    "counter_signature", "tenants", "autoscale"):
+            if key not in doc:
+                problems.append(f"missing required key {key!r}")
+        sig = doc.get("counter_signature")
+        if isinstance(sig, dict):
+            if not isinstance(sig.get("counters"), dict):
+                problems.append("counter_signature missing "
+                                "'counters'")
+        elif "counter_signature" in doc:
+            problems.append("counter_signature is not an object")
+        return problems
     elif name.startswith("fleet_timeline") or \
             doc.get("kind") == "fleet_timeline":
         # The merged fleet-timeline summary (telemetry/timeline.py
@@ -1556,6 +1627,10 @@ def main(argv=None) -> int:
              "resolved knobs) — ROADMAP item 5's autotuner input")
     hs.add_argument("path",
                     help="history.jsonl, or a directory containing it")
+    hs.add_argument("--tenant", default=None,
+                    help="summarize one tenant's entries only "
+                         "('default' selects unstamped entries — "
+                         "the default tenant omits its stamp)")
     hs.add_argument("--json", action="store_true",
                     help="print the summary JSON instead of the "
                          "human report")
@@ -1683,7 +1758,19 @@ def main(argv=None) -> int:
             from distributed_join_tpu.telemetry import history
 
             entries, malformed = history.load_history(args.path)
+            if args.tenant is not None:
+                # The default tenant omits its stamp (the pre-tenant
+                # line format, byte-identical), so selecting it means
+                # selecting the unstamped entries.
+                if args.tenant == history.DEFAULT_TENANT:
+                    entries = [e for e in entries
+                               if e.get("tenant") is None]
+                else:
+                    entries = [e for e in entries
+                               if e.get("tenant") == args.tenant]
             summary = history.summarize(entries)
+            if args.tenant is not None:
+                summary["tenant"] = args.tenant
             if malformed:
                 summary["malformed_lines"] = malformed
             if args.json:

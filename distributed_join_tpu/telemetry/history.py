@@ -49,6 +49,59 @@ from typing import Optional
 HISTORY_SCHEMA_VERSION = 1
 HISTORY_FILENAME = "history.jsonl"
 
+# Multi-tenancy (docs/FLEET.md "Multi-tenancy & autoscaling"): the
+# tenant every un-stamped request belongs to. Default-tenant entries
+# carry NO tenant field and key their trends by the bare signature —
+# the exact pre-tenancy store, byte for byte.
+DEFAULT_TENANT = "default"
+
+
+def tenant_key(signature: Optional[str],
+               tenant: Optional[str]) -> str:
+    """THE one composition of the tenant-namespaced trend key shared
+    by :func:`trends_of` and the autotuner
+    (:class:`..planning.tuner.JoinTuner`): ``tenant/signature`` for a
+    non-default tenant, the bare signature otherwise — so one
+    tenant's poisoned or skewed history can never pre-size another
+    tenant's programs, while tenant-free deployments keep their
+    historical keys."""
+    sig = signature or "?"
+    if tenant is None or tenant == DEFAULT_TENANT:
+        return sig
+    return f"{tenant}/{sig}"
+
+
+# The per-thread tenant scope: the wire handler installs the request's
+# tenant here (like telemetry.request_scope installs the trace), so
+# every accounting site on the request's thread — admission refusals,
+# the _observe fan-out — stamps the same tenant without threading a
+# parameter through every op signature. None = default tenant = the
+# exact pre-tenancy records.
+_TENANT_LOCAL = threading.local()
+
+
+class tenant_scope:
+    """Context manager installing ``tenant`` as the current thread's
+    tenant (restores the previous value on exit; None is a valid
+    scope — it masks an outer one)."""
+
+    def __init__(self, tenant: Optional[str]):
+        self.tenant = str(tenant) if tenant is not None else None
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_TENANT_LOCAL, "tenant", None)
+        _TENANT_LOCAL.tenant = self.tenant
+        return self.tenant
+
+    def __exit__(self, *exc):
+        _TENANT_LOCAL.tenant = self._prev
+        return False
+
+
+def current_tenant() -> Optional[str]:
+    return getattr(_TENANT_LOCAL, "tenant", None)
+
 # Per-stage wall drift: the same workload signature's measured stage
 # wall moving more than this factor across runs flags the trend (the
 # per-stage analog of counter_drift — re-profile before trusting a
@@ -127,7 +180,8 @@ class WorkloadHistory:
                 for e in entries:
                     if e.get("kind") == "rollup":
                         continue
-                    sig = e.get("signature") or "?"
+                    sig = tenant_key(e.get("signature"),
+                                     e.get("tenant"))
                     self._counts[sig] = self._counts.get(sig, 0) + 1
         return self._counts
 
@@ -140,7 +194,8 @@ class WorkloadHistory:
             bound = self.max_entries_per_signature
             if bound:
                 counts = self._load_counts_locked()
-                sig = entry.get("signature") or "?"
+                sig = tenant_key(entry.get("signature"),
+                                 entry.get("tenant"))
                 counts[sig] = counts.get(sig, 0) + 1
                 if counts[sig] > 2 * bound:
                     self._compact_locked(bound)
@@ -157,9 +212,17 @@ class WorkloadHistory:
         if self._f is not None and not self._f.closed:
             self._f.close()
         entries, _ = load_history(self.path)
-        by_sig: dict = {}        # sig -> [entries], insertion-ordered
+        # Grouped by the TENANT-NAMESPACED key: a rollup line carries
+        # the composed key in its signature field (and no tenant
+        # stamp), which tenant_key passes through unchanged — so a
+        # compacted store's trends land under the same keys as its
+        # live entries, and one tenant's flood can never compact away
+        # another tenant's same-signature trail.
+        by_sig: dict = {}        # key -> [entries], insertion-ordered
         for e in entries:
-            by_sig.setdefault(e.get("signature") or "?", []).append(e)
+            by_sig.setdefault(
+                tenant_key(e.get("signature"), e.get("tenant")),
+                []).append(e)
         tmp = self.path + ".tmp"
         counts: dict = {}
         with open(tmp, "w") as f:
@@ -337,7 +400,8 @@ def request_entry(*, request_id: str, op: str, signature: str,
                   aggregate: Optional[dict] = None,
                   replica: Optional[dict] = None,
                   error: Optional[str] = None,
-                  trace: Optional[dict] = None) -> dict:
+                  trace: Optional[dict] = None,
+                  tenant: Optional[str] = None) -> dict:
     """One serving request's history line (the JoinService write
     path). ``metrics`` is the request's ``Metrics.to_dict()`` block
     when telemetry rode the program, else None; ``predicted_wall_s``
@@ -352,7 +416,7 @@ def request_entry(*, request_id: str, op: str, signature: str,
     validates the stamp's shape)."""
     from distributed_join_tpu.telemetry import baselines
 
-    return {
+    entry = {
         "schema_version": HISTORY_SCHEMA_VERSION,
         "kind": "request",
         "request_id": request_id,
@@ -392,6 +456,14 @@ def request_entry(*, request_id: str, op: str, signature: str,
                   else None),
         "error": error,
     }
+    if tenant is not None and tenant != DEFAULT_TENANT:
+        # Tenant stamp (docs/FLEET.md "Multi-tenancy"): present only
+        # for non-default tenants, so default-tenant entries stay
+        # byte-identical to the pre-tenancy schema. `analyze check`
+        # validates the stamp; `analyze history --tenant` filters on
+        # it; trends key on tenant/signature through tenant_key().
+        entry["tenant"] = str(tenant)
+    return entry
 
 
 def run_signature(workload: dict) -> str:
@@ -690,10 +762,14 @@ class SignatureTrend:
 
 
 def trends_of(entries) -> dict:
-    """{signature: SignatureTrend} over a loaded store."""
+    """{trend key: SignatureTrend} over a loaded store. Keys are the
+    tenant-namespaced :func:`tenant_key` composition — the bare
+    signature for default-tenant (un-stamped) entries, so a
+    tenant-free store summarizes exactly as before."""
     sigs: dict = {}
     for e in entries:
-        sigs.setdefault(e.get("signature") or "?",
+        sigs.setdefault(tenant_key(e.get("signature"),
+                                   e.get("tenant")),
                         SignatureTrend()).add(e)
     return sigs
 

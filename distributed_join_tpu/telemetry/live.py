@@ -144,6 +144,7 @@ class LiveMetrics:
 
     QPS_WINDOW_S = 60
     MAX_SIGNATURES = 256
+    MAX_TENANTS = 64
 
     def __init__(self, now=time.monotonic):
         self._now = now
@@ -153,6 +154,8 @@ class LiveMetrics:
         self._ops: dict = {}
         self._signatures: OrderedDict = OrderedDict()
         self._signatures_dropped = 0
+        self._tenants: OrderedDict = OrderedDict()
+        self._tenants_dropped = 0
         self._arrivals = deque()        # (second, count) ring
 
     # -- write path ---------------------------------------------------
@@ -191,6 +194,28 @@ class LiveMetrics:
         }
         return slot
 
+    def _tenant_slot(self, tenant: str) -> dict:
+        """Per-tenant accounting slot (docs/FLEET.md "Multi-tenancy"):
+        only traffic that CARRIES a tenant lands here, so a
+        tenant-free deployment's snapshots and exposition stay
+        byte-identical to the pre-tenancy contract. Bounded LRU like
+        the signature table — never an unbounded label cardinality."""
+        slot = self._tenants.get(tenant)
+        if slot is not None:
+            self._tenants.move_to_end(tenant)
+            return slot
+        if len(self._tenants) >= self.MAX_TENANTS:
+            self._tenants.popitem(last=False)
+            self._tenants_dropped += 1
+        slot = self._tenants[tenant] = {
+            "requests": 0,
+            "outcomes": {},
+            "shed": 0,
+            "latency": LatencyHistogram(),
+            "arrivals": deque(),
+        }
+        return slot
+
     def _tick(self) -> None:
         sec = int(self._now())
         if self._arrivals and self._arrivals[-1][0] == sec:
@@ -206,7 +231,9 @@ class LiveMetrics:
                        signature: Optional[str] = None,
                        cache_hits: int = 0, new_traces: int = 0,
                        retry_rungs: int = 0,
-                       integrity_retries: int = 0) -> None:
+                       integrity_retries: int = 0,
+                       tenant: Optional[str] = None,
+                       shed: bool = False) -> None:
         with self._lock:
             self._tick()
             slots = [self._op_slot(op)]
@@ -223,6 +250,23 @@ class LiveMetrics:
                     slot["requests"] += 1
                 if latency_s is not None:
                     slot["latency"].observe(latency_s)
+            if tenant is not None:
+                ts = self._tenant_slot(str(tenant))
+                ts["requests"] += 1
+                ts["outcomes"][outcome] = (
+                    ts["outcomes"].get(outcome, 0) + 1)
+                ts["shed"] += int(bool(shed))
+                if latency_s is not None:
+                    ts["latency"].observe(latency_s)
+                sec = int(self._now())
+                arr = ts["arrivals"]
+                if arr and arr[-1][0] == sec:
+                    arr[-1][1] += 1
+                else:
+                    arr.append([sec, 1])
+                horizon = sec - self.QPS_WINDOW_S
+                while arr and arr[0][0] <= horizon:
+                    arr.popleft()
 
     # -- read path ----------------------------------------------------
 
@@ -255,6 +299,28 @@ class LiveMetrics:
                     for op, slot in sorted(self._ops.items())
                     if slot["latency"].count}
 
+    def tenants_summary(self) -> dict:
+        """Per-tenant served/shed counters, rolling QPS, and latency
+        quantiles — the ``stats.tenants`` block and the ``--watch``
+        console's per-tenant segment. Empty dict when no request ever
+        carried a tenant (the tenant-free wire contract)."""
+        with self._lock:
+            horizon = int(self._now()) - self.QPS_WINDOW_S
+            window = min(max(self.uptime_s(), 1.0),
+                         self.QPS_WINDOW_S)
+            out = {}
+            for tenant, slot in sorted(self._tenants.items()):
+                n = sum(c for sec, c in slot["arrivals"]
+                        if sec > horizon)
+                out[tenant] = {
+                    "requests": slot["requests"],
+                    "outcomes": dict(slot["outcomes"]),
+                    "shed": slot["shed"],
+                    "qps_60s": round(n / window, 3),
+                    "latency": slot["latency"].summary(),
+                }
+        return out
+
     def snapshot(self) -> dict:
         """The ``metrics`` wire op's JSON body."""
         with self._lock:
@@ -283,7 +349,8 @@ class LiveMetrics:
                 for digest, slot in self._signatures.items()
             }
             dropped = self._signatures_dropped
-        return {
+            have_tenants = bool(self._tenants)
+        snap = {
             "uptime_s": round(self.uptime_s(), 3),
             "epoch_start_s": self._epoch0,
             "qps_60s": round(self.qps(), 3),
@@ -291,6 +358,12 @@ class LiveMetrics:
             "signatures": signatures,
             "signatures_dropped": dropped,
         }
+        if have_tenants:
+            # Key present only when some request CARRIED a tenant —
+            # tenant-free snapshots stay byte-identical to the
+            # pre-tenancy schema (committed baselines depend on it).
+            snap["tenants"] = self.tenants_summary()
+        return snap
 
     def to_prometheus(self, gauges: Optional[dict] = None) -> str:
         """Prometheus text exposition (version 0.0.4) of the live
@@ -377,6 +450,52 @@ class LiveMetrics:
                 lines.append(
                     "djtpu_signature_requests_total"
                     f'{{signature="{digest}"}} {slot["requests"]}')
+            if self._tenants:
+                # Multi-tenancy series (docs/FLEET.md): emitted only
+                # once tenant-stamped traffic exists, so tenant-free
+                # scrapes keep the pre-tenancy exposition exactly.
+                lines += [
+                    "# HELP djtpu_tenant_requests_total Requests by "
+                    "tenant and outcome.",
+                    "# TYPE djtpu_tenant_requests_total counter",
+                ]
+                for tenant, slot in sorted(self._tenants.items()):
+                    for outcome, n in sorted(
+                            slot["outcomes"].items()):
+                        lines.append(
+                            "djtpu_tenant_requests_total"
+                            f'{{tenant="{tenant}",'
+                            f'outcome="{outcome}"}} {n}')
+                lines += [
+                    "# HELP djtpu_tenant_shed_total Requests shed by "
+                    "tenant quota/priority policy.",
+                    "# TYPE djtpu_tenant_shed_total counter",
+                ]
+                for tenant, slot in sorted(self._tenants.items()):
+                    lines.append(
+                        "djtpu_tenant_shed_total"
+                        f'{{tenant="{tenant}"}} {slot["shed"]}')
+                lines += [
+                    "# HELP "
+                    "djtpu_tenant_request_latency_quantile_seconds "
+                    "Per-tenant latency quantiles.",
+                    "# TYPE "
+                    "djtpu_tenant_request_latency_quantile_seconds "
+                    "gauge",
+                ]
+                for tenant, slot in sorted(self._tenants.items()):
+                    hist = slot["latency"]
+                    if not hist.count:
+                        continue
+                    for label, q in (("0.5", 0.50), ("0.95", 0.95),
+                                     ("0.99", 0.99)):
+                        v = hist.quantile(q)
+                        if v is not None:
+                            lines.append(
+                                "djtpu_tenant_request_latency_"
+                                "quantile_seconds"
+                                f'{{tenant="{tenant}",'
+                                f'quantile="{label}"}} {v:.6f}')
         for name, value in sorted((gauges or {}).items()):
             if value is None:
                 continue

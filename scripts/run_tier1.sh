@@ -129,7 +129,14 @@
 #                                   # --fleet 20-trial soak (one
 #                                   # replica faulted mid-soak, every
 #                                   # non-refused answer pandas-
-#                                   # oracle-graded)
+#                                   # oracle-graded) + the two-tenant
+#                                   # smoke (quota refusal, priority
+#                                   # shed order, warm-verified
+#                                   # autoscale spawn) + the chaos
+#                                   # --tenants soak (noisy tenant
+#                                   # flooded at 5x quota, quiet
+#                                   # tenant oracle-exact with zero
+#                                   # sheds, replica killed mid-soak)
 #   scripts/run_tier1.sh fleet_ha   # durable resident state + router
 #                                   # HA (docs/FLEET.md "Replication
 #                                   # & HA"): tests/test_fleet_ha.py
@@ -404,6 +411,17 @@ PY
       "$tmp/fleet_smoke.json"
     python -m distributed_join_tpu.telemetry.analyze compare \
       "$tmp/fleet_smoke.json" --baseline fleet_smoke
+    # The tenant smoke's record is schema-gated here (kind
+    # fleet_tenant_smoke: quota refusal, priority shed order,
+    # warm-verified autoscale spawn — docs/FLEET.md "Multi-tenancy
+    # & autoscaling"); its behavior gates live in the fleet lane.
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.service.fleet --tenant-smoke \
+      --platform cpu --replica-ranks 2 \
+      --json-output "$tmp/tenant_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/tenant_smoke.json"
     # The HA smoke's counter signature is part of the same gate
     # (docs/FLEET.md "Replication & HA"): the scripted holder-kill +
     # router-takeover protocol's deterministic match/trace/generation
@@ -815,6 +833,35 @@ PY
     # no exec: the EXIT trap must still clean $tmp
     python -m distributed_join_tpu.telemetry.analyze check \
       "$tmp/fleet_soak.json"
+    # 4. the two-tenant smoke (docs/FLEET.md "Multi-tenancy &
+    # autoscaling"): a noisy low-priority tenant is quota-refused
+    # (QuotaExceededError naming the bound) and priority-shed
+    # (ShedError) under the SAME pressure the quiet tenant rides
+    # served and oracle-exact, and the signature-level autoscaler
+    # spawns a replica that serves the hot signature WARM (zero new
+    # traces) before entering rotation.
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.service.fleet --tenant-smoke \
+      --platform cpu --replica-ranks 2 \
+      --history-dir "$tmp/tenant_history" \
+      --json-output "$tmp/tenant_smoke.json"
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/tenant_smoke.json" "$tmp/tenant_history/history.jsonl"
+    # 5. the multi-tenant chaos soak: the noisy tenant floods at 5x
+    # its quota while the quiet tenant's oracle-graded joins run,
+    # one replica SIGKILLed mid-soak — quiet answers exact with
+    # ZERO sheds, the noisy tenant is the one refused, history
+    # entries and trend keys stay tenant-namespaced, and the
+    # replacement serves the quiet tenant's signature warm.
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+      JAX_COMPILATION_CACHE_DIR=/tmp/djtpu_jax_cache \
+      python -m distributed_join_tpu.parallel.chaos \
+      --tenants 4 --seed 42 \
+      --json-output "$tmp/tenant_soak.json" \
+      --repro-out /tmp/djtpu_tenant_repro
+    python -m distributed_join_tpu.telemetry.analyze check \
+      "$tmp/tenant_soak.json"
     ;;
   fleet_ha)
     # Durable replicated resident state + router HA (docs/FLEET.md

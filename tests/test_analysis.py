@@ -560,6 +560,42 @@ def test_history_cli_and_artifact_checks(tmp_path, capsys):
     assert any("records[1]" in p for p in problems)
 
 
+def test_history_cli_tenant_filter(tmp_path, capsys):
+    """`analyze history --tenant` summarizes one tenant's slice of
+    the store: a named tenant selects its stamped entries (trend
+    keys stay ``tenant/signature``), the default-tenant name selects
+    the un-stamped (pre-tenancy) entries."""
+    from distributed_join_tpu.telemetry import history
+
+    store = history.WorkloadHistory(str(tmp_path))
+    store.append(history.request_entry(
+        request_id="req-000001", op="join", signature="sig-a",
+        outcome="served", wall_s=0.1, tenant="acme"))
+    store.append(history.request_entry(
+        request_id="req-000002", op="join", signature="sig-a",
+        outcome="served", wall_s=0.2))
+
+    assert analyze.main(["history", store.path, "--tenant", "acme",
+                         "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["tenant"] == "acme"
+    assert summary["n_entries"] == 1
+    assert list(summary["signatures"]) == ["acme/sig-a"]
+
+    assert analyze.main(["history", store.path, "--tenant",
+                         history.DEFAULT_TENANT, "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["n_entries"] == 1
+    assert list(summary["signatures"]) == ["sig-a"]
+
+    # An un-stamped store filtered to a tenant nobody stamped is
+    # empty, not an error.
+    assert analyze.main(["history", store.path, "--tenant", "ghost",
+                         "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["n_entries"] == 0
+
+
 def test_run_entry_from_driver_record(tmp_path):
     """The drivers' --history flag appends a run-shaped entry whose
     workload hash is stable across repeats and whose counter signature

@@ -379,6 +379,180 @@ def test_duplicate_request_id_parks_never_dispatches_concurrently():
         router.stop()
 
 
+# -- multi-tenant admission: quotas, priority shed, autoscaling --------
+
+
+def test_tenant_quota_refusals_structured_and_named():
+    """Each per-tenant bound refuses with a structured
+    QuotaExceededError NAMING the bound crossed (QPS bucket,
+    inflight cap, p95), stamped with ``shed`` + the tenant — and an
+    UNSTAMPED request is byte-identical to the pre-tenant contract
+    (no ``tenant`` key anywhere)."""
+    def factory(index, generation):
+        return FakeReplica(_ok_handler)
+
+    cfg = FleetConfig(
+        n_replicas=2, replica_ranks=2, probe_interval_s=30.0,
+        tenants={"b": {"qps": 0.001, "burst_s": 1.0},
+                 "c": {"max_inflight": 0},
+                 "d": {"shed_p95_s": 0.5}})
+    router = FleetRouter(factory, cfg)
+    router.start()
+    try:
+        # QPS bucket: holds max(qps*burst, 1) = 1 token — the first
+        # request spends it, the back-to-back repeat refuses.
+        first = router.dispatch({**Q, "tenant": "b"})
+        assert first["ok"], first
+        second = router.dispatch({**Q, "tenant": "b"})
+        assert not second["ok"]
+        assert second["error"] == "QuotaExceededError"
+        assert second["shed"] and second["tenant"] == "b"
+        assert "QPS quota" in second["message"]
+
+        # Inflight cap.
+        capped = router.dispatch({**Q, "tenant": "c"})
+        assert capped["error"] == "QuotaExceededError"
+        assert "max_inflight" in capped["message"]
+
+        # Per-tenant p95 bound, read from the probed snapshots the
+        # global shed policy uses.
+        for rep in router.replicas:
+            rep.last_stats = {"qps_60s": 1.0,
+                              "latency": {"p95_s": 2.0}}
+        slow = router.dispatch({**Q, "tenant": "d"})
+        assert slow["error"] == "QuotaExceededError"
+        assert "p95" in slow["message"]
+
+        st = router.stats()["tenants"]
+        assert st["b"]["quota_sheds"] == 1
+        assert st["c"]["quota_sheds"] == 1
+        assert st["d"]["quota_sheds"] == 1
+        assert st["b"]["shed"] == 1 and st["b"]["inflight"] == 0
+
+        # The default tenant rides the legacy contract untouched.
+        legacy = router.dispatch(dict(Q))
+        assert legacy["ok"] and "tenant" not in legacy
+        assert set(router.stats()["tenants"]) == {"b", "c", "d"}
+    finally:
+        router.stop()
+
+
+def test_priority_shed_order_low_yields_first():
+    """Under the SAME fleet pressure the low-priority tenant's
+    per-replica headroom (its priority share of the fleet inflight
+    bound) runs out first: bronze sheds with ShedError naming the
+    priority bound while gold — and the pressure gone — both
+    serve."""
+    def factory(index, generation):
+        return FakeReplica(_ok_handler)
+
+    cfg = FleetConfig(
+        n_replicas=2, replica_ranks=2, probe_interval_s=30.0,
+        max_inflight_per_replica=2,
+        tenants={"low": {"priority": 1}, "high": {"priority": 2}})
+    router = FleetRouter(factory, cfg)
+    router.start()
+    try:
+        with router._lock:
+            for rep in router.replicas:
+                rep.inflight += 1
+        low = router.dispatch({**Q, "tenant": "low"})
+        high = router.dispatch({**Q, "tenant": "high"})
+        with router._lock:
+            for rep in router.replicas:
+                rep.inflight = max(rep.inflight - 1, 0)
+        assert not low["ok"] and low["error"] == "ShedError"
+        assert low["shed"] and low["tenant"] == "low"
+        assert "priority" in low["message"]
+        assert high["ok"], \
+            "the high-priority tenant must ride the SAME pressure"
+        assert router.stats()["tenants"]["low"][
+            "priority_sheds"] == 1
+        relieved = router.dispatch({**Q, "tenant": "low"})
+        assert relieved["ok"], relieved
+    finally:
+        router.stop()
+
+
+def test_autoscaler_spawns_warm_verified_then_drains_idle(tmp_path):
+    """The signature-level control loop: sustained probed QPS over
+    the up bound spawns replica 2 — pre-warm gated on a replay of
+    the hottest retained spec with ZERO new traces BEFORE rotation —
+    and a sustained idle fleet drains it back, never below the base
+    replica count. The fleet_autoscale record passes analyze."""
+    from distributed_join_tpu.telemetry.analyze import check_file
+
+    def factory(index, generation):
+        return FakeReplica(_ok_handler)
+
+    cfg = FleetConfig(
+        n_replicas=2, replica_ranks=2, probe_interval_s=30.0,
+        autoscale=True, autoscale_max_replicas=3,
+        autoscale_up_qps=0.5, autoscale_interval_s=0.05,
+        autoscale_sustain=2, autoscale_down_qps=0.1,
+        autoscale_idle_s=0.3)
+    router = FleetRouter(factory, cfg)
+    router.start()
+    try:
+        served = router.dispatch(dict(Q))  # retains the hot spec
+        assert served["ok"]
+        with router._lock:
+            for rep in router.replicas:
+                rep.last_stats = {"qps_60s": 5.0,
+                                  "latency": {"p95_s": 0.01}}
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with router._lock:
+                if router.autoscale_spawns_total >= 1:
+                    break
+            time.sleep(0.02)
+        record = router.autoscale_record()
+        spawns = [e for e in record["events"]
+                  if e["action"] == "spawn"]
+        assert spawns, record["events"]
+        ev = spawns[0]
+        assert ev["replica"] == 2
+        assert ev["warm_verified"] and ev["new_traces"] == 0
+        assert ev["signature"] == affinity_key(Q, 2)
+        with router._lock:
+            scaled = [r for r in router.replicas if r.index == 2]
+        assert scaled and scaled[0].state == "healthy"
+        assert router.stats()["autoscale"]["spawns_total"] == 1
+        # No runaway: at the max, sustained heat spawns nothing.
+        time.sleep(0.3)
+        assert router.autoscale_spawns_total == 1
+
+        # Idle: QPS under the down bound + nothing in flight,
+        # sustained past autoscale_idle_s, drains the SCALED replica
+        # only — the base fleet never shrinks.
+        with router._lock:
+            for rep in router.replicas:
+                rep.last_stats = {"qps_60s": 0.0, "latency": {}}
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            record = router.autoscale_record()
+            if any(e["action"] == "drain"
+                   for e in record["events"]):
+                break
+            time.sleep(0.02)
+        assert [e["action"] for e in record["events"]].count(
+            "drain") == 1
+        with router._lock:
+            live = [r.index for r in router.replicas
+                    if r.state in ("healthy", "suspect")]
+        assert sorted(live) == [0, 1], \
+            "only the scaled-up replica drains"
+        time.sleep(0.5)
+        assert router.autoscale_drains_total == 1, \
+            "the base fleet must never shrink below n_replicas"
+
+        out = tmp_path / "autoscale.json"
+        out.write_text(json.dumps(record))
+        assert check_file(str(out)) == []
+    finally:
+        router.stop()
+
+
 # -- real replicas over disjoint device subsets ------------------------
 
 
@@ -562,6 +736,89 @@ def test_corrupt_refuses_loudly_through_router_never_wrong_rows(
         assert router.stats()["drains_total"] == 0
     finally:
         teardown_fleet(router, server, client)
+
+
+def test_program_cache_is_tenant_free_history_is_not(tmp_path):
+    """The shared program cache stays SHARED across tenants: the
+    compiled executable is keyed by workload signature alone
+    (tenant-free by construction), so tenant beta's first request
+    for alpha's signature is a warm cache hit on the SAME affine
+    replica — while the router's history stamps each entry with its
+    tenant and the tuner trend table keys ``tenant/signature``."""
+    router, server, client = make_fleet(
+        tmp_path, history_dir=str(tmp_path / "hist"),
+        probe_interval_s=10.0)
+    try:
+        cold = client.send({**Q, "tenant": "alpha"})
+        assert cold["ok"], cold
+        warm = client.send({**Q, "tenant": "beta"})
+        assert warm["ok"], warm
+        assert warm["fleet"]["replica"] == cold["fleet"]["replica"], \
+            "affinity must ignore the tenant stamp"
+        assert warm["new_traces"] == 0, \
+            "tenant beta must hit alpha's compiled executable"
+    finally:
+        teardown_fleet(router, server, client)
+
+    from distributed_join_tpu.telemetry import history as hist_mod
+    from distributed_join_tpu.telemetry.analyze import check_file
+
+    hist = tmp_path / "hist" / "history.jsonl"
+    assert check_file(str(hist)) == []
+    entries = [json.loads(ln) for ln in
+               hist.read_text().splitlines()]
+    reqs = [e for e in entries if e.get("kind") == "request"]
+    assert {e.get("tenant") for e in reqs} == {"alpha", "beta"}
+    # The trend namespace: same signature, one row per tenant.
+    sig = fleet_mod.affinity_key(Q, 2)
+    assert hist_mod.tenant_key(sig, "alpha") == f"alpha/{sig}"
+    assert hist_mod.tenant_key(sig, None) == sig
+    trends = hist_mod.trends_of(reqs)
+    assert f"alpha/{sig}" in trends and f"beta/{sig}" in trends
+
+
+def test_tenant_artifact_schemas(tmp_path):
+    """`analyze check` recognizes the three tenancy artifact kinds
+    by their stamps and flags gutted ones."""
+    from distributed_join_tpu.telemetry.analyze import check_file
+
+    docs = {
+        "soak.json": {
+            "kind": "fleet_tenant_soak", "schema_version": 1,
+            "harness_seed": 7, "slice": "tenants", "victim": 1,
+            "replica_ranks": 2, "trials": 4,
+            "verdicts": {"ok": 4},
+            "noisy": {"sent": 40, "quota_shed": 33},
+            "quiet": {"trials": 4, "shed_responses": 0},
+            "failures": 0},
+        "autoscale.json": {
+            "kind": "fleet_autoscale", "schema_version": 1,
+            "enabled": True, "spawns_total": 1, "drains_total": 0,
+            "replicas": 3,
+            "events": [{"action": "spawn", "replica": 2,
+                        "reason": "sustained load",
+                        "warm_verified": True, "new_traces": 0}]},
+        "smoke.json": {
+            "kind": "fleet_tenant_smoke", "n_ranks": 2,
+            "replicas": 2,
+            "counter_signature": {"signature_version": 1,
+                                  "n_ranks": 2,
+                                  "counters": {"replicas": 2}},
+            "tenants": {"gold": {}}, "autoscale": {}},
+    }
+    gut = {"fleet_tenant_soak": "noisy",
+           "fleet_autoscale": "events",
+           "fleet_tenant_smoke": "counter_signature"}
+    for name, doc in docs.items():
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        assert check_file(str(p)) == [], name
+        gutted = dict(doc)
+        gutted.pop(gut[doc["kind"]])
+        bad = tmp_path / ("bad_" + name)
+        bad.write_text(json.dumps(gutted))
+        assert check_file(str(bad)), \
+            f"a gutted {doc['kind']} artifact must be flagged"
 
 
 def test_fleet_soak_artifact_schema():

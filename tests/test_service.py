@@ -755,6 +755,49 @@ def test_watch_console_unreachable_daemon_is_one_line():
     assert "cannot reach daemon" in out.getvalue()
 
 
+def test_watch_console_per_tenant_segment():
+    """Tenant-stamped traffic adds a per-tenant ``name{qps .. shed
+    .. p95 ..}`` segment to the watch line; tenant-free traffic
+    keeps the pre-tenancy line with no segment at all."""
+    import io
+
+    from distributed_join_tpu.service.server import (
+        JoinService,
+        ServiceClient,
+        ServiceConfig,
+        start_daemon,
+        watch,
+    )
+
+    comm = dj.make_communicator("tpu", n_ranks=8)
+    service = JoinService(comm, ServiceConfig())
+    server, port = start_daemon(service)
+    try:
+        out = io.StringIO()
+        assert watch("127.0.0.1", port, interval_s=0.05, count=1,
+                     out=out) == 0
+        assert "{qps" not in out.getvalue(), \
+            "tenant-free traffic must keep the pre-tenancy line"
+
+        client = ServiceClient("127.0.0.1", port)
+        try:
+            q = {"op": "join", "build_nrows": 256,
+                 "probe_nrows": 256, "seed": 7, "selectivity": 0.5,
+                 "out_capacity_factor": 4.0, "tenant": "acme"}
+            assert client.send(q)["ok"]
+        finally:
+            client.close()
+        out = io.StringIO()
+        assert watch("127.0.0.1", port, interval_s=0.05, count=1,
+                     out=out) == 0
+        line = out.getvalue().strip()
+        assert "acme{qps" in line and "shed" in line \
+            and "p95" in line, line
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 def test_malformed_batch_is_counted_and_flight_recorded():
     """A batch that dies in combine() (schema mismatch) must still be
     visible to operators: failed count, live metric, flight record."""

@@ -113,6 +113,34 @@ def test_adopts_escalated_rung_and_overrides_explicit_sizing():
     assert cfg.applied["out_capacity_factor"] == 0.8
 
 
+def test_tenant_namespaced_trends_never_cross_presize():
+    """One tenant's escalated history pre-sizes ONLY its own
+    namespace (``tenant/signature``): the other tenant and the
+    default (un-stamped) tenant stay static for the same signature,
+    and ``active_tenant`` scopes a lookup exactly like the explicit
+    ``tenant=`` kwarg."""
+    t = JoinTuner()
+    t.observe_entry(_escalated_entry("s1", tenant="acme"))
+    assert t.recommend("s1", tenant="acme").source == "history"
+    # The SAME signature: the other tenant and the default tenant
+    # must not inherit acme's (possibly poisoned) sizing.
+    assert t.recommend("s1", tenant="globex").source == "static"
+    assert t.recommend("s1").source == "static"
+    # active_tenant is the exec-lock-scoped equivalent of tenant=.
+    t.active_tenant = "acme"
+    try:
+        assert t.recommend("s1").source == "history"
+    finally:
+        t.active_tenant = None
+    # An explicit tenant= wins over active_tenant... and the default
+    # tenant name maps to the bare-signature (pre-tenancy) table.
+    t.observe_entry(_escalated_entry("s1"))
+    from distributed_join_tpu.telemetry.history import DEFAULT_TENANT
+
+    assert t.recommend("s1",
+                       tenant=DEFAULT_TENANT).source == "history"
+
+
 def test_legacy_entries_without_rung_backfill_from_attempts():
     """PR 7/8-era history lines carry resolved_knobs but no 'rung';
     the ladder always started at 0 then, so the final rung is
